@@ -1,0 +1,16 @@
+from pbs_tpu.runtime.executor import Executor, quantum_to_steps
+from pbs_tpu.runtime.job import ContextState, ExecutionContext, Job, SchedParams
+from pbs_tpu.runtime.partition import Partition
+from pbs_tpu.runtime.timer import Timer, TimerWheel
+
+__all__ = [
+    "ContextState",
+    "ExecutionContext",
+    "Executor",
+    "Job",
+    "Partition",
+    "SchedParams",
+    "Timer",
+    "TimerWheel",
+    "quantum_to_steps",
+]

@@ -1,0 +1,116 @@
+"""Executors: the pCPU + ``schedule()`` softirq loop analog.
+
+Each executor multiplexes execution contexts over one device lane of its
+partition, mirroring Xen's per-pCPU scheduling loop
+(``xen/common/schedule.c:1082-1185``): fire due timers, ask the policy
+for (next, quantum), context-switch with telemetry save/restore
+(``__context_switch`` at ``arch/x86/domain.c:1583-1650``:
+``pmu_save_regs(prev)``; ``pmu_restore_regs(next)``; ``sched_count++``),
+run, account.
+
+TPU twist: there is no device preemption, so a quantum is realized as N
+compiled steps, N derived from the policy's nanosecond slice and the
+context's measured per-step time (SURVEY.md §7: "quantum = N compiled
+steps"; the 100 µs slice's real analog).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+from pbs_tpu.runtime.job import ContextState, ExecutionContext
+from pbs_tpu.telemetry.counters import Counter
+
+if TYPE_CHECKING:
+    from pbs_tpu.runtime.partition import Partition
+
+#: Upper bound on steps per quantum, so a mispredicted avg_step_ns can't
+#: starve the partition (no analog needed in Xen — timers preempt).
+MAX_STEPS_PER_QUANTUM = 1024
+
+
+def quantum_to_steps(quantum_ns: int, avg_step_ns: float) -> int:
+    if avg_step_ns <= 0:
+        return 1
+    return max(1, min(MAX_STEPS_PER_QUANTUM, round(quantum_ns / avg_step_ns)))
+
+
+class Executor:
+    """One schedulable device lane (pCPU analog)."""
+
+    def __init__(self, partition: "Partition", index: int, device=None):
+        self.partition = partition
+        self.index = index
+        self.device = device
+        self.current: ExecutionContext | None = None
+        self.idle_ns = 0
+        self.sched_invocations = 0
+
+    # ------------------------------------------------------------------
+
+    def schedule_once(self) -> bool:
+        """One trip through the scheduler loop. Returns True if work ran."""
+        part = self.partition
+        now = part.clock.now_ns()
+        part.timers.fire_due(now)
+        decision = part.scheduler.do_schedule(self, now)
+        self.sched_invocations += 1
+        ctx = decision.ctx
+        if ctx is None:
+            return False
+        self._run(ctx, decision.quantum_ns)
+        return True
+
+    def _run(self, ctx: ExecutionContext, quantum_ns: int) -> None:
+        part = self.partition
+        now = part.clock.now_ns()
+
+        if ctx.job.finished():
+            # Admitted with max_steps already reached (e.g. 0): retire
+            # without executing anything.
+            for c in ctx.job.contexts:
+                if c.state is not ContextState.DONE:
+                    c.state = ContextState.DONE
+                    part.scheduler.sleep(c)
+            return
+
+        # -- context switch in: pmu_restore_regs + sched_count++ --------
+        self.current = ctx
+        ctx.state = ContextState.RUNNING
+        ctx.sched_count += 1
+        if ctx.ledger_slot >= 0:
+            part.ledger.resume(ctx.ledger_slot, now)
+
+        n_steps = quantum_to_steps(quantum_ns, ctx.avg_step_ns)
+        if ctx.job.max_steps is not None:
+            remaining = ctx.job.max_steps - ctx.job.steps_retired()
+            n_steps = max(1, min(n_steps, remaining))
+
+        deltas = part.source.execute(ctx, n_steps)
+
+        # -- context switch out: pmu_save_regs (perfctr_cpu_vsuspend
+        # publishes sums into vcpu->pmc[], perfctr.c:1547-1573) ----------
+        ran_ns = int(deltas[Counter.DEVICE_TIME_NS])
+        deltas[Counter.SCHED_COUNT] = 1
+        ctx.counters += deltas
+        ctx.observe_step_time(ran_ns, n_steps)
+        if ctx.ledger_slot >= 0:
+            part.ledger.suspend(ctx.ledger_slot, deltas)
+        self.current = None
+
+        end = part.clock.now_ns()
+        part.timers.fire_due(end)
+        part.scheduler.descheduled(self, ctx, ran_ns, end)
+
+        if ctx.job.finished():
+            for c in ctx.job.contexts:
+                if c.state is not ContextState.DONE:
+                    c.state = ContextState.DONE
+                    part.scheduler.sleep(c)
+        elif ctx.state is ContextState.RUNNING:
+            ctx.state = ContextState.RUNNABLE
+
+    def __repr__(self) -> str:
+        return f"Executor({self.partition.name}#{self.index})"

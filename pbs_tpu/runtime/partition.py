@@ -1,0 +1,186 @@
+"""Partitions: cpupool analogs owning devices, a scheduler, and jobs.
+
+Xen cpupools (``xen/common/cpupool.c``) hard-partition pCPUs into pools,
+each with its own scheduler instance; domains live in exactly one pool.
+Here a Partition owns a set of device lanes (TPU cores/chips or sim
+lanes), one scheduler instance chosen from the registry, the telemetry
+ledger for its contexts (the 8-page shared_info analog,
+``xen/common/domain.c:618-626``), and the timer substrate.
+
+The cooperative ``run()`` loop drives executors round-robin on one host
+thread — the simulation/CI mode. Under a ``VirtualClock`` the loop is
+fully deterministic; when every executor is idle the clock jumps to the
+next timer deadline (event-driven simulation).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pbs_tpu.runtime.executor import Executor
+from pbs_tpu.runtime.job import ContextState, Job, SchedParams
+from pbs_tpu.runtime.timer import TimerWheel
+from pbs_tpu.sched.base import Scheduler, make_scheduler
+from pbs_tpu.telemetry.ledger import Ledger
+from pbs_tpu.telemetry.source import TelemetrySource
+from pbs_tpu.utils.clock import Clock, VirtualClock
+
+DEFAULT_LEDGER_SLOTS = 128
+
+
+class Partition:
+    def __init__(
+        self,
+        name: str,
+        source: TelemetrySource,
+        scheduler: str = "credit",
+        n_executors: int = 1,
+        devices: list[Any] | None = None,
+        clock: Clock | None = None,
+        ledger_slots: int = DEFAULT_LEDGER_SLOTS,
+        sched_params: dict[str, Any] | None = None,
+    ):
+        self.name = name
+        self.source = source
+        self.clock = clock if clock is not None else source.clock
+        self.timers = TimerWheel()
+        self.ledger = Ledger(ledger_slots)
+        self._free_slots = list(range(ledger_slots - 1, -1, -1))
+        self.jobs: list[Job] = []
+        self.executors: list[Executor] = []
+        self.scheduler: Scheduler = make_scheduler(
+            scheduler, self, **(sched_params or {})
+        )
+        devices = devices or [None] * n_executors
+        for i, dev in enumerate(devices):
+            ex = Executor(self, i, device=dev)
+            self.executors.append(ex)
+            self.scheduler.executor_added(ex)
+
+    # -- admission (domain_create analog, xen/common/domain.c) -----------
+
+    def add_job(self, job: Job) -> Job:
+        for ctx in job.contexts:
+            if not self._free_slots:
+                raise RuntimeError("ledger slots exhausted")
+            ctx.ledger_slot = self._free_slots.pop()
+            self.ledger.reset(ctx.ledger_slot)
+        self.jobs.append(job)
+        self.scheduler.job_added(job)
+        for ctx in job.contexts:
+            if ctx.state is ContextState.RUNNABLE:
+                self.scheduler.wake(ctx)
+        return job
+
+    def create_job(
+        self,
+        name: str,
+        step_fn: Callable | None = None,
+        state: Any = None,
+        params: SchedParams | None = None,
+        **kw: Any,
+    ) -> Job:
+        job = Job(name, step_fn=step_fn, state=state, params=params, **kw)
+        return self.add_job(job)
+
+    def remove_job(self, job: Job) -> None:
+        self.scheduler.job_removed(job)
+        self.jobs.remove(job)
+        for ctx in job.contexts:
+            if ctx.ledger_slot >= 0:
+                self._free_slots.append(ctx.ledger_slot)
+                ctx.ledger_slot = -1
+
+    def job(self, name: str) -> Job:
+        for j in self.jobs:
+            if j.name == name:
+                return j
+        raise KeyError(name)
+
+    # -- run-state control (vcpu_sleep/wake, schedule.c) -----------------
+
+    def sleep_job(self, job: Job) -> None:
+        for ctx in job.contexts:
+            if ctx.runnable():
+                ctx.state = ContextState.BLOCKED
+                self.scheduler.sleep(ctx)
+
+    def wake_job(self, job: Job) -> None:
+        for ctx in job.contexts:
+            if ctx.state is ContextState.BLOCKED:
+                ctx.state = ContextState.RUNNABLE
+                self.scheduler.wake(ctx)
+
+    # -- the loop --------------------------------------------------------
+
+    def pending_work(self) -> bool:
+        # PARKED counts: a timer (acct refill) will unpark it
+        # (CSCHED_FLAG_VCPU_PARKED is cleared in csched_acct).
+        live = (ContextState.RUNNABLE, ContextState.RUNNING,
+                ContextState.PARKED)
+        return any(
+            ctx.state in live for j in self.jobs for ctx in j.contexts
+        )
+
+    def run(
+        self,
+        until_ns: int | None = None,
+        max_rounds: int | None = None,
+    ) -> int:
+        """Drive executors until no runnable work (or bounds hit).
+
+        Returns the number of quanta executed.
+        """
+        rounds = 0
+        quanta = 0
+        while True:
+            if until_ns is not None and self.clock.now_ns() >= until_ns:
+                break
+            if max_rounds is not None and rounds >= max_rounds:
+                break
+            rounds += 1
+            ran_any = False
+            for ex in self.executors:
+                if until_ns is not None and self.clock.now_ns() >= until_ns:
+                    break
+                if ex.schedule_once():
+                    ran_any = True
+                    quanta += 1
+            if not ran_any:
+                if not self.pending_work():
+                    break
+                # All runnable work exists but nothing was dispatched
+                # (e.g. parked for cap enforcement): jump to the next
+                # timer event under virtual time, else we're stuck.
+                deadline = self.timers.next_deadline()
+                if deadline is None:
+                    break
+                if isinstance(self.clock, VirtualClock):
+                    if deadline > self.clock.now_ns():
+                        self.clock.advance(deadline - self.clock.now_ns())
+                    self.timers.fire_due(self.clock.now_ns())
+                else:
+                    import time as _t
+
+                    _t.sleep(min(0.001, max(0.0, (deadline - self.clock.now_ns()) / 1e9)))
+        return quanta
+
+    # -- observability ---------------------------------------------------
+
+    def dump(self) -> dict[str, Any]:
+        """The 'r'/'z' console-key dump surface
+        (``keyhandler.c:543-563``, ``schedule_customized_dump``
+        ``schedule.c:1442-1451``)."""
+        return {
+            "partition": self.name,
+            "scheduler": self.scheduler.dump_settings(),
+            "executors": [
+                {
+                    "index": ex.index,
+                    "sched_invocations": ex.sched_invocations,
+                    **self.scheduler.dump_executor(ex),
+                }
+                for ex in self.executors
+            ],
+            "contexts": self.scheduler.dump_admin_conf(),
+        }

@@ -1,0 +1,19 @@
+from pbs_tpu.sched.base import (
+    Decision,
+    Scheduler,
+    make_scheduler,
+    register_scheduler,
+    scheduler_names,
+)
+from pbs_tpu.sched.credit import CreditScheduler
+from pbs_tpu.sched.feedback import FeedbackPolicy
+
+__all__ = [
+    "Decision",
+    "Scheduler",
+    "make_scheduler",
+    "register_scheduler",
+    "scheduler_names",
+    "CreditScheduler",
+    "FeedbackPolicy",
+]

@@ -1,0 +1,157 @@
+"""Pluggable scheduler framework.
+
+Analog of Xen's generic scheduler layer: the ops table ``struct scheduler``
+(``xen-4.2.1/xen/include/xen/sched-if.h:144-190`` — including the
+research-added ``.dump_admin_conf`` hook at ``sched-if.h:186``), the
+``schedulers[]`` registry (``xen/common/schedule.c:65-70`` lists sedf,
+credit, credit2, arinc653), and the ``schedule()`` dispatch that asks the
+policy for (next vcpu, time slice) (``schedule.c:1082-1122``).
+
+Each Partition (cpupool analog, ``xen/common/cpupool.c``) instantiates its
+own scheduler — pools with different policies coexist, exactly as Xen
+cpupools do.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import TYPE_CHECKING, Any
+
+if TYPE_CHECKING:
+    from pbs_tpu.runtime.executor import Executor
+    from pbs_tpu.runtime.job import ExecutionContext, Job
+    from pbs_tpu.runtime.partition import Partition
+
+
+@dataclasses.dataclass
+class Decision:
+    """What ``do_schedule`` returns: run this context for this long.
+
+    Mirrors ``struct task_slice { vcpu, time, migrated }``
+    (``sched-if.h``); ``quantum_ns`` is the per-job adaptive slice applied
+    at ``csched_schedule`` exit (``sched_credit.c:1796-1805``). ``None``
+    context = idle.
+    """
+
+    ctx: "ExecutionContext | None"
+    quantum_ns: int
+
+
+class Scheduler(abc.ABC):
+    """Policy interface. One instance per Partition."""
+
+    name: str = "abstract"
+
+    def __init__(self, partition: "Partition", **params: Any):
+        self.partition = partition
+
+    # -- lifecycle hooks (alloc_pdata / insert_vcpu / ... analogs) -------
+
+    def executor_added(self, ex: "Executor") -> None:  # alloc_pdata
+        pass
+
+    def executor_removed(self, ex: "Executor") -> None:  # free_pdata
+        pass
+
+    def job_added(self, job: "Job") -> None:  # alloc_domdata + insert_vcpu
+        pass
+
+    def job_removed(self, job: "Job") -> None:
+        pass
+
+    # -- run-state transitions ------------------------------------------
+
+    def sleep(self, ctx: "ExecutionContext") -> None:
+        pass
+
+    @abc.abstractmethod
+    def wake(self, ctx: "ExecutionContext") -> None:
+        """Make ctx runnable (csched_vcpu_wake, incl. BOOST)."""
+
+    def yield_(self, ctx: "ExecutionContext") -> None:
+        pass
+
+    def pick_executor(self, ctx: "ExecutionContext") -> int:
+        """Placement (csched_cpu_pick). Default: round-robin by index."""
+        n = len(self.partition.executors)
+        return ctx.index % n if n else 0
+
+    # -- the hot path ----------------------------------------------------
+
+    @abc.abstractmethod
+    def do_schedule(self, ex: "Executor", now_ns: int) -> Decision:
+        """Pick the next context for this executor."""
+
+    def descheduled(self, ex: "Executor", ctx: "ExecutionContext",
+                    ran_ns: int, now_ns: int) -> None:
+        """Called after a quantum completes (credit burn happens here —
+        burn_credits, sched_credit.c:527-543)."""
+
+    # -- control plane ---------------------------------------------------
+
+    def adjust_job(self, job: "Job", **params: Any) -> None:
+        """Per-job knobs (csched_dom_cntl: weight/cap,
+        sched_credit.c:1103-1155)."""
+        for k, v in params.items():
+            if not hasattr(job.params, k):
+                raise KeyError(f"unknown sched param {k!r}")
+            setattr(job.params, k, v)
+
+    def adjust_global(self, **params: Any) -> None:
+        """System knobs (csched_sys_cntl: tslice µs bounds,
+        sched_credit.c:1157-1170)."""
+        raise NotImplementedError(f"{self.name} has no global knobs")
+
+    # -- observability ---------------------------------------------------
+
+    def dump_settings(self) -> dict[str, Any]:
+        return {"name": self.name}
+
+    def dump_executor(self, ex: "Executor") -> dict[str, Any]:
+        return {}
+
+    def dump_admin_conf(self) -> list[dict[str, Any]]:
+        """The research-added ops hook (sched-if.h:186): per-context
+        counter/sched_count dump behind the 'z' console key
+        (csched_dump_customized, sched_credit.c:1944-1977)."""
+        out = []
+        for job in self.partition.jobs:
+            for ctx in job.contexts:
+                out.append(
+                    {
+                        "ctx": ctx.name,
+                        "sched_count": ctx.sched_count,
+                        "counters": {
+                            "STEPS_RETIRED": int(ctx.counters[0]),
+                            "DEVICE_TIME_NS": int(ctx.counters[1]),
+                            "HBM_BYTES": int(ctx.counters[2]),
+                            "HBM_STALL_NS": int(ctx.counters[3]),
+                        },
+                    }
+                )
+        return out
+
+
+# -- registry (schedulers[] analog, schedule.c:65-70) -----------------------
+
+_REGISTRY: dict[str, type[Scheduler]] = {}
+
+
+def register_scheduler(cls: type[Scheduler]) -> type[Scheduler]:
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def scheduler_names() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def make_scheduler(name: str, partition: "Partition", **params: Any) -> Scheduler:
+    try:
+        cls = _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown scheduler {name!r}; available: {scheduler_names()}"
+        ) from None
+    return cls(partition, **params)

@@ -1,0 +1,227 @@
+"""Telemetry-feedback adaptive quantum policy — the research core.
+
+TPU-native re-expression of the reference's PMU-feedback loop
+(``xen-4.2.1/xen/common/sched_credit.c``):
+
+- ``csched_metric_tick`` (1 ms, ``sched_credit.c:450-465``): sample each
+  context's counters.
+- ``csched_dom_metric_update`` (``sched_credit.c:391-448``): per job, sum
+  counter deltas over contexts (``pmc - prev_pmc``), derive the rate
+  metrics — cache-miss rate (misses × 10⁵ / instruction) and CPI.
+- ``csched_submilli_metric_update`` (``sched_credit.c:302-389``): a
+  5-sample window over the average contention latency per event
+  (``spinlock_metric_update / spinlock_count``, fed by the ``vcrd_op``
+  channel); the window is *stable* when every sample lies within
+  [70%, 130%] of the window mean (``sched_credit.c:114,354-357``);
+  stable + miss-rate ≥ 100 → LOW_PHASE, grow the slice +100 µs (cap
+  1.1 ms); stable + miss-rate < 100 → HIGH_PHASE, shrink ÷3 (or −200 µs)
+  floor 100 µs; unstable → reset window, shrink if contention is rising.
+
+Counter translation (see ``pbs_tpu.telemetry.counters``):
+
+- instructions → steps retired; cycles → device ns.
+- LLC miss rate → HBM-stall rate: ``HBM_STALL_NS × 1000 / DEVICE_TIME_NS``
+  (scaled so the reference's phase threshold of 100
+  (``sched_credit.c:360-369``) means "10% of device time stalled on HBM").
+- spinlock latency → collective/barrier wait reported through
+  ``Job.report_contention`` (batched per step, not per event — fixing the
+  hypercall storm flagged at SURVEY.md §3.5) plus the
+  ``COLLECTIVE_WAIT_NS`` counter.
+
+On a TPU the slice in µs is realized as N compiled steps (see
+``pbs_tpu.runtime.executor.quantum_to_steps``): growing the slice
+amortizes dispatch overhead for steady memory-bound phases; shrinking it
+bounds the latency impact on co-tenants during contended/interactive
+phases — the same tradeoff the reference's 100 µs–1.1 ms band encodes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+from pbs_tpu.telemetry.counters import Counter
+from pbs_tpu.utils.clock import MS, US
+
+if TYPE_CHECKING:
+    from pbs_tpu.runtime.job import Job
+    from pbs_tpu.runtime.partition import Partition
+
+# Constants from the reference (BASELINE.md).
+METRIC_TICK_PERIOD_NS = 1 * MS  # CSCHED_METRIC_TICK_PERIOD (s_c.c:55)
+WINDOW = 5  # event filter window (s_c.c:114)
+STABLE_LO = 0.70  # stability band (s_c.c:354-357)
+STABLE_HI = 1.30
+STALL_RATE_THRESHOLD = 100.0  # phase threshold (s_c.c:360-369)
+TSLICE_MIN_US = 100  # floor (s_c.c:286-300)
+TSLICE_MAX_US = 1_100  # cap of built variant
+GROW_STEP_US = 100
+SHRINK_SUB_US = 200
+
+LOW_PHASE = "low"  # SPIN_LOW_PHASE: grow
+HIGH_PHASE = "high"  # SPIN_HIGH_PHASE: shrink
+
+
+@dataclasses.dataclass
+class JobMetricState:
+    """Per-job filter state (``struct metric_state``/``event_sample``,
+    ``sched_credit.c:173-191``)."""
+
+    window: list[float] = dataclasses.field(default_factory=list)
+    phase: str = LOW_PHASE
+    last_contention: tuple[int, int] = (0, 0)
+    ticks: int = 0
+    grows: int = 0
+    shrinks: int = 0
+    resets: int = 0
+
+
+class FeedbackPolicy:
+    """Arms the metric tick on a partition and adapts each job's
+    ``tslice_us`` in place. Scheduler-agnostic: any policy that honors
+    ``job.params.tslice_us`` at dispatch (credit does,
+    ``sched_credit.c:1796-1805``) gets adaptive quanta."""
+
+    def __init__(
+        self,
+        partition: "Partition",
+        tick_ns: int = METRIC_TICK_PERIOD_NS,
+        min_us: int = TSLICE_MIN_US,
+        max_us: int = TSLICE_MAX_US,
+        stall_threshold: float = STALL_RATE_THRESHOLD,
+        window: int = WINDOW,
+    ):
+        self.partition = partition
+        self.min_us = min_us
+        self.max_us = max_us
+        self.stall_threshold = stall_threshold
+        self.window_len = window
+        self.states: dict[str, JobMetricState] = {}
+        now = partition.clock.now_ns()
+        self.timer = partition.timers.arm(
+            now + tick_ns, self._metric_tick, period_ns=tick_ns,
+            name="csched_metric_tick",
+        )
+
+    def state_of(self, job: "Job") -> JobMetricState:
+        st = self.states.get(job.name)
+        if st is None:
+            st = self.states[job.name] = JobMetricState()
+        return st
+
+    # -- csched_metric_tick + csched_dom_metric_update -------------------
+
+    def _metric_tick(self, now_ns: int) -> None:
+        for job in self.partition.jobs:
+            self._job_update(job)
+
+    def _job_update(self, job: "Job") -> None:
+        st = self.state_of(job)
+        st.ticks += 1
+        steps = np.uint64(0)
+        dev_ns = np.uint64(0)
+        stall_ns = np.uint64(0)
+        coll_ns = np.uint64(0)
+        for ctx in job.contexts:
+            delta = ctx.counters - ctx.prev_counters
+            ctx.prev_counters = ctx.counters.copy()
+            steps += delta[Counter.STEPS_RETIRED]
+            dev_ns += delta[Counter.DEVICE_TIME_NS]
+            stall_ns += delta[Counter.HBM_STALL_NS]
+            coll_ns += delta[Counter.COLLECTIVE_WAIT_NS]
+        if int(steps) == 0 and int(dev_ns) == 0:
+            return  # job idle this tick — nothing to learn
+        # Rate metrics (csched_dom_metric_update, s_c.c:427-435).
+        if int(dev_ns) > 0:
+            job.stall_rate = float(int(stall_ns)) * 1000.0 / float(int(dev_ns))
+        if int(steps) > 0:
+            job.nspi = float(int(dev_ns)) / float(int(steps))
+        self._submilli_update(job, st, float(int(coll_ns)), int(steps))
+
+    # -- csched_submilli_metric_update (s_c.c:302-389) -------------------
+
+    def _submilli_update(self, job: "Job", st: JobMetricState,
+                         coll_wait_ns: float, steps: int) -> None:
+        # Average contention latency per event this tick
+        # (avg_spinlock = spinlock_metric_update / spinlock_count, :312).
+        # In-band counter waits count one event per step (each step's
+        # collectives are one batched measurement); out-of-band
+        # report_contention carries its own event count. Normalizing per
+        # event keeps the sample invariant to how many steps fit in a
+        # tick — the reference gets this for free by dividing by the
+        # contended-acquisition count.
+        wait_ns, events = job.take_contention()
+        total_wait = coll_wait_ns + wait_ns
+        total_events = max(1, events + (steps if coll_wait_ns > 0 else 0))
+        sample = total_wait / total_events
+
+        st.window.append(sample)
+        if len(st.window) < self.window_len:
+            return
+        if len(st.window) > self.window_len:
+            st.window.pop(0)
+
+        mean = sum(st.window) / len(st.window)
+        if mean > 0:
+            stable = all(
+                STABLE_LO * mean <= s <= STABLE_HI * mean for s in st.window
+            )
+        else:
+            stable = True  # no contention at all is maximally stable
+
+        if stable:
+            if job.stall_rate >= self.stall_threshold:
+                # Memory-bound steady phase: longer quanta amortize
+                # switch cost (SPIN_LOW_PHASE, grow +100 µs, cap).
+                st.phase = LOW_PHASE
+                self._grow(job, st)
+            else:
+                # Compute/latency phase with steady contention: shrink to
+                # bound co-tenant latency (SPIN_HIGH_PHASE).
+                st.phase = HIGH_PHASE
+                self._shrink(job, st)
+        else:
+            # Unstable window: reset; shrink if contention is rising
+            # (s_c.c:374-384).
+            rising = st.window[-1] > mean
+            st.window.clear()
+            st.resets += 1
+            if rising:
+                self._shrink(job, st)
+
+    def _grow(self, job: "Job", st: JobMetricState) -> None:
+        new = min(self.max_us, job.params.tslice_us + GROW_STEP_US)
+        if new != job.params.tslice_us:
+            st.grows += 1
+        job.params.tslice_us = new
+
+    def _shrink(self, job: "Job", st: JobMetricState) -> None:
+        cur = job.params.tslice_us
+        third = cur // 3
+        new = third if third >= self.min_us else cur - SHRINK_SUB_US
+        new = max(self.min_us, new)
+        if new != cur:
+            st.shrinks += 1
+        job.params.tslice_us = new
+
+    # -- observability ---------------------------------------------------
+
+    def dump(self) -> list[dict]:
+        out = []
+        for job in self.partition.jobs:
+            st = self.state_of(job)
+            out.append(
+                {
+                    "job": job.name,
+                    "tslice_us": job.params.tslice_us,
+                    "phase": st.phase,
+                    "stall_rate": round(job.stall_rate, 2),
+                    "nspi": round(job.nspi, 1),
+                    "grows": st.grows,
+                    "shrinks": st.shrinks,
+                    "resets": st.resets,
+                }
+            )
+        return out

@@ -1,0 +1,23 @@
+from pbs_tpu.telemetry.counters import NUM_COUNTERS, Counter, DUMP_EVENTS
+from pbs_tpu.telemetry.ledger import Ledger, SLOT_BYTES, SLOT_WORDS
+from pbs_tpu.telemetry.source import (
+    SimBackend,
+    SimPhase,
+    SimProfile,
+    TelemetrySource,
+    TpuBackend,
+)
+
+__all__ = [
+    "NUM_COUNTERS",
+    "Counter",
+    "DUMP_EVENTS",
+    "Ledger",
+    "SLOT_BYTES",
+    "SLOT_WORDS",
+    "SimBackend",
+    "SimPhase",
+    "SimProfile",
+    "TelemetrySource",
+    "TpuBackend",
+]

@@ -1,0 +1,150 @@
+"""Seqlock-versioned per-job telemetry ledger.
+
+This is the TPU analog of the reference's shared counter-state pages: the
+hypervisor grows ``shared_info`` from 1 to 8 pages (``XSI_SHIFT 15``,
+``xen-4.2.1/xen/include/public/arch-x86/xen.h:32-33``) and keeps one page
+of ``struct perfctr_cpu_state`` per vCPU at
+``shared_info + PAGE_SIZE + vcpu_id*PAGE_SIZE`` (``pmustate.c:102,130,146``);
+the guest maps the same physical pages into userspace
+(``drivers/perfctr/virtual.c:752-779``) and reads counters with **zero
+syscalls/hypercalls** via an rdpmc + start/sum merge, retried under a
+seqlock keyed on ``tsc_start`` (``drivers/perfctr/x86.c:228-312``).
+
+Here the scheduler (writer) publishes each job's counter sums into a flat
+shared buffer; monitors/clients (readers) take lock-free snapshots with
+the same retry contract. The memory layout is fixed little-endian u64 so a
+native C++ writer/reader (``native/pbst_runtime.cc``) and cross-process
+mappings (``multiprocessing.shared_memory``) interoperate with this pure
+Python implementation byte-for-byte.
+
+Slot layout (all u64, SLOT_WORDS words per execution-context slot):
+
+    [0]      version    — seqlock: odd while a write is in progress
+    [1]      tsc_start  — clock at last resume (0 when suspended);
+                          doubles as the "running now" flag the reference
+                          keys its retry loop on
+    [2:20]   sums[18]   — accumulated counter values
+    [20:38]  start[18]  — live-merge base (value at resume); readers add
+                          (current - start) for RUNNING slots if they have
+                          a live source, else consume sums only
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pbs_tpu.telemetry.counters import NUM_COUNTERS
+
+HEADER_WORDS = 2
+SLOT_WORDS = HEADER_WORDS + 2 * NUM_COUNTERS  # 38
+SLOT_BYTES = SLOT_WORDS * 8
+
+_V = 0  # version word
+_T = 1  # tsc_start word
+_SUMS = HEADER_WORDS
+_START = HEADER_WORDS + NUM_COUNTERS
+
+
+class Ledger:
+    """A contiguous array of seqlock counter slots, one per context.
+
+    ``buf`` may be any writable buffer (bytearray, mmap, shared memory);
+    the default allocates process-local memory. Analogous to the 7 vCPU
+    state pages carved out of the enlarged shared_info allocation
+    (``xen-4.2.1/xen/common/domain.c:618-626``).
+    """
+
+    def __init__(self, num_slots: int, buf=None):
+        self.num_slots = num_slots
+        nbytes = num_slots * SLOT_BYTES
+        if buf is None:
+            buf = bytearray(nbytes)
+        mv = memoryview(buf)
+        if mv.nbytes < nbytes:
+            raise ValueError(f"buffer too small: {mv.nbytes} < {nbytes}")
+        self._arr = np.frombuffer(mv, dtype="<u8", count=num_slots * SLOT_WORDS)
+        self._arr = self._arr.reshape(num_slots, SLOT_WORDS)
+
+    # -- writer side (scheduler/executor only) ---------------------------
+
+    def _begin(self, slot: int) -> None:
+        self._arr[slot, _V] += 1  # odd: write in progress
+
+    def _end(self, slot: int) -> None:
+        self._arr[slot, _V] += 1  # even: stable
+
+    def resume(self, slot: int, now_ns: int, live: np.ndarray | None = None) -> None:
+        """Mark slot running; record live-counter base for later merge.
+
+        Analog of ``pmu_restore_regs`` -> ``perfctr_cpu_resume``
+        (``pmustate.c:111-135``): set tsc_start, capture per-counter
+        start values.
+        """
+        self._begin(slot)
+        if live is not None:
+            self._arr[slot, _START:_START + NUM_COUNTERS] = live
+        self._arr[slot, _T] = now_ns
+        self._end(slot)
+
+    def suspend(self, slot: int, deltas: np.ndarray) -> None:
+        """Accumulate deltas and mark slot suspended.
+
+        Analog of ``pmu_save_regs`` -> ``perfctr_cpu_vsuspend``
+        (``pmustate.c:85-109``, ``perfctr.c:1547-1573``): fold the
+        interval's counter deltas into the published sums and clear
+        tsc_start so readers stop live-merging.
+        """
+        self._begin(slot)
+        self._arr[slot, _SUMS:_SUMS + NUM_COUNTERS] += deltas.astype("<u8")
+        self._arr[slot, _T] = 0
+        self._end(slot)
+
+    def add(self, slot: int, counter: int, delta: int) -> None:
+        """Accumulate a single counter without changing run state."""
+        self._begin(slot)
+        self._arr[slot, _SUMS + counter] += np.uint64(delta)
+        self._end(slot)
+
+    def add_many(self, slot: int, deltas: np.ndarray) -> None:
+        self._begin(slot)
+        self._arr[slot, _SUMS:_SUMS + NUM_COUNTERS] += deltas.astype("<u8")
+        self._end(slot)
+
+    def reset(self, slot: int) -> None:
+        """Zero a slot for a fresh context (``pmu_init_vcpu``,
+        ``pmustate.c:138-150``)."""
+        self._begin(slot)
+        self._arr[slot, _T] = 0
+        self._arr[slot, _SUMS:] = 0
+        self._end(slot)
+
+    # -- reader side (lock-free, any process) ----------------------------
+
+    def snapshot(self, slot: int, max_retries: int = 64) -> np.ndarray:
+        """Lock-free consistent read of a slot's counter sums.
+
+        The retry contract of ``drivers/perfctr/x86.c:228-312``: read the
+        version, copy the sums, re-read the version; retry if a write was
+        in progress (odd) or intervened (changed).
+        """
+        for _ in range(max_retries):
+            v0 = int(self._arr[slot, _V])
+            if v0 & 1:
+                continue
+            sums = self._arr[slot, _SUMS:_SUMS + NUM_COUNTERS].copy()
+            v1 = int(self._arr[slot, _V])
+            if v0 == v1:
+                return sums
+        raise RuntimeError(f"ledger slot {slot}: snapshot retries exhausted")
+
+    def is_running(self, slot: int) -> bool:
+        return int(self._arr[slot, _T]) != 0
+
+    def tsc_start(self, slot: int) -> int:
+        return int(self._arr[slot, _T])
+
+    def raw(self) -> np.ndarray:
+        """Whole-buffer view (for checkpoint integration — fixing the
+        reference's gap: perfctr state is NOT in xc_domain_save
+        (SURVEY.md §5 checkpoint caveat))."""
+        return self._arr

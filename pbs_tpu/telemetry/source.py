@@ -1,0 +1,213 @@
+"""Telemetry sources: who fills the per-job counter deltas.
+
+The reference stacks a low-level CPU driver (``perfctr.c``: family detect,
+MSR programming, rdpmc sampling) under a virtualization module
+(``pmustate.c``) that snapshots counters at every context switch. The TPU
+has no public per-tenant PMC file (SURVEY.md §7 "hard parts"), so we keep
+the same seam as a ``TelemetrySource`` protocol with two backends:
+
+- ``SimBackend`` — deterministic, host-only synthetic workloads: the
+  fake-backend pattern of ``tools/tests/x86_emulator`` (compile the policy
+  against mocked hardware and test it as a normal program). Every
+  scheduler/policy test in ``tests/`` runs against this.
+- ``TpuBackend`` — real measurements: step wall time (device-synchronised),
+  XLA cost analysis per compiled executable (FLOPs, HBM bytes), a roofline
+  HBM-stall estimate, and in-graph metrics the job's step function
+  returns to the host (collective wait — the batched ``vcrd_op`` analog,
+  ``sched_credit.c:249-259``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Protocol
+
+import numpy as np
+
+from pbs_tpu.telemetry.counters import NUM_COUNTERS, Counter
+from pbs_tpu.utils.clock import Clock, MonotonicClock, VirtualClock
+
+# Per-chip peaks used by the roofline stall estimator. Defaults are TPU
+# v5e-class; override per deployment. (The reference equivalently bakes
+# in per-family PMU capabilities, asm-x86/perfctr.h:40-65.)
+DEFAULT_PEAK_FLOPS = 197e12  # bf16 FLOP/s
+DEFAULT_PEAK_HBM_BW = 819e9  # bytes/s
+
+
+class TelemetrySource(Protocol):
+    """Executes one quantum of a job's work and reports counter deltas."""
+
+    clock: Clock
+
+    def execute(self, ctx: Any, n_steps: int) -> np.ndarray:
+        """Run ``n_steps`` steps of ``ctx.job`` and return u64 deltas
+        (length NUM_COUNTERS)."""
+        ...
+
+
+# ---------------------------------------------------------------------------
+# Simulation backend
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SimPhase:
+    """One behavioral phase of a synthetic workload.
+
+    Lets tests reproduce the reference's phase transitions: e.g. a guest
+    moving between cache-friendly and cache-thrashing phases, which the
+    windowed filter at ``sched_credit.c:302-389`` must track.
+    """
+
+    steps: int  # phase length in steps (last phase may be -1 = forever)
+    step_time_ns: int = 1_000_000  # device time per step
+    hbm_bytes: int = 1 << 20
+    stall_frac: float = 0.1  # fraction of step time stalled on HBM
+    collective_wait_ns: int = 0  # spin-latency analog per step
+    flops: int = 1 << 30
+    tokens: int = 0
+
+
+@dataclasses.dataclass
+class SimProfile:
+    phases: list[SimPhase]
+
+    def phase_at(self, step: int) -> SimPhase:
+        s = step
+        for ph in self.phases:
+            if ph.steps < 0 or s < ph.steps:
+                return ph
+            s -= ph.steps
+        return self.phases[-1]
+
+    @staticmethod
+    def steady(**kw) -> "SimProfile":
+        return SimProfile([SimPhase(steps=-1, **kw)])
+
+
+class SimBackend:
+    """Deterministic synthetic telemetry; advances a VirtualClock.
+
+    Jobs registered here need no real step function — the backend *is*
+    the device. This is the CPU-CI substrate mandated by SURVEY.md §4.
+    """
+
+    def __init__(self, clock: VirtualClock | None = None):
+        self.clock: VirtualClock = clock or VirtualClock()
+        self._profiles: dict[str, SimProfile] = {}
+        self._steps_done: dict[str, int] = {}
+
+    def register(self, job_name: str, profile: SimProfile) -> None:
+        self._profiles[job_name] = profile
+        self._steps_done[job_name] = 0  # fresh phase schedule per register
+
+    def execute(self, ctx: Any, n_steps: int) -> np.ndarray:
+        name = ctx.job.name
+        prof = self._profiles[name]
+        deltas = np.zeros(NUM_COUNTERS, dtype=np.uint64)
+        for _ in range(n_steps):
+            step = self._steps_done[name]
+            ph = prof.phase_at(step)
+            self.clock.advance(ph.step_time_ns)
+            deltas[Counter.STEPS_RETIRED] += 1
+            deltas[Counter.DEVICE_TIME_NS] += ph.step_time_ns
+            deltas[Counter.HBM_BYTES] += ph.hbm_bytes
+            deltas[Counter.HBM_STALL_NS] += int(ph.step_time_ns * ph.stall_frac)
+            deltas[Counter.COLLECTIVE_WAIT_NS] += ph.collective_wait_ns
+            deltas[Counter.DEVICE_FLOPS] += ph.flops
+            deltas[Counter.TOKENS] += ph.tokens
+            self._steps_done[name] = step + 1
+        return deltas
+
+
+# ---------------------------------------------------------------------------
+# TPU backend
+# ---------------------------------------------------------------------------
+
+
+def cost_analysis_of(compiled) -> tuple[int, int]:
+    """(flops, hbm_bytes) from an XLA compiled executable, best-effort."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = int(ca.get("flops", 0.0))
+        nbytes = int(ca.get("bytes accessed", 0.0))
+        return flops, nbytes
+    except Exception:
+        return 0, 0
+
+
+class TpuBackend:
+    """Measures real jobs: wall time + XLA cost analysis + in-graph metrics.
+
+    A job's ``step_fn(state) -> state`` may instead return
+    ``(state, metrics)`` where ``metrics`` is a dict of scalars; the key
+    ``collective_wait_ns`` feeds the contention channel (batched per step
+    — deliberately NOT per-event, fixing the reference's hypercall storm
+    noted at SURVEY.md §3.5).
+    """
+
+    def __init__(
+        self,
+        clock: Clock | None = None,
+        peak_flops: float = DEFAULT_PEAK_FLOPS,
+        peak_hbm_bw: float = DEFAULT_PEAK_HBM_BW,
+    ):
+        self.clock = clock or MonotonicClock()
+        self.peak_flops = peak_flops
+        self.peak_hbm_bw = peak_hbm_bw
+        # per-job (flops, bytes) from cost analysis, captured at first run
+        self._costs: dict[str, tuple[int, int]] = {}
+
+    def _job_cost(self, job) -> tuple[int, int]:
+        c = self._costs.get(job.name)
+        if c is None:
+            compiled = getattr(job, "compiled", None)
+            c = cost_analysis_of(compiled) if compiled is not None else (0, 0)
+            self._costs[job.name] = c
+        return c
+
+    def _block(self, out) -> None:
+        try:
+            import jax
+
+            jax.block_until_ready(out)
+        except Exception:
+            pass
+
+    def execute(self, ctx: Any, n_steps: int) -> np.ndarray:
+        job = ctx.job
+        deltas = np.zeros(NUM_COUNTERS, dtype=np.uint64)
+        flops, nbytes = self._job_cost(job)
+        for _ in range(n_steps):
+            t0 = time.monotonic_ns()
+            out = job.step_fn(job.state)
+            metrics: dict[str, float] = {}
+            if isinstance(out, tuple) and len(out) == 2 and isinstance(out[1], dict):
+                job.state, metrics = out
+            else:
+                job.state = out
+            self._block(job.state)
+            dt = time.monotonic_ns() - t0
+            deltas[Counter.STEPS_RETIRED] += 1
+            deltas[Counter.DEVICE_TIME_NS] += dt
+            deltas[Counter.HBM_BYTES] += nbytes
+            deltas[Counter.DEVICE_FLOPS] += flops
+            # Roofline stall estimate: fraction of the step the program
+            # was memory-bound. Coarse, but behind the TelemetrySource
+            # seam so fidelity can improve without policy changes.
+            if flops or nbytes:
+                t_mem = nbytes / self.peak_hbm_bw
+                t_flop = flops / self.peak_flops
+                frac = t_mem / (t_mem + t_flop) if (t_mem + t_flop) > 0 else 0.0
+                deltas[Counter.HBM_STALL_NS] += int(dt * frac)
+            for key, ctr in (
+                ("collective_wait_ns", Counter.COLLECTIVE_WAIT_NS),
+                ("gang_skew_ns", Counter.GANG_SKEW_NS),
+                ("tokens", Counter.TOKENS),
+            ):
+                if key in metrics:
+                    deltas[ctr] += np.uint64(max(0, int(metrics[key])))
+        return deltas

@@ -1,0 +1,3 @@
+from pbs_tpu.utils.clock import Clock, MonotonicClock, VirtualClock
+
+__all__ = ["Clock", "MonotonicClock", "VirtualClock"]

@@ -1,0 +1,18 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+All scheduler/policy tests run hardware-free against SimBackend (the
+x86_emulator fake-backend pattern, SURVEY.md §4); JAX-touching tests see
+8 virtual CPU devices so multi-chip sharding compiles and executes
+without TPUs.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,103 @@
+"""Feedback policy tests: the adaptive-quantum research loop.
+
+Reproduces the reference's validation scenarios in simulation
+(BASELINE.md north-star configs 2-3): phase changes in a workload drive
+the time slice between the 100 µs floor and 1.1 ms cap
+(sched_credit.c:286-300), with the 5-sample stability filter
+(sched_credit.c:354-357).
+"""
+
+from pbs_tpu.runtime import Job, Partition, SchedParams
+from pbs_tpu.sched.feedback import (
+    FeedbackPolicy,
+    TSLICE_MAX_US,
+    TSLICE_MIN_US,
+)
+from pbs_tpu.telemetry import SimBackend, SimPhase, SimProfile
+
+
+def setup(profile, tslice_us=500, max_steps=100_000):
+    be = SimBackend()
+    part = Partition("t", source=be, scheduler="credit")
+    fb = FeedbackPolicy(part)
+    be.register("w", profile)
+    job = Job("w", params=SchedParams(tslice_us=tslice_us), max_steps=max_steps)
+    job.contexts[0].avg_step_ns = profile.phases[0].step_time_ns
+    part.add_job(job)
+    return part, fb, job
+
+
+def test_memory_bound_phase_grows_slice():
+    """Stable high HBM-stall phase => slice grows to the cap
+    (SPIN_LOW_PHASE, +100 µs steps)."""
+    prof = SimProfile.steady(
+        step_time_ns=100_000, stall_frac=0.5, collective_wait_ns=1_000
+    )
+    part, fb, job = setup(prof, tslice_us=200)
+    part.run(until_ns=200_000_000)  # 200 simulated ms
+    assert job.params.tslice_us == TSLICE_MAX_US
+    assert fb.state_of(job).grows > 0
+
+
+def test_compute_phase_shrinks_slice():
+    """Stable low-stall phase => slice shrinks to the floor
+    (SPIN_HIGH_PHASE, ÷3 / −200 µs)."""
+    prof = SimProfile.steady(
+        step_time_ns=100_000, stall_frac=0.01, collective_wait_ns=1_000
+    )
+    part, fb, job = setup(prof, tslice_us=900)
+    part.run(until_ns=200_000_000)
+    assert job.params.tslice_us == TSLICE_MIN_US
+    assert fb.state_of(job).shrinks > 0
+
+
+def test_phase_transition_tracks():
+    """Workload switches memory-bound -> compute-bound: slice follows."""
+    prof = SimProfile(
+        [
+            SimPhase(steps=2000, step_time_ns=100_000, stall_frac=0.5,
+                     collective_wait_ns=1_000),
+            SimPhase(steps=-1, step_time_ns=100_000, stall_frac=0.01,
+                     collective_wait_ns=1_000),
+        ]
+    )
+    part, fb, job = setup(prof, tslice_us=400)
+    part.run(until_ns=150_000_000)
+    grew_to = job.params.tslice_us
+    assert grew_to > 400, "slice should grow during memory-bound phase"
+    part.run(until_ns=600_000_000)
+    assert job.params.tslice_us == TSLICE_MIN_US
+
+
+def test_unstable_contention_resets_window():
+    """Oscillating contention breaks the 70-130% stability band =>
+    window resets (sched_credit.c:374-384)."""
+    # Alternate wildly between contention levels every step.
+    phases = []
+    for i in range(50):
+        phases.append(
+            SimPhase(steps=20, step_time_ns=100_000, stall_frac=0.3,
+                     collective_wait_ns=100 if i % 2 == 0 else 1_000_000)
+        )
+    phases.append(SimPhase(steps=-1, step_time_ns=100_000))
+    part, fb, job = setup(SimProfile(phases))
+    part.run(until_ns=100_000_000)
+    assert fb.state_of(job).resets > 0
+
+
+def test_contention_report_channel():
+    """The batched vcrd_op analog feeds the filter."""
+    prof = SimProfile.steady(step_time_ns=100_000, stall_frac=0.5)
+    part, fb, job = setup(prof)
+    job.report_contention(5_000, events=2)
+    assert job.contention_wait_ns == 5_000
+    w, e = job.take_contention()
+    assert (w, e) == (5_000, 2)
+    assert job.contention_wait_ns == 0
+
+
+def test_bounds_respected():
+    prof = SimProfile.steady(step_time_ns=100_000, stall_frac=0.9)
+    part, fb, job = setup(prof, tslice_us=TSLICE_MAX_US)
+    part.run(until_ns=100_000_000)
+    assert TSLICE_MIN_US <= job.params.tslice_us <= TSLICE_MAX_US
