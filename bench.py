@@ -1,0 +1,86 @@
+"""Headline benchmark: flagship transformer training throughput on TPU.
+
+The reference publishes no benchmark numbers (BASELINE.md: none in
+tree), so the headline metric is defined here and tracked round over
+round: steady-state training throughput (tokens/s) of the flagship
+decoder on one chip, with ``vs_baseline`` normalized against a fixed
+roofline-derived bar so improvements are visible across rounds:
+
+    bar = 40% MFU on a 197 TFLOP/s (bf16, v5e) chip
+        = 0.4 * 197e12 / (6 * n_params) tokens/s
+
+Prints exactly ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+PEAK_FLOPS = 197e12  # bf16 peak, TPU v5e
+TARGET_MFU = 0.40
+
+WARMUP_STEPS = 5
+BENCH_STEPS = 20
+BATCH = 8
+SEQ = 1024
+
+
+def main() -> None:
+    from pbs_tpu.models import init_params, make_train_step
+
+    from __graft_entry__ import _flagship_cfg
+
+    cfg = _flagship_cfg()
+    n_params = cfg.num_params()
+    key = jax.random.PRNGKey(0)
+    params = init_params(cfg, key)
+    init_opt, train_step = make_train_step(cfg, learning_rate=3e-4)
+    state = (params, jax.jit(init_opt)(params), 0)
+    step = jax.jit(train_step, donate_argnums=(0,))
+
+    tokens = jax.random.randint(key, (BATCH, SEQ), 0, cfg.vocab, jnp.int32)
+
+    for _ in range(WARMUP_STEPS):
+        state, m = step(state, tokens)
+    float(m["loss"])  # host fetch: hard sync
+
+    t0 = time.perf_counter()
+    for _ in range(BENCH_STEPS):
+        state, m = step(state, tokens)
+    # Sync via host fetch of the last step's loss rather than
+    # block_until_ready: a device-to-host read cannot complete until the
+    # whole dependency chain has executed, independent of any platform
+    # quirk in readiness signaling.
+    final_loss = float(m["loss"])
+    dt = time.perf_counter() - t0
+
+    ntok = BATCH * (SEQ - 1) * BENCH_STEPS
+    tokens_per_s = ntok / dt
+    flops_per_token = 6 * n_params
+    mfu = tokens_per_s * flops_per_token / PEAK_FLOPS
+    bar = TARGET_MFU * PEAK_FLOPS / flops_per_token
+
+    print(
+        json.dumps(
+            {
+                "metric": "flagship_train_throughput",
+                "value": round(tokens_per_s, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(tokens_per_s / bar, 4),
+                "mfu": round(mfu, 4),
+                "n_params": n_params,
+                "step_ms": round(1e3 * dt / BENCH_STEPS, 1),
+                "device": str(jax.devices()[0]),
+                "loss": round(final_loss, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

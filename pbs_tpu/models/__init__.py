@@ -1,0 +1,17 @@
+from pbs_tpu.models.transformer import (
+    TransformerConfig,
+    forward,
+    init_params,
+    make_eval_step,
+    make_train_step,
+    next_token_loss,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "forward",
+    "init_params",
+    "make_eval_step",
+    "make_train_step",
+    "next_token_loss",
+]

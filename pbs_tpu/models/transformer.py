@@ -1,0 +1,258 @@
+"""Flagship workload: a LLaMA-style decoder-only transformer, TPU-first.
+
+The reference contains no ML models (SURVEY.md §0); workloads there are
+guest VMs. In PBS-T the schedulable tenant is a compiled training or
+serving loop, and this transformer is the flagship job the framework
+multiplexes, benchmarks, and checkpoints (the "small transformer train
+loop" of SURVEY.md §7's minimum end-to-end slice).
+
+TPU-first design choices:
+
+- **Pure functional pytrees** (no Module framework): params are nested
+  dicts, steps are jit-compiled pure functions — transforms compose.
+- **bfloat16 compute, fp32 master params**: keeps the MXU fed at its
+  native precision while optimizer math stays stable.
+- **``lax.scan`` over stacked layer params**: one compiled layer body
+  regardless of depth — compile time O(1) in n_layers, XLA still
+  pipelines.
+- **Static shapes everywhere**; causal masking via iota comparison (no
+  dynamic slicing in the hot path).
+- **Sharding by annotation**: forward code is single-device; distribution
+  comes from `jax.sharding` constraints applied at jit boundaries
+  (pbs_tpu.parallel) — mesh axes `dp` (batch), `tp` (heads/ff/vocab),
+  and sequence-parallel residual streams between blocks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 32_000
+    d_model: int = 512
+    n_layers: int = 8
+    n_heads: int = 8
+    n_kv_heads: int = 4  # GQA: kv heads < query heads
+    d_ff: int = 1_408  # ~2.67x d_model, SwiGLU-adjusted
+    max_seq: int = 1_024
+    rope_theta: float = 10_000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16  # compute dtype (MXU-native)
+    # Remat the layer body: trade FLOPs for HBM (jax.checkpoint).
+    remat: bool = False
+    # Attention implementation: "xla" (fused by compiler), "pallas"
+    # (pbs_tpu.ops.attention), "ring" (sequence-parallel ring attention).
+    attn_impl: str = "xla"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def bytes_per_token_step(self) -> int:
+        """Rough HBM traffic per token per training step (params read
+        fwd+bwd+update), for telemetry estimates."""
+        return 6 * self.num_params() // max(1, self.max_seq)
+
+    def num_params(self) -> int:
+        d, f, v = self.d_model, self.d_ff, self.vocab
+        hd = self.head_dim
+        per_layer = (
+            d * (self.n_heads * hd)  # wq
+            + 2 * d * (self.n_kv_heads * hd)  # wk, wv
+            + (self.n_heads * hd) * d  # wo
+            + 3 * d * f  # w1, w3, w2
+            + 2 * d  # norms
+        )
+        return v * d + self.n_layers * per_layer + d + d * v
+
+
+# -- initialization ---------------------------------------------------------
+
+
+def init_params(cfg: TransformerConfig, key: jax.Array) -> dict:
+    """fp32 master params; layer params stacked on axis 0 for scan."""
+    k_emb, k_layers, k_head = jax.random.split(key, 3)
+    d, f = cfg.d_model, cfg.d_ff
+    hd, nh, nkv, L = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads, cfg.n_layers
+
+    def dense(key, shape):
+        fan_in = shape[-2] if len(shape) > 1 else shape[-1]
+        return jax.random.normal(key, shape, jnp.float32) / np.sqrt(fan_in)
+
+    ks = jax.random.split(k_layers, 7)
+    layers = {
+        "attn_norm": jnp.ones((L, d), jnp.float32),
+        "wq": dense(ks[0], (L, d, nh * hd)),
+        "wk": dense(ks[1], (L, d, nkv * hd)),
+        "wv": dense(ks[2], (L, d, nkv * hd)),
+        "wo": dense(ks[3], (L, nh * hd, d)),
+        "mlp_norm": jnp.ones((L, d), jnp.float32),
+        "w1": dense(ks[4], (L, d, f)),  # gate
+        "w3": dense(ks[5], (L, d, f)),  # up
+        "w2": dense(ks[6], (L, f, d)),  # down
+    }
+    return {
+        "embed": dense(k_emb, (cfg.vocab, d)) * np.sqrt(d),  # scaled emb
+        "layers": layers,
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "head": dense(k_head, (d, cfg.vocab)),
+    }
+
+
+# -- building blocks --------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    # Normalize in fp32 for stability, cast back to compute dtype.
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale).astype(x.dtype) * weight.astype(x.dtype)
+
+
+def rope_tables(cfg: TransformerConfig, seq: int) -> tuple[jax.Array, jax.Array]:
+    half = cfg.head_dim // 2
+    freqs = cfg.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    t = jnp.arange(seq, dtype=jnp.float32)
+    ang = jnp.outer(t, freqs)  # (seq, half)
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: (B, S, H, hd). Rotate pairs (even, odd) halves."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    cos = cos[None, :, None, :].astype(x.dtype)
+    sin = sin[None, :, None, :].astype(x.dtype)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+
+
+def causal_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, cfg: TransformerConfig
+) -> jax.Array:
+    """(B, S, H, hd) GQA attention with causal iota mask — left to XLA
+    to fuse; swap for the Pallas kernel via cfg.attn_impl."""
+    if cfg.attn_impl == "pallas":
+        from pbs_tpu.ops.attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
+    B, S, H, hd = q.shape
+    nkv = k.shape[2]
+    group = H // nkv
+    # (B, nkv, group, S, hd) queries against (B, nkv, S, hd) keys.
+    qg = q.reshape(B, S, nkv, group, hd).transpose(0, 2, 3, 1, 4)
+    kt = k.transpose(0, 2, 1, 3)  # (B, nkv, S, hd)
+    vt = v.transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bngqh,bnkh->bngqk", qg, kt) / np.sqrt(hd)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+    scores = jnp.where(cols <= rows, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bngqk,bnkh->bngqh", probs, vt)
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, S, H, hd)
+
+
+def layer_body(cfg: TransformerConfig, x: jax.Array, lp: dict,
+               cos: jax.Array, sin: jax.Array, constrain) -> jax.Array:
+    """One transformer block. ``constrain`` re-applies the activation
+    sharding between ops (sequence-parallel residual stream)."""
+    B, S, _ = x.shape
+    nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    dt = cfg.dtype
+
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"].astype(dt)).reshape(B, S, nh, hd)
+    k = (h @ lp["wk"].astype(dt)).reshape(B, S, nkv, hd)
+    v = (h @ lp["wv"].astype(dt)).reshape(B, S, nkv, hd)
+    q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+    attn = causal_attention(q, k, v, cfg).reshape(B, S, nh * hd)
+    x = constrain(x + attn @ lp["wo"].astype(dt))
+
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(h @ lp["w1"].astype(dt))
+    up = h @ lp["w3"].astype(dt)
+    x = constrain(x + (gate * up) @ lp["w2"].astype(dt))
+    return x
+
+
+# -- forward / loss ---------------------------------------------------------
+
+
+def forward(cfg: TransformerConfig, params: dict, tokens: jax.Array,
+            constrain=lambda x: x) -> jax.Array:
+    """tokens (B, S) int32 -> logits (B, S, vocab) fp32."""
+    B, S = tokens.shape
+    dt = cfg.dtype
+    x = constrain(params["embed"].astype(dt)[tokens])
+    cos, sin = rope_tables(cfg, S)
+
+    def body(x, lp, cos, sin):
+        return layer_body(cfg, x, lp, cos, sin, constrain)
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+
+    def scan_fn(x, lp):
+        return body(x, lp, cos, sin), None
+
+    x, _ = jax.lax.scan(scan_fn, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["head"].astype(dt)).astype(jnp.float32)
+    return logits
+
+
+def next_token_loss(cfg: TransformerConfig, params: dict,
+                    tokens: jax.Array, constrain=lambda x: x) -> jax.Array:
+    """Causal LM loss: predict tokens[:, 1:] from tokens[:, :-1]."""
+    logits = forward(cfg, params, tokens[:, :-1], constrain)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+# -- training step ----------------------------------------------------------
+
+
+def make_train_step(cfg: TransformerConfig, learning_rate: float = 3e-4,
+                    constrain=lambda x: x):
+    """Returns (init_opt_state, train_step). AdamW via optax; donate-safe.
+
+    ``train_step(state, tokens) -> (state, metrics)`` where state is
+    (params, opt_state, step). The metrics dict feeds the TpuBackend
+    telemetry channel (tokens counted for throughput attribution).
+    """
+    import optax
+
+    tx = optax.adamw(learning_rate, b1=0.9, b2=0.95, weight_decay=0.1)
+
+    def init_opt_state(params):
+        return tx.init(params)
+
+    def train_step(state, tokens):
+        params, opt_state, step = state
+        loss, grads = jax.value_and_grad(
+            lambda p: next_token_loss(cfg, p, tokens, constrain)
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        ntok = tokens.shape[0] * (tokens.shape[1] - 1)
+        metrics = {"loss": loss, "tokens": jnp.asarray(ntok, jnp.int32)}
+        return (params, opt_state, step + 1), metrics
+
+    return init_opt_state, train_step
+
+
+def make_eval_step(cfg: TransformerConfig, constrain=lambda x: x):
+    def eval_step(params, tokens):
+        return next_token_loss(cfg, params, tokens, constrain)
+
+    return eval_step

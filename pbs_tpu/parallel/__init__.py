@@ -1,0 +1,18 @@
+from pbs_tpu.parallel.mesh import make_mesh, split_devices
+from pbs_tpu.parallel.sharding import (
+    activation_constrainer,
+    batch_sharding,
+    make_sharded_train,
+    param_specs,
+    shard_params,
+)
+
+__all__ = [
+    "make_mesh",
+    "split_devices",
+    "activation_constrainer",
+    "batch_sharding",
+    "make_sharded_train",
+    "param_specs",
+    "shard_params",
+]

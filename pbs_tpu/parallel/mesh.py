@@ -1,0 +1,66 @@
+"""Device meshes and slice partitions.
+
+The reference multiplexes vCPUs over pCPUs and hard-partitions pCPUs
+into cpupools (``xen/common/cpupool.c``). The TPU analog (SURVEY.md §7):
+jobs run SPMD programs over a ``jax.sharding.Mesh``; partitions own
+disjoint device sets ("slice partitions") each with its own scheduler
+instance. Mesh axes follow the scaling-book convention:
+
+- ``dp`` — data parallel (batch sharding, gradient psum rides ICI)
+- ``tp`` — tensor parallel (heads/ff/vocab sharding + sequence-parallel
+  residual streams between blocks)
+- ``pp`` — pipeline stages (shard_map + ppermute microbatching)
+- ``ep`` — expert parallel (MoE all-to-all)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    axes: dict[str, int] | None = None,
+    devices: Sequence | None = None,
+) -> Mesh:
+    """Build a Mesh from an {axis: size} dict (row-major over devices).
+
+    With ``axes=None`` the full device set becomes a 1-D ``dp`` mesh.
+    Axis sizes of -1 are inferred from the device count (at most one).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if axes is None:
+        axes = {"dp": n}
+    names = list(axes)
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis size may be -1")
+    if -1 in sizes:
+        known = math.prod(s for s in sizes if s != -1)
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    if math.prod(sizes) != n:
+        raise ValueError(
+            f"mesh {dict(zip(names, sizes))} needs {math.prod(sizes)} "
+            f"devices, have {n}"
+        )
+    arr = np.array(devices).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def split_devices(n_partitions: int, devices: Sequence | None = None):
+    """Partition the device set into equal contiguous pools (cpupool
+    analog: contiguous so intra-pool collectives stay on neighboring
+    ICI links)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n % n_partitions:
+        raise ValueError(f"{n} devices not divisible into {n_partitions} pools")
+    per = n // n_partitions
+    return [devices[i * per:(i + 1) * per] for i in range(n_partitions)]

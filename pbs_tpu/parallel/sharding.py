@@ -1,0 +1,108 @@
+"""Sharding rules for the flagship transformer: dp + tp + sp by annotation.
+
+The scaling-book recipe (SURVEY.md directive): pick a mesh, annotate
+parameter and activation shardings, let XLA insert the collectives —
+psum for dp gradient reduction, all-gathers around tp matmuls,
+reduce-scatters for the sequence-parallel residual stream. No hand-rolled
+NCCL analog exists or is needed; ICI collectives are compiled.
+
+Layout (Megatron-style, re-derived for annotation form):
+
+- embed (V, d)        -> P('tp', None)      vocab-sharded lookup
+- wq/wk/wv (L, d, H)  -> P(None, None, 'tp') column-parallel
+- wo (L, H, d)        -> P(None, 'tp', None) row-parallel
+- w1/w3 (L, d, F)     -> P(None, None, 'tp') column-parallel
+- w2 (L, F, d)        -> P(None, 'tp', None) row-parallel
+- head (d, V)         -> P(None, 'tp')      vocab-sharded logits
+- norms               -> replicated
+- tokens (B, S)       -> P('dp', None)
+- residual (B, S, d)  -> P('dp', 'tp', None): batch over dp, *sequence
+  over tp* between blocks — sequence parallelism for the elementwise/
+  norm regions, gathered by XLA where attention needs full sequence.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pbs_tpu.models.transformer import TransformerConfig, make_train_step
+
+
+def param_specs(cfg: TransformerConfig) -> dict:
+    return {
+        "embed": P("tp", None),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "mlp_norm": P(None, None),
+            "w1": P(None, None, "tp"),
+            "w3": P(None, None, "tp"),
+            "w2": P(None, "tp", None),
+        },
+        "final_norm": P(None),
+        "head": P(None, "tp"),
+    }
+
+
+def _named(mesh: Mesh, tree):
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_params(params: dict, mesh: Mesh, cfg: TransformerConfig) -> dict:
+    shardings = _named(mesh, param_specs(cfg))
+    return jax.tree.map(jax.device_put, params, shardings)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("dp", None))
+
+
+def activation_constrainer(mesh: Mesh | None):
+    """Returns the ``constrain`` fn threaded through the model: pins the
+    residual stream to P('dp','tp',None) — the sequence-parallel layout."""
+    if mesh is None or "tp" not in mesh.axis_names:
+        return lambda x: x
+    spec = NamedSharding(mesh, P("dp", "tp", None))
+
+    def constrain(x):
+        if x.ndim == 3:
+            return jax.lax.with_sharding_constraint(x, spec)
+        return x
+
+    return constrain
+
+
+def make_sharded_train(
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    learning_rate: float = 3e-4,
+    key: jax.Array | None = None,
+):
+    """Build fully-sharded (params, opt_state, step) + jitted train step.
+
+    Opt-state shardings are not spelled out: XLA sharding propagation
+    derives mu/nu layouts from the sharded params flowing into the
+    jitted init — the annotation-driven recipe end to end.
+    """
+    from pbs_tpu.models.transformer import init_params
+
+    key = key if key is not None else jax.random.PRNGKey(0)
+    constrain = activation_constrainer(mesh)
+    init_opt, train_step = make_train_step(cfg, learning_rate, constrain)
+
+    # NamedSharding carries its mesh: no ambient mesh context needed.
+    params = shard_params(init_params(cfg, key), mesh, cfg)
+    opt_state = jax.jit(init_opt)(params)
+    state = (params, opt_state, jax.device_put(0))
+    step = jax.jit(train_step, donate_argnums=(0,))
+    return state, step

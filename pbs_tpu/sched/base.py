@@ -115,6 +115,8 @@ class Scheduler(abc.ABC):
         """The research-added ops hook (sched-if.h:186): per-context
         counter/sched_count dump behind the 'z' console key
         (csched_dump_customized, sched_credit.c:1944-1977)."""
+        from pbs_tpu.telemetry.counters import DUMP_EVENTS
+
         out = []
         for job in self.partition.jobs:
             for ctx in job.contexts:
@@ -123,10 +125,7 @@ class Scheduler(abc.ABC):
                         "ctx": ctx.name,
                         "sched_count": ctx.sched_count,
                         "counters": {
-                            "STEPS_RETIRED": int(ctx.counters[0]),
-                            "DEVICE_TIME_NS": int(ctx.counters[1]),
-                            "HBM_BYTES": int(ctx.counters[2]),
-                            "HBM_STALL_NS": int(ctx.counters[3]),
+                            c.name: int(ctx.counters[c]) for c in DUMP_EVENTS
                         },
                     }
                 )

@@ -119,10 +119,11 @@ class CreditScheduler(Scheduler):
         q.insert(i, ctx)
 
     def _runq_remove(self, ctx) -> None:
-        for q in self.runqs:
-            if ctx in q:
-                q.remove(ctx)
-                return
+        # Invariant: a queued ctx lives only in runqs[cc.executor]
+        # (_runq_insert always records the assignment).
+        q = self.runqs[self._cc(ctx).executor]
+        if ctx in q:
+            q.remove(ctx)
 
     # -- lifecycle -------------------------------------------------------
 
@@ -152,7 +153,7 @@ class CreditScheduler(Scheduler):
 
     def wake(self, ctx) -> None:
         cc = self._cc(ctx)
-        if any(ctx in q for q in self.runqs):
+        if ctx in self.runqs[cc.executor]:
             return
         if cc.parked:
             return  # stays parked until acct unparks (cap)
@@ -195,12 +196,6 @@ class CreditScheduler(Scheduler):
         return Decision(ctx, ctx.job.params.tslice_us * US)
 
     def _pick_local(self, q):
-        for ctx in q:
-            cc = self._cc(ctx)
-            if cc.yielding and len(q) > 1:
-                continue
-            return ctx
-        # Only yielding contexts left: take the first anyway.
         return q[0] if q else None
 
     def _steal(self, exi: int, better_than: int):
@@ -228,7 +223,6 @@ class CreditScheduler(Scheduler):
         cc.credit -= ran_us
         cj.spent_us += ran_us
         cj.active = True
-        cc.yielding = False
         if cc.pri == PRI_BOOST:
             cc.pri = PRI_UNDER  # boost expires after one quantum
         if cc.credit < 0:
@@ -241,7 +235,15 @@ class CreditScheduler(Scheduler):
             ctx.state = ContextState.PARKED
             return
         if ctx.runnable():
-            self._runq_insert(ex.index, ctx)
+            if cc.yielding:
+                # CSCHED_FLAG_VCPU_YIELD consumed here: a mid-quantum
+                # yield reinserts the yielder at the very tail, behind
+                # every priority class, exactly once.
+                cc.yielding = False
+                cc.executor = ex.index
+                self.runqs[ex.index].append(ctx)
+            else:
+                self._runq_insert(ex.index, ctx)
 
     # -- accounting (csched_acct, sched_credit.c:1330-1519) --------------
 

@@ -83,7 +83,9 @@ class Ledger:
         self._begin(slot)
         if live is not None:
             self._arr[slot, _START:_START + NUM_COUNTERS] = live
-        self._arr[slot, _T] = now_ns
+        # tsc_start doubles as the running flag; a clock legitimately
+        # reading 0 (VirtualClock at t=0) must still read as running.
+        self._arr[slot, _T] = now_ns or 1
         self._end(slot)
 
     def suspend(self, slot: int, deltas: np.ndarray) -> None:

@@ -4,15 +4,24 @@ All scheduler/policy tests run hardware-free against SimBackend (the
 x86_emulator fake-backend pattern, SURVEY.md §4); JAX-touching tests see
 8 virtual CPU devices so multi-chip sharding compiles and executes
 without TPUs.
+
+The ambient session may have a real-TPU plugin registered from
+``sitecustomize`` at interpreter boot (before this file runs), so setting
+``JAX_PLATFORMS`` here can be too late; ``jax.config.update`` wins as
+long as no backend has been initialized yet — which is why this must be
+the first JAX touch in the test process.
 """
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -161,3 +161,25 @@ def test_capped_solo_job_sustains_progress():
     part.run(until_ns=60_000_000_000)  # 60 simulated seconds
     # 10% cap over 60 s at 10 ms/step ~ 600 steps; require steady progress.
     assert capped.steps_retired() > 200
+
+
+def test_yield_deprioritizes_once():
+    """yield_() during a quantum puts the yielder behind a peer for
+    exactly one pick (CSCHED_FLAG_VCPU_YIELD semantics)."""
+    part, be = make_partition()
+    a = add_sim_job(part, be, "ya", max_steps=1_000)
+    b = add_sim_job(part, be, "yb", max_steps=1_000)
+    sched = part.scheduler
+    # Dispatch 'a', then yield it mid-quantum.
+    d = sched.do_schedule(part.executors[0], part.clock.now_ns())
+    first = d.ctx
+    other = b.contexts[0] if first is a.contexts[0] else a.contexts[0]
+    sched.yield_(first)
+    part.executors[0]._run(first, d.quantum_ns)
+    # Next pick must be the peer, not the yielder.
+    d2 = sched.do_schedule(part.executors[0], part.clock.now_ns())
+    assert d2.ctx is other
+    part.executors[0]._run(d2.ctx, d2.quantum_ns)
+    # Flag consumed: yielder runs again afterwards.
+    d3 = sched.do_schedule(part.executors[0], part.clock.now_ns())
+    assert d3.ctx is first
