@@ -1,0 +1,192 @@
+// pbst_runtime: native hot-path primitives for PBS-T.
+//
+// The reference keeps its hot paths in C inside the hypervisor: the
+// seqlock counter-state pages read by guests with zero
+// syscalls/hypercalls (linux-3.2.30/drivers/perfctr/x86.c:228-312) and
+// the lockless per-CPU trace rings drained by dom0
+// (xen-4.2.1/xen/common/trace.c). This library provides the same two
+// primitives over caller-provided shared memory so multi-process
+// monitors read telemetry without locks or RPCs. Byte-compatible with
+// the pure-Python implementations (pbs_tpu/telemetry/ledger.py,
+// pbs_tpu/obs/trace.py), which remain as fallbacks.
+//
+// Build: make -C native    (g++ -O2 -shared -fPIC, no dependencies)
+// Bind:  ctypes (pbs_tpu/runtime/native.py). No pybind11 by design —
+// the ABI is a handful of flat functions over uint64 buffers.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Seqlock ledger.
+//
+// Slot layout (u64 words): [0] version  [1] tsc_start
+//                          [2..19] sums[18]  [20..37] start[18]
+// ---------------------------------------------------------------------------
+
+static const int kNumCounters = 18;
+static const int kHeaderWords = 2;
+static const int kSlotWords = kHeaderWords + 2 * kNumCounters;  // 38
+
+static inline uint64_t* slot_ptr(uint64_t* buf, int64_t slot) {
+  return buf + slot * kSlotWords;
+}
+
+static inline void write_begin(uint64_t* s) {
+  uint64_t v = __atomic_load_n(&s[0], __ATOMIC_RELAXED);
+  __atomic_store_n(&s[0], v + 1, __ATOMIC_RELEASE);  // odd: writing
+  __atomic_thread_fence(__ATOMIC_RELEASE);
+}
+
+static inline void write_end(uint64_t* s) {
+  __atomic_thread_fence(__ATOMIC_RELEASE);
+  uint64_t v = __atomic_load_n(&s[0], __ATOMIC_RELAXED);
+  __atomic_store_n(&s[0], v + 1, __ATOMIC_RELEASE);  // even: stable
+}
+
+int pbst_ledger_slot_words() { return kSlotWords; }
+
+void pbst_ledger_reset(uint64_t* buf, int64_t slot) {
+  uint64_t* s = slot_ptr(buf, slot);
+  write_begin(s);
+  std::memset(&s[1], 0, (kSlotWords - 1) * sizeof(uint64_t));
+  write_end(s);
+}
+
+// Mark running (pmu_restore_regs analog). now_ns==0 is promoted to 1:
+// tsc_start doubles as the running flag.
+void pbst_ledger_resume(uint64_t* buf, int64_t slot, uint64_t now_ns,
+                        const uint64_t* live_or_null) {
+  uint64_t* s = slot_ptr(buf, slot);
+  write_begin(s);
+  if (live_or_null != nullptr) {
+    std::memcpy(&s[kHeaderWords + kNumCounters], live_or_null,
+                kNumCounters * sizeof(uint64_t));
+  }
+  s[1] = now_ns ? now_ns : 1;
+  write_end(s);
+}
+
+// Fold deltas into sums, mark suspended (pmu_save_regs /
+// perfctr_cpu_vsuspend analog).
+void pbst_ledger_suspend(uint64_t* buf, int64_t slot,
+                         const uint64_t* deltas) {
+  uint64_t* s = slot_ptr(buf, slot);
+  write_begin(s);
+  for (int i = 0; i < kNumCounters; i++) s[kHeaderWords + i] += deltas[i];
+  s[1] = 0;
+  write_end(s);
+}
+
+void pbst_ledger_add(uint64_t* buf, int64_t slot, int counter,
+                     uint64_t delta) {
+  uint64_t* s = slot_ptr(buf, slot);
+  write_begin(s);
+  s[kHeaderWords + counter] += delta;
+  write_end(s);
+}
+
+void pbst_ledger_add_many(uint64_t* buf, int64_t slot,
+                          const uint64_t* deltas) {
+  uint64_t* s = slot_ptr(buf, slot);
+  write_begin(s);
+  for (int i = 0; i < kNumCounters; i++) s[kHeaderWords + i] += deltas[i];
+  write_end(s);
+}
+
+// Lock-free consistent snapshot of sums[]. Returns the number of
+// retries used, or -1 if max_retries were exhausted. The retry
+// contract of drivers/perfctr/x86.c:228-312.
+int pbst_ledger_snapshot(const uint64_t* buf, int64_t slot, uint64_t* out,
+                         int max_retries) {
+  const uint64_t* s = buf + slot * kSlotWords;
+  for (int attempt = 0; attempt < max_retries; attempt++) {
+    uint64_t v0 = __atomic_load_n(&s[0], __ATOMIC_ACQUIRE);
+    if (v0 & 1) continue;
+    __atomic_thread_fence(__ATOMIC_ACQUIRE);
+    uint64_t tmp[kNumCounters];
+    std::memcpy(tmp, &s[kHeaderWords], sizeof(tmp));
+    __atomic_thread_fence(__ATOMIC_ACQUIRE);
+    uint64_t v1 = __atomic_load_n(&s[0], __ATOMIC_ACQUIRE);
+    if (v0 == v1) {
+      std::memcpy(out, tmp, sizeof(tmp));
+      return attempt;
+    }
+  }
+  return -1;
+}
+
+uint64_t pbst_ledger_tsc_start(const uint64_t* buf, int64_t slot) {
+  return __atomic_load_n(&(buf + slot * kSlotWords)[1], __ATOMIC_ACQUIRE);
+}
+
+// ---------------------------------------------------------------------------
+// Lockless SPSC trace ring (xen/common/trace.c analog).
+//
+// Header (u64): [0] head (total records written)  [1] tail (consumed)
+//               [2] capacity (records)            [3] lost
+// Records: 8 u64 each: [timestamp_ns, event_id, a0..a5].
+// Producer: the executor thread. Consumer: any monitor process mapping
+// the same buffer (xentrace analog). head/tail are monotonic; index =
+// value % capacity.
+// ---------------------------------------------------------------------------
+
+static const int kTraceHeaderWords = 4;
+static const int kTraceRecWords = 8;
+
+int pbst_trace_rec_words() { return kTraceRecWords; }
+int pbst_trace_header_words() { return kTraceHeaderWords; }
+
+void pbst_trace_init(uint64_t* buf, uint64_t capacity) {
+  buf[0] = 0;
+  buf[1] = 0;
+  buf[2] = capacity;
+  buf[3] = 0;
+}
+
+// Returns 1 if recorded, 0 if dropped (ring full -> lost++, matching
+// trace.c's "lost records" accounting rather than blocking).
+int pbst_trace_emit(uint64_t* buf, uint64_t ts_ns, uint64_t event,
+                    uint64_t a0, uint64_t a1, uint64_t a2, uint64_t a3,
+                    uint64_t a4, uint64_t a5) {
+  uint64_t cap = buf[2];
+  uint64_t head = __atomic_load_n(&buf[0], __ATOMIC_RELAXED);
+  uint64_t tail = __atomic_load_n(&buf[1], __ATOMIC_ACQUIRE);
+  if (head - tail >= cap) {
+    __atomic_fetch_add(&buf[3], 1, __ATOMIC_RELAXED);
+    return 0;
+  }
+  uint64_t* rec = buf + kTraceHeaderWords + (head % cap) * kTraceRecWords;
+  rec[0] = ts_ns;
+  rec[1] = event;
+  rec[2] = a0; rec[3] = a1; rec[4] = a2;
+  rec[5] = a3; rec[6] = a4; rec[7] = a5;
+  __atomic_store_n(&buf[0], head + 1, __ATOMIC_RELEASE);
+  return 1;
+}
+
+// Consume up to max_records into out (flat u64 array). Returns count.
+int pbst_trace_consume(uint64_t* buf, uint64_t* out, int max_records) {
+  uint64_t cap = buf[2];
+  uint64_t tail = __atomic_load_n(&buf[1], __ATOMIC_RELAXED);
+  uint64_t head = __atomic_load_n(&buf[0], __ATOMIC_ACQUIRE);
+  int n = 0;
+  while (tail < head && n < max_records) {
+    const uint64_t* rec =
+        buf + kTraceHeaderWords + (tail % cap) * kTraceRecWords;
+    std::memcpy(out + n * kTraceRecWords, rec,
+                kTraceRecWords * sizeof(uint64_t));
+    tail++;
+    n++;
+  }
+  __atomic_store_n(&buf[1], tail, __ATOMIC_RELEASE);
+  return n;
+}
+
+uint64_t pbst_trace_lost(const uint64_t* buf) {
+  return __atomic_load_n(&buf[3], __ATOMIC_RELAXED);
+}
+
+}  // extern "C"

@@ -1,0 +1,4 @@
+from pbs_tpu.obs.perfc import Perfc, perfc
+from pbs_tpu.obs.trace import Ev, TraceBuffer, format_records
+
+__all__ = ["Ev", "Perfc", "TraceBuffer", "format_records", "perfc"]

@@ -1,0 +1,141 @@
+"""Trace buffers: lockless event rings + taxonomy (xentrace analog).
+
+Reference: per-CPU lockless trace rings in xen-heap pages
+(``xen-4.2.1/xen/common/trace.c:53-120``, producers behind
+``tb_init_done``), a structured event taxonomy (``TRC_SCHED_*`` etc.,
+``xen/include/public/trace.h:35-74``), drained by the ``xentrace`` CLI
+and post-processed by ``xentrace_format``; ``xenbaked``/``xenmon``
+digest scheduler events into per-domain histories.
+
+Here: one ring per executor over a flat u64 buffer (native SPSC ring in
+``native/pbst_runtime.cc`` when available, Python fallback otherwise),
+records of (timestamp, event, 6 args), a lost-record counter instead of
+blocking, and host-side formatting/digestion in ``pbs_tpu.cli``.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+TRACE_HEADER_WORDS = 4
+TRACE_REC_WORDS = 8
+
+
+class Ev(enum.IntEnum):
+    """Event taxonomy (TRC_* analog, public/trace.h:35-74). The top
+    byte is the subsystem class, like TRC_SCHED/TRC_MEM/..."""
+
+    # scheduler class (0x01xx)
+    SCHED_PICK = 0x0101  # args: ctx_slot, quantum_ns
+    SCHED_DESCHED = 0x0102  # args: ctx_slot, ran_ns, credit_mu
+    SCHED_WAKE = 0x0103  # args: ctx_slot, boosted
+    SCHED_SLEEP = 0x0104  # args: ctx_slot
+    SCHED_STEAL = 0x0105  # args: ctx_slot, from_ex, to_ex
+    SCHED_PARK = 0x0106  # args: ctx_slot
+    SCHED_UNPARK = 0x0107  # args: ctx_slot
+    SCHED_ACCT = 0x0108  # args: acct_count, weight_total
+    # feedback class (0x02xx)
+    FB_TICK = 0x0201  # args: job_slot, stall_rate_x1000, tslice_us
+    FB_GROW = 0x0202  # args: job_slot, new_tslice_us
+    FB_SHRINK = 0x0203  # args: job_slot, new_tslice_us
+    FB_RESET = 0x0204  # args: job_slot
+    # job lifecycle (0x03xx)
+    JOB_ADD = 0x0301  # args: job_slot, n_contexts, weight
+    JOB_REMOVE = 0x0302
+    JOB_DONE = 0x0303
+    # checkpoint (0x04xx)
+    CKPT_BEGIN = 0x0401  # args: job_slot, step
+    CKPT_END = 0x0402  # args: job_slot, bytes, dur_ns
+    # contention channel (0x05xx) — the vcrd_op analog
+    CONTENTION = 0x0501  # args: job_slot, wait_ns, events
+
+
+class TraceBuffer:
+    """One SPSC ring. Producer: an executor. Consumer: a monitor."""
+
+    def __init__(self, capacity: int = 4096, buf=None, native: bool | None = None):
+        self.capacity = capacity
+        nwords = TRACE_HEADER_WORDS + capacity * TRACE_REC_WORDS
+        if buf is None:
+            buf = bytearray(nwords * 8)
+        self._arr = np.frombuffer(memoryview(buf), dtype="<u8", count=nwords)
+        self._nat = None
+        self._ptr = None
+        if native is not False:
+            from pbs_tpu.runtime import native as native_mod
+
+            lib = native_mod.load()
+            if lib is not None:
+                self._nat = lib
+                self._ptr = native_mod.as_u64p(self._arr)
+            elif native is True:
+                raise RuntimeError("native runtime requested but unavailable")
+        if self._nat is not None:
+            self._nat.pbst_trace_init(self._ptr, capacity)
+        else:
+            self._arr[0] = 0
+            self._arr[1] = 0
+            self._arr[2] = capacity
+            self._arr[3] = 0
+
+    # -- producer --------------------------------------------------------
+
+    def emit(self, ts_ns: int, event: int, *args: int) -> bool:
+        a = list(args)[:6] + [0] * (6 - min(6, len(args)))
+        if self._nat is not None:
+            return bool(
+                self._nat.pbst_trace_emit(
+                    self._ptr, ts_ns, int(event), *[int(x) & (2**64 - 1) for x in a]
+                )
+            )
+        head, tail, cap = int(self._arr[0]), int(self._arr[1]), self.capacity
+        if head - tail >= cap:
+            self._arr[3] += np.uint64(1)
+            return False
+        off = TRACE_HEADER_WORDS + (head % cap) * TRACE_REC_WORDS
+        rec = [ts_ns, int(event)] + [int(x) & (2**64 - 1) for x in a]
+        self._arr[off:off + TRACE_REC_WORDS] = np.array(rec, dtype="<u8")
+        self._arr[0] = np.uint64(head + 1)
+        return True
+
+    # -- consumer --------------------------------------------------------
+
+    def consume(self, max_records: int = 1024) -> np.ndarray:
+        """(n, 8) u64 array of drained records."""
+        if self._nat is not None:
+            from pbs_tpu.runtime import native as native_mod
+
+            out = np.empty(max_records * TRACE_REC_WORDS, dtype="<u8")
+            n = self._nat.pbst_trace_consume(
+                self._ptr, native_mod.as_u64p(out), max_records)
+            return out[: n * TRACE_REC_WORDS].reshape(n, TRACE_REC_WORDS)
+        head, tail, cap = int(self._arr[0]), int(self._arr[1]), self.capacity
+        n = min(head - tail, max_records)
+        recs = np.empty((n, TRACE_REC_WORDS), dtype="<u8")
+        for i in range(n):
+            off = TRACE_HEADER_WORDS + ((tail + i) % cap) * TRACE_REC_WORDS
+            recs[i] = self._arr[off:off + TRACE_REC_WORDS]
+        self._arr[1] = np.uint64(tail + n)
+        return recs
+
+    @property
+    def lost(self) -> int:
+        if self._nat is not None:
+            return int(self._nat.pbst_trace_lost(self._ptr))
+        return int(self._arr[3])
+
+
+def format_records(recs: np.ndarray) -> list[str]:
+    """xentrace_format analog: human-readable lines."""
+    out = []
+    for r in recs:
+        ts, ev = int(r[0]), int(r[1])
+        try:
+            name = Ev(ev).name
+        except ValueError:
+            name = f"0x{ev:04x}"
+        args = " ".join(str(int(x)) for x in r[2:])
+        out.append(f"[{ts / 1e9:.6f}] {name} {args}")
+    return out

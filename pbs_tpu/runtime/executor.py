@@ -20,6 +20,7 @@ from typing import TYPE_CHECKING
 
 import numpy as np
 
+from pbs_tpu.obs.trace import Ev
 from pbs_tpu.runtime.job import ContextState, ExecutionContext
 from pbs_tpu.telemetry.counters import Counter
 
@@ -82,6 +83,7 @@ class Executor:
         ctx.sched_count += 1
         if ctx.ledger_slot >= 0:
             part.ledger.resume(ctx.ledger_slot, now)
+        part.trace_emit(self.index, Ev.SCHED_PICK, ctx.ledger_slot, quantum_ns)
 
         n_steps = quantum_to_steps(quantum_ns, ctx.avg_step_ns)
         if ctx.job.max_steps is not None:
@@ -101,6 +103,7 @@ class Executor:
         self.current = None
 
         end = part.clock.now_ns()
+        part.trace_emit(self.index, Ev.SCHED_DESCHED, ctx.ledger_slot, ran_ns)
         part.timers.fire_due(end)
         part.scheduler.descheduled(self, ctx, ran_ns, end)
 

@@ -1,0 +1,90 @@
+"""ctypes bindings for the native runtime (native/pbst_runtime.cc).
+
+The reference's hot paths are C compiled into the hypervisor/guest
+kernel; ours is a small C++ shared library over flat u64 buffers —
+seqlock ledger writes/snapshots and the lockless trace ring — bound via
+ctypes (no pybind11 in this image; the ABI is flat by design). The
+library is built on demand with the in-tree Makefile and cached;
+everything degrades to the pure-Python implementations when a toolchain
+is unavailable, so nothing upstack depends on native availability.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libpbst_runtime.so"))
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+_U64P = ctypes.POINTER(ctypes.c_uint64)
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    lib.pbst_ledger_slot_words.restype = ctypes.c_int
+    lib.pbst_ledger_reset.argtypes = [_U64P, ctypes.c_int64]
+    lib.pbst_ledger_resume.argtypes = [
+        _U64P, ctypes.c_int64, ctypes.c_uint64, _U64P]
+    lib.pbst_ledger_suspend.argtypes = [_U64P, ctypes.c_int64, _U64P]
+    lib.pbst_ledger_add.argtypes = [
+        _U64P, ctypes.c_int64, ctypes.c_int, ctypes.c_uint64]
+    lib.pbst_ledger_add_many.argtypes = [_U64P, ctypes.c_int64, _U64P]
+    lib.pbst_ledger_snapshot.argtypes = [
+        _U64P, ctypes.c_int64, _U64P, ctypes.c_int]
+    lib.pbst_ledger_snapshot.restype = ctypes.c_int
+    lib.pbst_ledger_tsc_start.argtypes = [_U64P, ctypes.c_int64]
+    lib.pbst_ledger_tsc_start.restype = ctypes.c_uint64
+    lib.pbst_trace_init.argtypes = [_U64P, ctypes.c_uint64]
+    lib.pbst_trace_emit.argtypes = [_U64P] + [ctypes.c_uint64] * 8
+    lib.pbst_trace_emit.restype = ctypes.c_int
+    lib.pbst_trace_consume.argtypes = [_U64P, _U64P, ctypes.c_int]
+    lib.pbst_trace_consume.restype = ctypes.c_int
+    lib.pbst_trace_lost.argtypes = [_U64P]
+    lib.pbst_trace_lost.restype = ctypes.c_uint64
+
+
+def load() -> ctypes.CDLL | None:
+    """The native library, building it on first use; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            _declare(lib)
+            _lib = lib
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def as_u64p(arr: np.ndarray):
+    """uint64 pointer into a (C-contiguous) numpy array's buffer."""
+    assert arr.dtype == np.uint64 and arr.flags["C_CONTIGUOUS"]
+    return arr.ctypes.data_as(_U64P)

@@ -17,6 +17,9 @@ from __future__ import annotations
 
 from typing import Any, Callable
 
+import numpy as np
+
+from pbs_tpu.obs.trace import TraceBuffer
 from pbs_tpu.runtime.executor import Executor
 from pbs_tpu.runtime.job import ContextState, Job, SchedParams
 from pbs_tpu.runtime.timer import TimerWheel
@@ -45,6 +48,8 @@ class Partition:
         self.clock = clock if clock is not None else source.clock
         self.timers = TimerWheel()
         self.ledger = Ledger(ledger_slots)
+        # Per-executor lockless trace rings (per-CPU rings, trace.c).
+        self.traces: list[TraceBuffer] = []
         self._free_slots = list(range(ledger_slots - 1, -1, -1))
         self.jobs: list[Job] = []
         self.executors: list[Executor] = []
@@ -55,6 +60,7 @@ class Partition:
         for i, dev in enumerate(devices):
             ex = Executor(self, i, device=dev)
             self.executors.append(ex)
+            self.traces.append(TraceBuffer())
             self.scheduler.executor_added(ex)
 
     # -- admission (domain_create analog, xen/common/domain.c) -----------
@@ -166,6 +172,19 @@ class Partition:
         return quanta
 
     # -- observability ---------------------------------------------------
+
+    def trace_emit(self, exi: int, event: int, *args: int) -> None:
+        if 0 <= exi < len(self.traces):
+            self.traces[exi].emit(self.clock.now_ns(), event, *args)
+
+    def drain_traces(self, max_records: int = 4096):
+        """xentrace analog: drain all rings, merged and time-sorted."""
+        chunks = [t.consume(max_records) for t in self.traces]
+        chunks = [c for c in chunks if len(c)]
+        if not chunks:
+            return np.empty((0, 8), dtype="<u8")
+        allr = np.concatenate(chunks, axis=0)
+        return allr[np.argsort(allr[:, 0], kind="stable")]
 
     def dump(self) -> dict[str, Any]:
         """The 'r'/'z' console-key dump surface

@@ -5,8 +5,12 @@ from pbs_tpu.sched.base import (
     register_scheduler,
     scheduler_names,
 )
+from pbs_tpu.sched.arinc653 import Arinc653Scheduler
+from pbs_tpu.sched.atc import AtcFeedbackPolicy
 from pbs_tpu.sched.credit import CreditScheduler
+from pbs_tpu.sched.credit2 import Credit2Scheduler
 from pbs_tpu.sched.feedback import FeedbackPolicy
+from pbs_tpu.sched.sedf import SedfScheduler
 
 __all__ = [
     "Decision",
@@ -14,6 +18,10 @@ __all__ = [
     "make_scheduler",
     "register_scheduler",
     "scheduler_names",
+    "Arinc653Scheduler",
+    "AtcFeedbackPolicy",
     "CreditScheduler",
+    "Credit2Scheduler",
     "FeedbackPolicy",
+    "SedfScheduler",
 ]

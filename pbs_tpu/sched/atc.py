@@ -1,0 +1,118 @@
+"""ATC feedback variant: EWMA + log-bucket quantum law (design history).
+
+The reference ships a second, *unbuilt* scheduler
+(``xen-4.2.1/xen/common/sched_credit_atc.c``, 2,251 LoC — absent from
+``xen/common/Makefile:21-24``) recording an earlier design of the
+adaptive policy. Its distinct mechanisms, re-expressed here as an
+alternative FeedbackPolicy so both designs can be A/B'd on the same
+scheduler:
+
+- **EWMA of contention latency** with ALPHA=4
+  (``sched_credit_atc.c:210-229``): avg = (avg*(ALPHA-1) + sample)/ALPHA.
+- **Log-bucketing** (``log()``, ``sched_credit_atc.c:241-262``):
+  bucket = floor(log2(avg_latency)).
+- **Linear quantum law** (``sched_credit_atc.c:336-347``):
+  tslice_us = 49_980 − 3_300·bucket, clamped to [300 µs, 30 ms] — the
+  wider adaptation band of the two designs (BASELINE.md).
+- **4-entry history state machine** (``update_time_slice``,
+  ``sched_credit_atc.c:291-460``): a new bucket is only *applied* after
+  the last HISTORY samples agree (hysteresis against noise).
+- **Global minimum slice** (``csched_update_acct``,
+  ``sched_credit_atc.c:462-501``): the partition-wide applied quantum is
+  the minimum over all jobs' suggestions — one contended tenant tightens
+  everyone's quantum (the lock-holder-preemption rationale: shorter
+  quanta everywhere bound any tenant's wait).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import TYPE_CHECKING
+
+from pbs_tpu.sched.feedback import FeedbackPolicy, JobMetricState
+from pbs_tpu.utils.clock import MS
+
+if TYPE_CHECKING:
+    from pbs_tpu.runtime.job import Job
+
+ALPHA = 4  # EWMA weight (sched_credit_atc.c ALPHA)
+HISTORY = 4  # state-history depth (update_time_slice)
+SLICE_BASE_US = 49_980  # linear law intercept (atc:336-347)
+SLICE_STEP_US = 3_300  # per-bucket decrement
+ATC_MIN_US = 300
+ATC_MAX_US = 30_000
+
+
+@dataclasses.dataclass
+class AtcJobState:
+    ewma_ns: float = 0.0
+    history: list = dataclasses.field(default_factory=list)
+    applied_bucket: int | None = None
+
+
+class AtcFeedbackPolicy(FeedbackPolicy):
+    """Drop-in alternative to FeedbackPolicy with the atc quantum law."""
+
+    def __init__(self, partition, tick_ns: int = 1 * MS):
+        super().__init__(
+            partition, tick_ns=tick_ns, min_us=ATC_MIN_US, max_us=ATC_MAX_US
+        )
+        self.atc: dict[str, AtcJobState] = {}
+
+    def _atc_state(self, job: "Job") -> AtcJobState:
+        st = self.atc.get(job.name)
+        if st is None:
+            st = self.atc[job.name] = AtcJobState()
+        return st
+
+    # Override the phase filter wholesale: atc has no stall-rate phases.
+    def _submilli_update(self, job, st: JobMetricState,
+                         coll_wait_ns: float, steps: int) -> None:
+        wait_ns, events = job.take_contention()
+        total_wait = coll_wait_ns + wait_ns
+        total_events = max(1, events + (steps if coll_wait_ns > 0 else 0))
+        sample = total_wait / total_events
+
+        a = self._atc_state(job)
+        a.ewma_ns = (a.ewma_ns * (ALPHA - 1) + sample) / ALPHA
+        bucket = int(math.log2(a.ewma_ns)) if a.ewma_ns >= 1 else 0
+
+        a.history.append(bucket)
+        if len(a.history) > HISTORY:
+            a.history.pop(0)
+        # Hysteresis: only adopt a bucket after HISTORY agreeing samples.
+        if len(a.history) == HISTORY and len(set(a.history)) == 1:
+            a.applied_bucket = bucket
+
+        self._apply_global_min()
+
+    def _apply_global_min(self) -> None:
+        """Partition-wide quantum = min over per-job suggestions
+        (atc csched_update_acct:462-501)."""
+        suggestions = []
+        for job in self.partition.jobs:
+            a = self.atc.get(job.name)
+            if a is None or a.applied_bucket is None:
+                continue
+            us = SLICE_BASE_US - SLICE_STEP_US * a.applied_bucket
+            suggestions.append(max(ATC_MIN_US, min(ATC_MAX_US, us)))
+        if not suggestions:
+            return
+        global_us = min(suggestions)
+        for job in self.partition.jobs:
+            job.params.tslice_us = global_us
+
+    def dump(self) -> list[dict]:
+        out = []
+        for job in self.partition.jobs:
+            a = self._atc_state(job)
+            out.append(
+                {
+                    "job": job.name,
+                    "tslice_us": job.params.tslice_us,
+                    "ewma_ns": round(a.ewma_ns, 1),
+                    "bucket": a.applied_bucket,
+                }
+            )
+        return out

@@ -1,0 +1,137 @@
+"""Credit2 scheduler: burn-rate-scaled credits with global reset.
+
+Semantic port of Xen's credit2 (``xen-4.2.1/xen/common/sched_credit2.c``,
+2,130 LoC; registered in ``schedule.c:65-70``), redesigned for step-quanta
+executors rather than translated:
+
+- Every context holds ``credit``; running burns credit at a rate
+  *inversely proportional to job weight* (heavier jobs burn slower, so
+  they naturally run longer — credit2's key difference from credit1's
+  periodic redistribution).
+- The runqueue is ordered by credit (highest first); dispatch picks the
+  richest context.
+- When the picked context's credit falls below zero, a **reset event**
+  adds ``CREDIT_INIT`` to every context (credit2's global reset), which
+  preserves relative spacing — proportional fairness emerges without an
+  accounting timer.
+- The returned quantum is the per-job adaptive ``tslice_us``, same as
+  credit (the feedback policy plugs into either).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from pbs_tpu.sched.base import Decision, Scheduler, register_scheduler
+from pbs_tpu.utils.clock import US
+
+CREDIT_INIT = 10_000.0  # µs at weight 256 (reset quantum)
+DEFAULT_WEIGHT = 256.0
+
+
+@dataclasses.dataclass
+class C2Ctx:
+    credit: float = CREDIT_INIT
+    executor: int = 0
+
+
+@register_scheduler
+class Credit2Scheduler(Scheduler):
+    name = "credit2"
+
+    def __init__(self, partition):
+        super().__init__(partition)
+        self.runqs: list[list] = []
+        self.resets = 0
+
+    @staticmethod
+    def _cc(ctx) -> C2Ctx:
+        if not isinstance(ctx.sched_priv, C2Ctx):
+            ctx.sched_priv = C2Ctx()
+        return ctx.sched_priv
+
+    def executor_added(self, ex) -> None:
+        while len(self.runqs) <= ex.index:
+            self.runqs.append([])
+
+    def job_removed(self, job) -> None:
+        for ctx in job.contexts:
+            q = self.runqs[self._cc(ctx).executor]
+            if ctx in q:
+                q.remove(ctx)
+
+    def sleep(self, ctx) -> None:
+        q = self.runqs[self._cc(ctx).executor]
+        if ctx in q:
+            q.remove(ctx)
+
+    def wake(self, ctx) -> None:
+        cc = self._cc(ctx)
+        if ctx in self.runqs[cc.executor]:
+            return
+        exi = self.pick_executor(ctx)
+        cc.executor = exi
+        self._insert(exi, ctx)
+
+    def _insert(self, exi: int, ctx) -> None:
+        q = self.runqs[exi]
+        c = self._cc(ctx).credit
+        i = 0
+        while i < len(q) and self._cc(q[i]).credit >= c:
+            i += 1
+        q.insert(i, ctx)
+
+    def pick_executor(self, ctx) -> int:
+        if ctx.executor_hint is not None:
+            return ctx.executor_hint
+        lens = [len(q) for q in self.runqs]
+        return lens.index(min(lens)) if lens else 0
+
+    def do_schedule(self, ex, now_ns: int) -> Decision:
+        q = self.runqs[ex.index]
+        if not q:
+            # Steal the richest context from the fullest peer.
+            best, best_q = None, None
+            for qq in self.runqs:
+                for ctx in qq:
+                    if ctx.executor_hint is not None:
+                        continue
+                    if best is None or self._cc(ctx).credit > self._cc(best).credit:
+                        best, best_q = ctx, qq
+            if best is None:
+                return Decision(None, 0)
+            best_q.remove(best)
+            self._cc(best).executor = ex.index
+            ctx = best
+        else:
+            ctx = q.pop(0)
+        if self._cc(ctx).credit <= 0:
+            self._reset_credits()
+        return Decision(ctx, ctx.job.params.tslice_us * US)
+
+    def _reset_credits(self) -> None:
+        """Global reset: everyone gains CREDIT_INIT, spacing preserved."""
+        self.resets += 1
+        for job in self.partition.jobs:
+            for ctx in job.contexts:
+                self._cc(ctx).credit += CREDIT_INIT
+
+    def descheduled(self, ex, ctx, ran_ns: int, now_ns: int) -> None:
+        cc = self._cc(ctx)
+        # Weight-scaled burn: weight w burns at (DEFAULT_WEIGHT / w).
+        w = max(1, ctx.job.params.weight)
+        cc.credit -= (ran_ns / US) * (DEFAULT_WEIGHT / w)
+        if ctx.runnable():
+            cc.executor = ex.index
+            self._insert(ex.index, ctx)
+
+    def dump_settings(self) -> dict:
+        return {"name": self.name, "resets": self.resets}
+
+    def dump_executor(self, ex) -> dict:
+        return {
+            "runq": [
+                {"ctx": c.name, "credit": round(self._cc(c).credit, 1)}
+                for c in self.runqs[ex.index]
+            ]
+        }

@@ -54,7 +54,7 @@ class Ledger:
     (``xen-4.2.1/xen/common/domain.c:618-626``).
     """
 
-    def __init__(self, num_slots: int, buf=None):
+    def __init__(self, num_slots: int, buf=None, native: bool | None = None):
         self.num_slots = num_slots
         nbytes = num_slots * SLOT_BYTES
         if buf is None:
@@ -64,6 +64,20 @@ class Ledger:
             raise ValueError(f"buffer too small: {mv.nbytes} < {nbytes}")
         self._arr = np.frombuffer(mv, dtype="<u8", count=num_slots * SLOT_WORDS)
         self._arr = self._arr.reshape(num_slots, SLOT_WORDS)
+        # Native fast path (native/pbst_runtime.cc): same byte layout,
+        # real atomics. native=None auto-detects; False forces Python
+        # (used by tests to exercise both paths).
+        self._nat = None
+        if native is not False:
+            from pbs_tpu.runtime import native as native_mod
+
+            lib = native_mod.load()
+            if lib is not None:
+                self._nat = lib
+                self._as_u64p = native_mod.as_u64p
+                self._ptr = native_mod.as_u64p(self._arr.reshape(-1))
+            elif native is True:
+                raise RuntimeError("native runtime requested but unavailable")
 
     # -- writer side (scheduler/executor only) ---------------------------
 
@@ -80,6 +94,13 @@ class Ledger:
         (``pmustate.c:111-135``): set tsc_start, capture per-counter
         start values.
         """
+        if self._nat is not None:
+            live_p = None
+            if live is not None:
+                live = np.ascontiguousarray(live, dtype="<u8")
+                live_p = self._as_u64p(live)
+            self._nat.pbst_ledger_resume(self._ptr, slot, now_ns, live_p)
+            return
         self._begin(slot)
         if live is not None:
             self._arr[slot, _START:_START + NUM_COUNTERS] = live
@@ -96,6 +117,10 @@ class Ledger:
         interval's counter deltas into the published sums and clear
         tsc_start so readers stop live-merging.
         """
+        if self._nat is not None:
+            d = np.ascontiguousarray(deltas, dtype="<u8")
+            self._nat.pbst_ledger_suspend(self._ptr, slot, self._as_u64p(d))
+            return
         self._begin(slot)
         self._arr[slot, _SUMS:_SUMS + NUM_COUNTERS] += deltas.astype("<u8")
         self._arr[slot, _T] = 0
@@ -103,11 +128,18 @@ class Ledger:
 
     def add(self, slot: int, counter: int, delta: int) -> None:
         """Accumulate a single counter without changing run state."""
+        if self._nat is not None:
+            self._nat.pbst_ledger_add(self._ptr, slot, counter, delta)
+            return
         self._begin(slot)
         self._arr[slot, _SUMS + counter] += np.uint64(delta)
         self._end(slot)
 
     def add_many(self, slot: int, deltas: np.ndarray) -> None:
+        if self._nat is not None:
+            d = np.ascontiguousarray(deltas, dtype="<u8")
+            self._nat.pbst_ledger_add_many(self._ptr, slot, self._as_u64p(d))
+            return
         self._begin(slot)
         self._arr[slot, _SUMS:_SUMS + NUM_COUNTERS] += deltas.astype("<u8")
         self._end(slot)
@@ -115,6 +147,9 @@ class Ledger:
     def reset(self, slot: int) -> None:
         """Zero a slot for a fresh context (``pmu_init_vcpu``,
         ``pmustate.c:138-150``)."""
+        if self._nat is not None:
+            self._nat.pbst_ledger_reset(self._ptr, slot)
+            return
         self._begin(slot)
         self._arr[slot, _T] = 0
         self._arr[slot, _SUMS:] = 0
@@ -129,6 +164,14 @@ class Ledger:
         version, copy the sums, re-read the version; retry if a write was
         in progress (odd) or intervened (changed).
         """
+        if self._nat is not None:
+            out = np.empty(NUM_COUNTERS, dtype="<u8")
+            rc = self._nat.pbst_ledger_snapshot(
+                self._ptr, slot, self._as_u64p(out), max_retries)
+            if rc < 0:
+                raise RuntimeError(
+                    f"ledger slot {slot}: snapshot retries exhausted")
+            return out
         for _ in range(max_retries):
             v0 = int(self._arr[slot, _V])
             if v0 & 1:

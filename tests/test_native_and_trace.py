@@ -1,0 +1,127 @@
+"""Native runtime (C++ seqlock ledger + SPSC trace ring) and trace tests.
+
+The native library must be byte-compatible with the Python fallback —
+both are tested over the same buffer, plus a cross-process consistency
+hammer for the seqlock contract (the reference's guest reads hypervisor-
+written pages concurrently, x86.c:228-312)."""
+
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from pbs_tpu.obs import Ev, TraceBuffer, format_records
+from pbs_tpu.runtime import native
+from pbs_tpu.telemetry import Counter, Ledger, NUM_COUNTERS, SLOT_BYTES
+
+
+def test_native_builds():
+    assert native.available(), "native runtime failed to build"
+
+
+def test_native_python_interop():
+    """Native writer, Python reader (and vice versa) over one buffer."""
+    buf = bytearray(2 * SLOT_BYTES)
+    nat = Ledger(2, buf=buf, native=True)
+    py = Ledger(2, buf=buf, native=False)
+    nat.add(0, Counter.STEPS_RETIRED, 7)
+    assert py.snapshot(0)[Counter.STEPS_RETIRED] == 7
+    py.add(1, Counter.TOKENS, 3)
+    assert nat.snapshot(1)[Counter.TOKENS] == 3
+    d = np.zeros(NUM_COUNTERS, dtype=np.uint64)
+    d[Counter.DEVICE_TIME_NS] = 1000
+    nat.resume(0, now_ns=0)
+    assert py.is_running(0)  # 0 promoted to 1: running flag holds
+    nat.suspend(0, d)
+    assert py.snapshot(0)[Counter.DEVICE_TIME_NS] == 1000
+    assert not py.is_running(0)
+
+
+def _hammer_writer(shm_name, n_slots, iters):
+    from multiprocessing import shared_memory
+
+    shm = shared_memory.SharedMemory(name=shm_name)
+    led = Ledger(n_slots, buf=shm.buf)
+    d = np.zeros(NUM_COUNTERS, dtype=np.uint64)
+    # Invariant: STEPS_RETIRED and DEVICE_TIME_NS always advance in
+    # lockstep; a torn read would catch them out of sync.
+    d[Counter.STEPS_RETIRED] = 1
+    d[Counter.DEVICE_TIME_NS] = 1
+    for _ in range(iters):
+        led.add_many(0, d)
+    del led  # numpy view pins the mapping; drop before close
+    shm.close()
+
+
+@pytest.mark.skipif(not native.available(), reason="needs native runtime")
+def test_seqlock_cross_process_consistency():
+    from multiprocessing import shared_memory
+
+    shm = shared_memory.SharedMemory(create=True, size=SLOT_BYTES)
+    try:
+        led = Ledger(1, buf=shm.buf)
+        iters = 20_000
+        p = mp.get_context("fork").Process(
+            target=_hammer_writer, args=(shm.name, 1, iters))
+        p.start()
+        torn = 0
+        reads = 0
+        while p.is_alive():
+            snap = led.snapshot(0)
+            reads += 1
+            if snap[Counter.STEPS_RETIRED] != snap[Counter.DEVICE_TIME_NS]:
+                torn += 1
+        p.join()
+        assert torn == 0, f"{torn}/{reads} torn snapshots"
+        assert led.snapshot(0)[Counter.STEPS_RETIRED] == iters
+    finally:
+        import gc
+
+        led = None
+        gc.collect()  # drop numpy views pinning the mapping
+        shm.close()
+        shm.unlink()
+
+
+@pytest.mark.parametrize("use_native", [False, True])
+def test_trace_ring_roundtrip(use_native):
+    if use_native and not native.available():
+        pytest.skip("no native runtime")
+    tb = TraceBuffer(capacity=8, native=use_native)
+    for i in range(5):
+        assert tb.emit(1000 + i, Ev.SCHED_PICK, i, 7)
+    recs = tb.consume()
+    assert recs.shape == (5, 8)
+    assert [int(r[0]) for r in recs] == [1000, 1001, 1002, 1003, 1004]
+    assert all(int(r[1]) == Ev.SCHED_PICK for r in recs)
+    assert [int(r[2]) for r in recs] == [0, 1, 2, 3, 4]
+
+
+@pytest.mark.parametrize("use_native", [False, True])
+def test_trace_ring_overflow_counts_lost(use_native):
+    if use_native and not native.available():
+        pytest.skip("no native runtime")
+    tb = TraceBuffer(capacity=4, native=use_native)
+    for i in range(6):
+        tb.emit(i, Ev.SCHED_WAKE)
+    assert tb.lost == 2
+    assert tb.consume().shape[0] == 4
+    # Drained: capacity available again.
+    assert tb.emit(99, Ev.SCHED_SLEEP)
+
+
+def test_partition_emits_sched_trace():
+    from pbs_tpu.runtime import Job, Partition
+    from pbs_tpu.telemetry import SimBackend, SimProfile
+
+    be = SimBackend()
+    part = Partition("t", source=be, scheduler="credit")
+    be.register("a", SimProfile.steady())
+    part.add_job(Job("a", max_steps=3))
+    part.run()
+    recs = part.drain_traces()
+    events = [int(r[1]) for r in recs]
+    assert Ev.SCHED_PICK in events and Ev.SCHED_DESCHED in events
+    lines = format_records(recs)
+    assert any("SCHED_PICK" in l for l in lines)
