@@ -26,7 +26,7 @@ TARGET_MFU = 0.40
 
 WARMUP_STEPS = 5
 BENCH_STEPS = 20
-BATCH = 8
+BATCH = 6
 SEQ = 1024
 
 

@@ -48,6 +48,10 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16  # compute dtype (MXU-native)
     # Remat the layer body: trade FLOPs for HBM (jax.checkpoint).
     remat: bool = False
+    # Remat policy: "full" recomputes everything; "dots" saves weight
+    # matmul outputs (dots_with_no_batch_dims_saveable) and recomputes
+    # elementwise/attention — usually the best throughput point.
+    remat_policy: str = "full"
     # Attention implementation: "xla" (fused by compiler), "pallas"
     # (pbs_tpu.ops.attention), "ring" (sequence-parallel ring attention).
     attn_impl: str = "xla"
@@ -198,7 +202,16 @@ def forward(cfg: TransformerConfig, params: dict, tokens: jax.Array,
         return layer_body(cfg, x, lp, cos, sin, constrain)
 
     if cfg.remat:
-        body = jax.checkpoint(body)
+        if cfg.remat_policy == "dots":
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        elif cfg.remat_policy == "full":
+            policy = None
+        else:
+            raise ValueError(
+                f"unknown remat_policy {cfg.remat_policy!r}; "
+                "expected 'full' or 'dots'"
+            )
+        body = jax.checkpoint(body, policy=policy)
 
     def scan_fn(x, lp):
         return body(x, lp, cos, sin), None

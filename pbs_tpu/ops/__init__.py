@@ -1,0 +1,3 @@
+from pbs_tpu.ops.attention import flash_attention
+
+__all__ = ["flash_attention"]

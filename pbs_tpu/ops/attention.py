@@ -1,0 +1,123 @@
+"""Pallas TPU flash attention (causal, GQA) with online softmax.
+
+The hot op of the flagship workload, written blockwise so attention
+probabilities never materialize in HBM: per (batch, head, q-block)
+grid cell, iterate over k/v blocks with the online-softmax recurrence
+(running max m, normalizer l, fp32 accumulator) — the standard
+flash-attention scheme expressed in Pallas for the MXU/VMEM hierarchy
+(block sizes 128, fp32 accumulation via ``preferred_element_type``).
+
+Causal skip: a q-block only visits k-blocks up to its diagonal —
+``fori_loop`` with a traced upper bound, so the work per row is
+triangular, not square.
+
+Falls back to interpreter mode off-TPU so the same code path is tested
+on CPU CI (the fake-backend pattern, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool,
+                  sm_scale: float, block_k: int):
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # (BQ, hd)
+    bq = q.shape[0]
+    hd = q.shape[1]
+    s_len = k_ref.shape[2]
+    i = pl.program_id(2)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BQ, BK)
+        if causal:
+            qpos = i * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            kpos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v.astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    if causal:
+        # Only k-blocks at or before this q-block's diagonal.
+        n_blocks = jax.lax.div(i * bq + bq + block_k - 1, block_k)
+    else:
+        n_blocks = s_len // block_k
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, hd), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
+def flash_attention(
+    q: jax.Array,  # (B, S, H, hd)
+    k: jax.Array,  # (B, S, Hkv, hd)
+    v: jax.Array,  # (B, S, Hkv, hd)
+    causal: bool = True,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Returns (B, S, H, hd). GQA: H must be a multiple of Hkv."""
+    B, S, H, hd = q.shape
+    Hkv = k.shape[2]
+    if H % Hkv:
+        raise ValueError(f"H={H} not a multiple of Hkv={Hkv}")
+    group = H // Hkv
+    bq = min(block_q, S)
+    bk = min(block_k, S)
+    if S % bq or S % bk:
+        raise ValueError(f"S={S} must be divisible by block sizes {bq},{bk}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # (B, H, S, hd) layout: heads become a grid dimension.
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    kernel = functools.partial(
+        _flash_kernel, causal=causal, sm_scale=1.0 / np.sqrt(hd), block_k=bk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, H, S // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, S, hd),
+                         lambda b, h, i, g=group: (b, h // g, 0, 0)),
+            pl.BlockSpec((1, 1, S, hd),
+                         lambda b, h, i, g=group: (b, h // g, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, hd), lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, hd), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)

@@ -1,4 +1,6 @@
+from pbs_tpu.parallel.gang import GangMonitor, anti_stack_pick
 from pbs_tpu.parallel.mesh import make_mesh, split_devices
+from pbs_tpu.parallel.ring_attention import ring_attention
 from pbs_tpu.parallel.sharding import (
     activation_constrainer,
     batch_sharding,
@@ -8,7 +10,10 @@ from pbs_tpu.parallel.sharding import (
 )
 
 __all__ = [
+    "GangMonitor",
+    "anti_stack_pick",
     "make_mesh",
+    "ring_attention",
     "split_devices",
     "activation_constrainer",
     "batch_sharding",

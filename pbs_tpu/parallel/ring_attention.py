@@ -1,0 +1,123 @@
+"""Ring attention: sequence-parallel exact attention over a mesh axis.
+
+The reference has no long-context concept (SURVEY.md §5: structurally a
+new design area). Design here: shard the sequence across a mesh axis;
+each device holds its q/k/v chunk; k/v chunks rotate around the ring via
+``jax.lax.ppermute`` while every device folds each visiting chunk into
+its local online-softmax state (running max / normalizer / accumulator —
+the same recurrence as the Pallas flash kernel, lifted one level to the
+inter-chip ring). After ``ring_size`` rotations every q has attended to
+every k exactly once. Communication is neighbor-only, so it rides ICI
+links; XLA overlaps the permute with the local block computation.
+
+Causality across chunks: a visiting chunk is fully-visible (source index
+< mine), fully-masked (source > mine), or diagonal (source == mine,
+intra-chunk causal mask); fully-masked chunks are skipped arithmetically
+(their contribution multiplies in as exp(-inf)=0) to keep control flow
+static for XLA.
+
+Gang-scheduling note (SURVEY.md §7): one ring step stalls if any member
+is preempted — ring jobs must be gang-dispatched; the scheduler treats
+multi-context ring jobs as gangs and the GangMonitor converts ring skew
+into the contention hint (the lock-holder-preemption signal reborn).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _local_block(q, k, v, sm_scale, mask):
+    """One chunk-vs-chunk attention block. q:(B,Sq,H,hd) k,v:(B,Sk,Hkv,hd).
+    mask: (Sq, Sk) bool or None. Returns (m, l, acc) contributions."""
+    B, Sq, H, hd = q.shape
+    Hkv = k.shape[2]
+    group = H // Hkv
+    kr = jnp.repeat(k, group, axis=2)  # (B, Sk, H, hd)
+    vr = jnp.repeat(v, group, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, kr).astype(jnp.float32) * sm_scale
+    if mask is not None:
+        s = jnp.where(mask[None, None, :, :], s, NEG_INF)
+    m = s.max(axis=-1, keepdims=True)  # (B,H,Sq,1)
+    p = jnp.exp(s - m)
+    l = p.sum(axis=-1, keepdims=True)
+    acc = jnp.einsum("bhqk,bkhd->bhqd", p, vr.astype(jnp.float32))
+    return m, l, acc
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
+                          sm_scale: float):
+    """Per-device body (runs under shard_map). q/k/v are local chunks
+    (B, S_local, H|Hkv, hd)."""
+    B, Sq, H, hd = q.shape
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    m = jnp.full((B, H, Sq, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((B, H, Sq, 1), jnp.float32)
+    acc = jnp.zeros((B, H, Sq, hd), jnp.float32)
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sq), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sq), 1)
+    diag_mask = cols <= rows
+
+    def step(carry, _):
+        m, l, acc, k_cur, v_cur, src = carry
+        if causal:
+            # src < my: fully visible; src == my: diagonal; src > my:
+            # masked out. Select between the three masks statically.
+            full = jnp.ones((Sq, Sq), bool)
+            none = jnp.zeros((Sq, Sq), bool)
+            mask = jnp.where(
+                src < my, full, jnp.where(src == my, diag_mask, none))
+        else:
+            mask = None
+        bm, bl, bacc = _local_block(q, k_cur, v_cur, sm_scale, mask)
+        m_new = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(bm - m_new)
+        l_new = alpha * l + beta * bl
+        acc_new = alpha * acc + beta * bacc
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        src_nxt = (src - 1) % n
+        return (m_new, l_new, acc_new, k_nxt, v_nxt, src_nxt), None
+
+    carry = (m, l, acc, k, v, my)
+    (m, l, acc, _, _, _), _ = jax.lax.scan(step, carry, None, length=n)
+    out = acc / jnp.maximum(l, 1e-30)  # (B,H,Sq,hd)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,  # (B, S, H, hd), S sharded over ``axis``
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Exact attention with the sequence sharded over ``mesh[axis]``.
+
+    Matches dense causal attention bit-for-near (fp32 accumulation);
+    memory per device is O(S/n · S/n) per block instead of O(S·S).
+    """
+    hd = q.shape[-1]
+    sm_scale = 1.0 / np.sqrt(hd)
+    spec = P(None, axis, None, None)
+    fn = functools.partial(
+        _ring_attention_local, axis_name=axis, causal=causal,
+        sm_scale=sm_scale)
+    mapped = jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    return mapped(q, k, v)

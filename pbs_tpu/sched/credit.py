@@ -39,6 +39,7 @@ from typing import TYPE_CHECKING
 
 from pbs_tpu.runtime.job import ContextState
 from pbs_tpu.sched.base import Decision, Scheduler, register_scheduler
+from pbs_tpu.sched.placement import anti_stack_pick, holds_sibling
 from pbs_tpu.utils.clock import US
 
 if TYPE_CHECKING:
@@ -170,6 +171,12 @@ class CreditScheduler(Scheduler):
     def pick_executor(self, ctx) -> int:
         if ctx.executor_hint is not None:
             return ctx.executor_hint
+        # Gang members spread over distinct executors (anti-stacking,
+        # sched_credit_atc.c:545-570 generalized).
+        if ctx.job.gang:
+            pick = anti_stack_pick(self, ctx)
+            if pick is not None:
+                return pick
         # csched_cpu_pick: prefer an idle executor, then least-loaded.
         lens = [len(q) for q in self.runqs]
         return lens.index(min(lens)) if lens else 0
@@ -208,6 +215,11 @@ class CreditScheduler(Scheduler):
             for ctx in q:
                 if ctx.executor_hint is not None:
                     continue  # pinned: not stealable
+                if ctx.job.gang and holds_sibling(self, exi, ctx):
+                    # Stealable only where anti-stacking is preserved:
+                    # a sibling-free idle executor may take a gang
+                    # member, but never collocate siblings by theft.
+                    continue
                 pri = self._cc(ctx).pri
                 if pri >= PRI_UNDER and pri > best_pri:
                     best, best_pri = ctx, pri

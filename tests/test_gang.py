@@ -1,0 +1,78 @@
+"""Gang scheduling: anti-stacking placement + skew-derived contention."""
+
+from pbs_tpu.parallel import GangMonitor
+from pbs_tpu.runtime import Job, Partition, SchedParams
+from pbs_tpu.sched import FeedbackPolicy
+from pbs_tpu.telemetry import Counter, SimBackend, SimProfile
+
+
+def test_gang_members_spread_across_executors():
+    be = SimBackend()
+    part = Partition("g", source=be, scheduler="credit", n_executors=2)
+    be.register("ring", SimProfile.steady(step_time_ns=100_000))
+    ring = Job("ring", n_contexts=2, gang=True, max_steps=1000)
+    part.add_job(ring)
+    sched = part.scheduler
+    ex0 = sched._cc(ring.contexts[0]).executor
+    ex1 = sched._cc(ring.contexts[1]).executor
+    assert ex0 != ex1, "gang members stacked on one executor"
+
+
+def test_gang_not_stolen():
+    be = SimBackend()
+    part = Partition("g", source=be, scheduler="credit", n_executors=2)
+    be.register("ring", SimProfile.steady(step_time_ns=100_000))
+    ring = Job("ring", n_contexts=2, gang=True, max_steps=100)
+    part.add_job(ring)
+    # Executor with empty runq must not steal a gang member.
+    stolen = part.scheduler._steal(0, better_than=-3)
+    if stolen is not None:
+        assert not stolen.job.gang
+
+
+def test_gang_skew_feeds_contention():
+    """A competitor on one member's executor creates progress skew; the
+    GangMonitor reports it through the vcrd channel."""
+    be = SimBackend()
+    part = Partition("g", source=be, scheduler="credit", n_executors=2)
+    GangMonitor(part)
+    be.register("ring", SimProfile.steady(step_time_ns=100_000))
+    be.register("noise", SimProfile.steady(step_time_ns=100_000))
+    ring = Job("ring", n_contexts=2, gang=True, max_steps=200_000)
+    ring.contexts[0].executor_hint = 0
+    ring.contexts[1].executor_hint = 1
+    part.add_job(ring)
+    noise = Job("noise", max_steps=200_000)
+    noise.contexts[0].executor_hint = 0  # compete with member 0 only
+    part.add_job(noise)
+    part.run(until_ns=200_000_000)
+    skew = int(ring.contexts[0].counters[Counter.GANG_SKEW_NS])
+    assert skew > 0, "no gang skew observed despite asymmetric contention"
+    # The hint reached the job's contention accumulators at some point
+    # (consumed by policies; accumulate again to check the channel).
+    ring.report_contention(1, 1)
+    assert ring.contention_events >= 1
+
+
+def test_gang_skew_drives_quantum_shrink():
+    """End-to-end: skewed gang + feedback policy => quantum shrinks
+    (the lock-holder-preemption mitigation)."""
+    be = SimBackend()
+    part = Partition("g", source=be, scheduler="credit", n_executors=2)
+    fb = FeedbackPolicy(part)
+    be.register("ring", SimProfile.steady(step_time_ns=100_000,
+                                          stall_frac=0.01))
+    be.register("noise", SimProfile.steady(step_time_ns=100_000))
+    GangMonitor(part)
+    ring = Job("ring", n_contexts=2, gang=True, max_steps=500_000,
+               params=SchedParams(tslice_us=900))
+    ring.contexts[0].executor_hint = 0
+    ring.contexts[1].executor_hint = 1
+    part.add_job(ring)
+    noise = Job("noise", max_steps=500_000)
+    noise.contexts[0].executor_hint = 0
+    part.add_job(noise)
+    part.run(until_ns=400_000_000)
+    assert ring.params.tslice_us < 900, (
+        "quantum did not shrink under gang contention"
+    )
