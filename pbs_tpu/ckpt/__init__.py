@@ -1,0 +1,15 @@
+from pbs_tpu.ckpt.checkpoint import (
+    Replicator,
+    checkpoint_exists,
+    remove_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)
+
+__all__ = [
+    "Replicator",
+    "checkpoint_exists",
+    "remove_checkpoint",
+    "restore_checkpoint",
+    "save_checkpoint",
+]

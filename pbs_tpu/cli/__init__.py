@@ -1,0 +1,3 @@
+from pbs_tpu.cli.pbst import main
+
+__all__ = ["main"]

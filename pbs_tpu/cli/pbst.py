@@ -1,0 +1,269 @@
+"""``pbst`` — the management CLI (xl / xentop / xentrace analogs).
+
+Reference surface being re-expressed (``tools/libxl/xl_cmdimpl.c``,
+``tools/xenstat/xentop``, ``tools/xentrace``, ``tools/misc/xenperf.c``):
+
+    pbst top        live per-job telemetry from a shared ledger file
+                    (lock-free snapshots; xentop)
+    pbst dump       one-shot counter dump (the 'z' console key,
+                    csched_dump_customized sched_credit.c:1944-1977)
+    pbst trace      format a drained trace ring file (xentrace_format)
+    pbst store      hierarchical store ops (xenstore-ls / -read / -write)
+    pbst ckpt-info  inspect a checkpoint directory (xl save artifacts)
+    pbst sched-credit  adjust weight/cap in a store db (xl sched-credit)
+    pbst demo       run the two-tenant sim demo end to end
+
+Monitors attach to artifacts (ledger file, store db, trace dump), not to
+a live daemon — the same decoupling as xentop reading shared pages.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _load_meta(ledger_path: str) -> dict:
+    try:
+        with open(ledger_path + ".meta.json") as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return {"partition": "?", "scheduler": "?", "slots": {}}
+
+
+def _ledger(args):
+    import os
+
+    from pbs_tpu.telemetry import Ledger
+
+    if not os.path.exists(args.ledger):
+        raise SystemExit(f"pbst: no ledger at {args.ledger}")
+    # Monitors attach read-only; slot count comes from the file itself
+    # so a mismatched --slots can neither truncate nor over-index the
+    # producer's live mapping.
+    return Ledger.file_backed(args.ledger, readonly=True)
+
+
+def _fmt_row(slot, info, snap, prev=None, dt=1.0):
+    from pbs_tpu.telemetry import Counter
+
+    steps = int(snap[Counter.STEPS_RETIRED])
+    dev_ms = int(snap[Counter.DEVICE_TIME_NS]) / 1e6
+    stall = int(snap[Counter.HBM_STALL_NS])
+    dev = int(snap[Counter.DEVICE_TIME_NS])
+    stall_pct = 100.0 * stall / dev if dev else 0.0
+    rate = ""
+    if prev is not None:
+        dsteps = steps - int(prev[Counter.STEPS_RETIRED])
+        rate = f"{dsteps / dt:8.1f}"
+    return (
+        f"{slot:>4} {info.get('ctx', '?'):<16} {info.get('weight', ''):>6} "
+        f"{info.get('cap', ''):>4} {info.get('tslice_us', ''):>8} "
+        f"{steps:>10} {dev_ms:>10.1f} {stall_pct:>6.1f} {rate:>8}"
+    )
+
+
+HDR = (
+    f"{'slot':>4} {'ctx':<16} {'weight':>6} {'cap':>4} {'tslice':>8} "
+    f"{'steps':>10} {'dev_ms':>10} {'stall%':>6} {'st/s':>8}"
+)
+
+
+def cmd_dump(args) -> int:
+    led = _ledger(args)
+    meta = _load_meta(args.ledger)
+    print(f"partition={meta['partition']} scheduler={meta['scheduler']}")
+    print(HDR)
+    for slot_s, info in sorted(meta["slots"].items(), key=lambda kv: int(kv[0])):
+        snap = led.snapshot(int(slot_s))
+        print(_fmt_row(int(slot_s), info, snap))
+    return 0
+
+
+def cmd_top(args) -> int:
+    led = _ledger(args)
+    prev: dict[int, np.ndarray] = {}
+    try:
+        for _ in range(args.iterations if args.iterations > 0 else 10**9):
+            meta = _load_meta(args.ledger)
+            rows = []
+            for slot_s, info in sorted(meta["slots"].items(),
+                                       key=lambda kv: int(kv[0])):
+                slot = int(slot_s)
+                snap = led.snapshot(slot)
+                rows.append(_fmt_row(slot, info, snap, prev.get(slot),
+                                     args.interval))
+                prev[slot] = snap
+            sys.stdout.write("\x1b[2J\x1b[H" if args.clear else "")
+            print(f"pbst top — partition={meta['partition']} "
+                  f"scheduler={meta['scheduler']} "
+                  f"({time.strftime('%H:%M:%S')})")
+            print(HDR)
+            print("\n".join(rows))
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_trace(args) -> int:
+    from pbs_tpu.obs.trace import format_records
+
+    recs = np.load(args.file)
+    for line in format_records(recs):
+        print(line)
+    return 0
+
+
+def cmd_store(args) -> int:
+    from pbs_tpu.store import Store
+
+    s = Store(persist_path=args.db)
+    if args.op == "ls":
+        for name in s.ls(args.path):
+            print(name)
+    elif args.op == "read":
+        v = s.read(args.path)
+        if v is None and not s.exists(args.path):
+            print(f"pbst: no entry {args.path}", file=sys.stderr)
+            return 1
+        print(json.dumps(v))
+    elif args.op == "write":
+        if args.value is None:
+            print("pbst: store write requires a JSON value", file=sys.stderr)
+            return 2
+        s.write(args.path, json.loads(args.value))
+    elif args.op == "rm":
+        print(s.rm(args.path))
+    return 0
+
+
+def cmd_ckpt_info(args) -> int:
+    with open(f"{args.path}/manifest.json") as f:
+        m = json.load(f)
+    print(json.dumps(
+        {k: m[k] for k in
+         ("version", "n_leaves", "bytes", "has_telemetry", "metadata",
+          "wall_time")},
+        indent=1))
+    return 0
+
+
+def cmd_sched_credit(args) -> int:
+    """xl sched-credit analog over a store db: -d job [-w W] [-c C]
+    [-t TSLICE_US]. The controller watches these keys."""
+    from pbs_tpu.store import Store
+
+    s = Store(persist_path=args.db)
+    base = f"/jobs/{args.domain}/sched"
+    if args.weight is None and args.cap is None and args.tslice_us is None:
+        print(json.dumps({
+            "weight": s.read(f"{base}/weight", 256),
+            "cap": s.read(f"{base}/cap", 0),
+            "tslice_us": s.read(f"{base}/tslice_us", 100),
+        }))
+        return 0
+    # Validate everything before writing anything: a rejected update
+    # must leave the store untouched (operators assume all-or-nothing).
+    if args.tslice_us is not None and not (100 <= args.tslice_us <= 1_000_000):
+        print("pbst: tslice out of bounds [100, 1000000] us",
+              file=sys.stderr)
+        return 1
+    t = s.transaction()
+    if args.weight is not None:
+        t.write(f"{base}/weight", args.weight)
+    if args.cap is not None:
+        t.write(f"{base}/cap", args.cap)
+    if args.tslice_us is not None:
+        t.write(f"{base}/tslice_us", args.tslice_us)
+    t.commit()
+    return 0
+
+
+def cmd_demo(args) -> int:
+    from pbs_tpu.runtime import Job, Partition, SchedParams
+    from pbs_tpu.sched import FeedbackPolicy
+    from pbs_tpu.telemetry import SimBackend, SimProfile
+
+    be = SimBackend()
+    part = Partition("demo", source=be, scheduler=args.scheduler,
+                     ledger_path=args.ledger)
+    fb = FeedbackPolicy(part)
+    be.register("train", SimProfile.steady(
+        step_time_ns=200_000, stall_frac=0.5, collective_wait_ns=2_000))
+    be.register("serve", SimProfile.steady(
+        step_time_ns=50_000, stall_frac=0.02, collective_wait_ns=500))
+    part.add_job(Job("train", params=SchedParams(weight=512)))
+    part.add_job(Job("serve", params=SchedParams(weight=256)))
+    part.run(until_ns=int(args.seconds * 1e9))
+    print(json.dumps(part.dump(), indent=1))
+    print(json.dumps({"feedback": fb.dump()}, indent=1))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="pbst",
+                                description="PBS-T management CLI")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def ledger_args(sp):
+        sp.add_argument("--ledger", required=True, help="ledger file path")
+        sp.add_argument("--slots", type=int, default=128)
+
+    sp = sub.add_parser("dump", help="one-shot counter dump ('z' key)")
+    ledger_args(sp)
+    sp.set_defaults(fn=cmd_dump)
+
+    sp = sub.add_parser("top", help="live telemetry (xentop)")
+    ledger_args(sp)
+    sp.add_argument("--interval", type=float, default=1.0)
+    sp.add_argument("--iterations", type=int, default=0, help="0=forever")
+    sp.add_argument("--clear", action="store_true")
+    sp.set_defaults(fn=cmd_top)
+
+    sp = sub.add_parser("trace", help="format a trace dump (xentrace)")
+    sp.add_argument("file")
+    sp.set_defaults(fn=cmd_trace)
+
+    sp = sub.add_parser("store", help="store ops (xenstore)")
+    sp.add_argument("op", choices=["ls", "read", "write", "rm"])
+    sp.add_argument("path")
+    sp.add_argument("value", nargs="?")
+    sp.add_argument("--db", required=True)
+    sp.set_defaults(fn=cmd_store)
+
+    sp = sub.add_parser("ckpt-info", help="inspect a checkpoint")
+    sp.add_argument("path")
+    sp.set_defaults(fn=cmd_ckpt_info)
+
+    sp = sub.add_parser("sched-credit", help="adjust job scheduling")
+    sp.add_argument("-d", "--domain", required=True)
+    sp.add_argument("-w", "--weight", type=int)
+    sp.add_argument("-c", "--cap", type=int)
+    sp.add_argument("-t", "--tslice-us", type=int, dest="tslice_us")
+    sp.add_argument("--db", required=True)
+    sp.set_defaults(fn=cmd_sched_credit)
+
+    sp = sub.add_parser("demo", help="run the two-tenant sim demo")
+    sp.add_argument("--scheduler", default="credit")
+    sp.add_argument("--seconds", type=float, default=2.0)
+    sp.add_argument("--ledger", default=None)
+    sp.set_defaults(fn=cmd_demo)
+
+    args = p.parse_args(argv)
+    try:
+        return args.fn(args)
+    except FileNotFoundError as e:
+        print(f"pbst: not found: {e.filename or e}", file=sys.stderr)
+        return 1
+    except json.JSONDecodeError as e:
+        print(f"pbst: invalid JSON value: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,3 +1,4 @@
+from pbs_tpu.runtime.events import EventBus, EventChannel, Virq
 from pbs_tpu.runtime.executor import Executor, quantum_to_steps
 from pbs_tpu.runtime.job import ContextState, ExecutionContext, Job, SchedParams
 from pbs_tpu.runtime.partition import Partition
@@ -5,8 +6,11 @@ from pbs_tpu.runtime.timer import Timer, TimerWheel
 
 __all__ = [
     "ContextState",
+    "EventBus",
+    "EventChannel",
     "ExecutionContext",
     "Executor",
+    "Virq",
     "Job",
     "Partition",
     "SchedParams",

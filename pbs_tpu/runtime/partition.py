@@ -20,6 +20,7 @@ from typing import Any, Callable
 import numpy as np
 
 from pbs_tpu.obs.trace import TraceBuffer
+from pbs_tpu.runtime.events import EventBus, Virq
 from pbs_tpu.runtime.executor import Executor
 from pbs_tpu.runtime.job import ContextState, Job, SchedParams
 from pbs_tpu.runtime.timer import TimerWheel
@@ -41,15 +42,25 @@ class Partition:
         devices: list[Any] | None = None,
         clock: Clock | None = None,
         ledger_slots: int = DEFAULT_LEDGER_SLOTS,
+        ledger_path: str | None = None,
         sched_params: dict[str, Any] | None = None,
     ):
         self.name = name
         self.source = source
         self.clock = clock if clock is not None else source.clock
         self.timers = TimerWheel()
-        self.ledger = Ledger(ledger_slots)
+        # File-backed ledger lets external monitors (pbst top) read live
+        # telemetry lock-free across processes.
+        self._ledger_path = ledger_path
+        if ledger_path is not None:
+            self.ledger = Ledger.file_backed(ledger_path, ledger_slots)
+        else:
+            self.ledger = Ledger(ledger_slots)
         # Per-executor lockless trace rings (per-CPU rings, trace.c).
         self.traces: list[TraceBuffer] = []
+        # Async signaling fabric (event_channel.c analog); delivered by
+        # the run loop between quanta.
+        self.events = EventBus()
         self._free_slots = list(range(ledger_slots - 1, -1, -1))
         self.jobs: list[Job] = []
         self.executors: list[Executor] = []
@@ -76,6 +87,7 @@ class Partition:
         for ctx in job.contexts:
             if ctx.state is ContextState.RUNNABLE:
                 self.scheduler.wake(ctx)
+        self._publish_meta()
         return job
 
     def create_job(
@@ -96,6 +108,7 @@ class Partition:
             if ctx.ledger_slot >= 0:
                 self._free_slots.append(ctx.ledger_slot)
                 ctx.ledger_slot = -1
+        self._publish_meta()
 
     def job(self, name: str) -> Job:
         for j in self.jobs:
@@ -145,6 +158,7 @@ class Partition:
             if max_rounds is not None and rounds >= max_rounds:
                 break
             rounds += 1
+            self.events.deliver_pending()
             ran_any = False
             for ex in self.executors:
                 if until_ns is not None and self.clock.now_ns() >= until_ns:
@@ -169,9 +183,42 @@ class Partition:
                     import time as _t
 
                     _t.sleep(min(0.001, max(0.0, (deadline - self.clock.now_ns()) / 1e9)))
+        # Refresh the monitor sidecar so adapted tslice/weights are
+        # visible to pbst top after the run.
+        self._publish_meta()
         return quanta
 
     # -- observability ---------------------------------------------------
+
+    def _publish_meta(self) -> None:
+        """Sidecar slot map so external monitors can label ledger slots
+        (the xenstore-registered device metadata analog)."""
+        if self._ledger_path is None:
+            return
+        import json
+
+        meta = {
+            "partition": self.name,
+            "scheduler": self.scheduler.name,
+            "slots": {
+                str(ctx.ledger_slot): {
+                    "ctx": ctx.name,
+                    "job": job.name,
+                    "weight": job.params.weight,
+                    "cap": job.params.cap,
+                    "tslice_us": job.params.tslice_us,
+                }
+                for job in self.jobs
+                for ctx in job.contexts
+                if ctx.ledger_slot >= 0
+            },
+        }
+        tmp = self._ledger_path + ".meta.json.tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f, indent=1)
+        import os
+
+        os.replace(tmp, self._ledger_path + ".meta.json")
 
     def trace_emit(self, exi: int, event: int, *args: int) -> None:
         if 0 <= exi < len(self.traces):

@@ -1,0 +1,3 @@
+from pbs_tpu.store.store import Store, Transaction, TransactionError
+
+__all__ = ["Store", "Transaction", "TransactionError"]

@@ -1,0 +1,172 @@
+"""Hierarchical config/rendezvous store — the xenstore analog.
+
+Reference: xenstore (``xen-4.2.1/tools/xenstore``, 11.7k LoC C +
+oxenstored) is the control-plane rendezvous: a transactional
+hierarchical key-value tree with watches, used by the toolstack and
+guests to exchange configuration and device state.
+
+Here: an in-process tree with path keys (``/jobs/train/weight``),
+watches firing on subtree changes (xenstore watch semantics: a watch on
+a prefix fires for any descendant), simple transactions
+(all-or-nothing batches with optimistic version checks), and optional
+JSON file persistence for cross-process handoff. The ``pbst`` CLI and
+the controller use it as their source of truth.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Callable
+
+
+def _norm(path: str) -> str:
+    if not path.startswith("/"):
+        raise ValueError(f"store paths are absolute: {path!r}")
+    while "//" in path:
+        path = path.replace("//", "/")
+    return path.rstrip("/") or "/"
+
+
+class TransactionError(Exception):
+    pass
+
+
+class Store:
+    def __init__(self, persist_path: str | None = None):
+        self._data: dict[str, Any] = {}
+        self._version: dict[str, int] = {}
+        self._watches: list[tuple[str, Callable[[str, Any], None]]] = []
+        self._lock = threading.RLock()
+        self._persist = persist_path
+        if persist_path and os.path.exists(persist_path):
+            with open(persist_path) as f:
+                raw = json.load(f)
+            self._data = dict(raw.get("data", {}))
+            self._version = {k: int(v) for k, v in raw.get("version", {}).items()}
+
+    # -- basic ops -------------------------------------------------------
+
+    def write(self, path: str, value: Any) -> None:
+        path = _norm(path)
+        with self._lock:
+            self._data[path] = value
+            self._version[path] = self._version.get(path, 0) + 1
+            self._fire(path, value)
+            self._save()
+
+    def read(self, path: str, default: Any = None) -> Any:
+        path = _norm(path)
+        with self._lock:
+            return self._data.get(path, default)
+
+    def exists(self, path: str) -> bool:
+        return _norm(path) in self._data
+
+    def rm(self, path: str) -> int:
+        """Remove path and its whole subtree (xenstore rm). Returns the
+        number of removed keys."""
+        path = _norm(path)
+        with self._lock:
+            doomed = [k for k in self._data
+                      if k == path or k.startswith(path + "/")]
+            for k in doomed:
+                del self._data[k]
+                self._version[k] = self._version.get(k, 0) + 1
+                self._fire(k, None)
+            self._save()
+            return len(doomed)
+
+    def ls(self, path: str) -> list[str]:
+        """Immediate children names (xenstore-ls one level)."""
+        path = _norm(path)
+        prefix = "" if path == "/" else path
+        out = set()
+        with self._lock:
+            for k in self._data:
+                if k.startswith(prefix + "/"):
+                    rest = k[len(prefix) + 1:]
+                    out.add(rest.split("/", 1)[0])
+        return sorted(out)
+
+    def version(self, path: str) -> int:
+        return self._version.get(_norm(path), 0)
+
+    # -- watches (fire for the key or any ancestor watch prefix) ---------
+
+    def watch(self, prefix: str, fn: Callable[[str, Any], None]) -> None:
+        self._watches.append((_norm(prefix), fn))
+
+    def unwatch(self, prefix: str, fn) -> None:
+        self._watches.remove((_norm(prefix), fn))
+
+    def _fire(self, path: str, value: Any) -> None:
+        for prefix, fn in list(self._watches):
+            if path == prefix or path.startswith(prefix + "/") or prefix == "/":
+                fn(path, value)
+
+    # -- transactions ----------------------------------------------------
+
+    def transaction(self) -> "Transaction":
+        return Transaction(self)
+
+    def _save(self) -> None:
+        if not self._persist:
+            return
+        tmp = self._persist + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"data": self._data, "version": self._version}, f)
+        os.replace(tmp, self._persist)
+
+
+class Transaction:
+    """Optimistic all-or-nothing batch: reads record versions; commit
+    fails if any read key changed (xenstore transaction semantics)."""
+
+    def __init__(self, store: Store):
+        self.store = store
+        self._reads: dict[str, int] = {}
+        self._writes: dict[str, Any] = {}
+        self._rms: list[str] = []
+
+    def read(self, path: str, default: Any = None) -> Any:
+        path = _norm(path)
+        if path in self._writes:
+            return self._writes[path]
+        self._reads[path] = self.store.version(path)
+        return self.store.read(path, default)
+
+    def write(self, path: str, value: Any) -> None:
+        self._writes[_norm(path)] = value
+
+    def rm(self, path: str) -> None:
+        self._rms.append(_norm(path))
+
+    def commit(self) -> None:
+        s = self.store
+        with s._lock:
+            for path, ver in self._reads.items():
+                if s.version(path) != ver:
+                    raise TransactionError(
+                        f"conflict on {path}: version {ver} -> "
+                        f"{s.version(path)}"
+                    )
+            # Apply the whole batch in memory, persist ONCE, then fire
+            # watches — so a crash cannot leave a half-persisted batch
+            # and watchers never observe intermediate states.
+            fired: list[tuple[str, Any]] = []
+            for path in self._rms:
+                doomed = [k for k in s._data
+                          if k == path or k.startswith(path + "/")]
+                for k in doomed:
+                    del s._data[k]
+                    s._version[k] = s._version.get(k, 0) + 1
+                    fired.append((k, None))
+            for path, value in self._writes.items():
+                s._data[path] = value
+                s._version[path] = s._version.get(path, 0) + 1
+                fired.append((path, value))
+            s._save()
+            for path, value in fired:
+                s._fire(path, value)

@@ -52,7 +52,48 @@ class Ledger:
     the default allocates process-local memory. Analogous to the 7 vCPU
     state pages carved out of the enlarged shared_info allocation
     (``xen-4.2.1/xen/common/domain.c:618-626``).
+
+    ``Ledger.file_backed(path, n)`` maps a file so external monitors
+    (``pbst top``) snapshot live counters with zero RPCs — the guest
+    userspace mmap of the hypervisor counter pages
+    (``drivers/perfctr/virtual.c:752-779``).
     """
+
+    @classmethod
+    def file_backed(cls, path: str, num_slots: int | None = None,
+                    native: bool | None = None,
+                    readonly: bool = False) -> "Ledger":
+        import mmap
+        import os
+
+        if readonly:
+            # Monitor attach: never create/resize; slot count derives
+            # from the file so it cannot disagree with the producer.
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                size = os.fstat(fd).st_size
+                if num_slots is None:
+                    num_slots = size // SLOT_BYTES
+                mm = mmap.mmap(fd, num_slots * SLOT_BYTES,
+                               prot=mmap.PROT_READ)
+            finally:
+                os.close(fd)
+            led = cls(num_slots, buf=mm, native=native)
+            led._mmap = mm
+            return led
+        if num_slots is None:
+            raise ValueError("num_slots required for writable ledgers")
+        nbytes = num_slots * SLOT_BYTES
+        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+        try:
+            if os.fstat(fd).st_size < nbytes:
+                os.ftruncate(fd, nbytes)
+            mm = mmap.mmap(fd, nbytes)
+        finally:
+            os.close(fd)
+        led = cls(num_slots, buf=mm, native=native)
+        led._mmap = mm  # keep the mapping alive
+        return led
 
     def __init__(self, num_slots: int, buf=None, native: bool | None = None):
         self.num_slots = num_slots

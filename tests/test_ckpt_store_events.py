@@ -1,0 +1,214 @@
+"""Checkpoint/resume + Remus replication, xenstore analog, event
+channels (SURVEY.md §2d, §5)."""
+
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pbs_tpu.ckpt import (
+    Replicator,
+    checkpoint_exists,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from pbs_tpu.runtime import EventBus, Virq
+from pbs_tpu.store import Store, TransactionError
+
+
+# -- checkpoint -------------------------------------------------------------
+
+
+def state_pytree(seed=0):
+    k = jax.random.PRNGKey(seed)
+    return {
+        "w": jax.random.normal(k, (8, 8)),
+        "nested": {"b": jnp.arange(4, dtype=jnp.int32), "step": 7},
+    }
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    path = str(tmp_path / "ckpt")
+    state = state_pytree()
+    m = save_checkpoint(path, state, metadata={"job": "test"},
+                        telemetry=np.arange(18, dtype=np.uint64))
+    assert checkpoint_exists(path)
+    assert m["metadata"]["job"] == "test"
+    restored, m2 = restore_checkpoint(path, like=state)
+    np.testing.assert_allclose(restored["w"], state["w"])
+    np.testing.assert_array_equal(restored["nested"]["b"],
+                                  state["nested"]["b"])
+    # Telemetry rides the checkpoint (the reference's missing record).
+    np.testing.assert_array_equal(m2["_telemetry"],
+                                  np.arange(18, dtype=np.uint64))
+
+
+def test_checkpoint_atomic_overwrite(tmp_path):
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, state_pytree(0))
+    save_checkpoint(path, state_pytree(1))
+    restored, _ = restore_checkpoint(path, like=state_pytree())
+    np.testing.assert_allclose(restored["w"], state_pytree(1)["w"])
+    assert not os.path.exists(path + ".old")
+
+
+def test_checkpoint_shape_mismatch_rejected(tmp_path):
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, {"w": np.zeros((2, 2))})
+    with pytest.raises(ValueError):
+        restore_checkpoint(path, like={"w": np.zeros((3, 3))})
+
+
+def test_replicator_epochs_and_retention(tmp_path):
+    base = str(tmp_path / "remus")
+    counter = {"n": 0}
+
+    def snap():
+        counter["n"] += 1
+        return {"step": np.int64(counter["n"])}, {"epoch": counter["n"]}, None
+
+    rep = Replicator(base, snap, keep=2)
+    for _ in range(5):
+        rep.replicate_once()
+    epochs = sorted(d for d in os.listdir(base) if d.startswith("epoch_"))
+    assert len(epochs) == 2  # retention
+    latest = rep.latest()
+    restored, m = restore_checkpoint(latest, like={"step": np.int64(0)})
+    assert int(restored["step"]) == 5
+
+
+# -- store ------------------------------------------------------------------
+
+
+def test_store_tree_ops():
+    s = Store()
+    s.write("/jobs/train/weight", 512)
+    s.write("/jobs/train/cap", 0)
+    s.write("/jobs/serve/weight", 256)
+    assert s.read("/jobs/train/weight") == 512
+    assert s.ls("/jobs") == ["serve", "train"]
+    assert s.ls("/jobs/train") == ["cap", "weight"]
+    assert s.rm("/jobs/train") == 2
+    assert not s.exists("/jobs/train/weight")
+    assert s.ls("/jobs") == ["serve"]
+
+
+def test_store_watch_fires_on_subtree():
+    s = Store()
+    hits = []
+    s.watch("/jobs", lambda p, v: hits.append((p, v)))
+    s.write("/jobs/a/x", 1)
+    s.write("/other", 2)
+    assert hits == [("/jobs/a/x", 1)]
+
+
+def test_store_transaction_conflict():
+    s = Store()
+    s.write("/k", 1)
+    t1 = s.transaction()
+    assert t1.read("/k") == 1
+    t1.write("/k", 2)
+    s.write("/k", 99)  # conflicting interleaved write
+    with pytest.raises(TransactionError):
+        t1.commit()
+    assert s.read("/k") == 99
+    # Clean transaction succeeds.
+    t2 = s.transaction()
+    t2.write("/k", t2.read("/k") + 1)
+    t2.commit()
+    assert s.read("/k") == 100
+
+
+def test_store_persistence(tmp_path):
+    p = str(tmp_path / "store.json")
+    s1 = Store(persist_path=p)
+    s1.write("/a/b", [1, 2, 3])
+    s2 = Store(persist_path=p)
+    assert s2.read("/a/b") == [1, 2, 3]
+
+
+def test_store_rejects_relative_paths():
+    with pytest.raises(ValueError):
+        Store().write("relative", 1)
+
+
+# -- event channels ---------------------------------------------------------
+
+
+def test_event_coalescing_and_delivery():
+    bus = EventBus()
+    hits = []
+    port = bus.bind(lambda p: hits.append(p))
+    bus.send(port)
+    bus.send(port)  # coalesces with the first (edge-triggered)
+    assert hits == []
+    assert bus.deliver_pending() == 1
+    assert hits == [port]
+    assert bus.deliver_pending() == 0
+
+
+def test_event_virq_and_mask():
+    bus = EventBus(synchronous=True)
+    hits = []
+    bus.bind_virq(Virq.TELEMETRY, lambda p: hits.append(p))
+    bus.send_virq(Virq.TELEMETRY)
+    assert hits == [int(Virq.TELEMETRY)]
+    bus.mask(int(Virq.TELEMETRY))
+    bus.send_virq(Virq.TELEMETRY)
+    assert hits == [int(Virq.TELEMETRY)]  # masked: pending, not delivered
+    bus.mask(int(Virq.TELEMETRY), False)
+    assert bus.deliver_pending() == 1
+    assert len(hits) == 2
+
+
+def test_event_send_unbound_port():
+    assert EventBus().send(12345) is False
+
+
+def test_event_double_bind_rejected():
+    bus = EventBus()
+    bus.bind(lambda p: None, port=7)
+    with pytest.raises(ValueError):
+        bus.bind(lambda p: None, port=7)
+
+
+def test_checkpoint_dtype_mismatch_rejected(tmp_path):
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, {"w": np.zeros((2, 2), np.float32)})
+    with pytest.raises(ValueError, match="dtype"):
+        restore_checkpoint(path, like={"w": np.zeros((2, 2), np.float64)})
+
+
+def test_checkpoint_path_never_missing_during_save(tmp_path):
+    """The symlink swap means `path` always resolves to a complete
+    checkpoint, even across repeated overwrites."""
+    path = str(tmp_path / "ckpt")
+    for seed in range(3):
+        save_checkpoint(path, state_pytree(seed))
+        assert checkpoint_exists(path)
+    assert os.path.islink(path)
+    # Only one generation dir retained.
+    gens = [d for d in os.listdir(tmp_path)
+            if d.startswith(".ckpt.gen.") and not d.endswith(".lnk")]
+    assert len(gens) == 1
+
+
+def test_transaction_watch_fires_once_per_key_after_commit():
+    s = Store()
+    hits = []
+    s.watch("/", lambda p, v: hits.append((p, v)))
+    t = s.transaction()
+    t.write("/a", 1)
+    t.write("/b", 2)
+    t.commit()
+    assert sorted(hits) == [("/a", 1), ("/b", 2)]
+
+
+def test_event_auto_port_skips_bound():
+    bus = EventBus()
+    bus.bind(lambda p: None, port=64)
+    p2 = bus.bind(lambda p: None)
+    assert p2 != 64

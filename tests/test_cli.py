@@ -1,0 +1,74 @@
+"""pbst CLI surface tests (xl/xentop/xenstore analogs)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from pbs_tpu.cli.pbst import main
+
+
+def test_demo_and_dump(tmp_path, capsys):
+    ledger = str(tmp_path / "p.ledger")
+    assert main(["demo", "--seconds", "0.5", "--ledger", ledger]) == 0
+    out = capsys.readouterr().out
+    d = json.loads(out[: out.rindex("}") + 1].rsplit("{\n \"feedback\"", 1)[0])
+    assert d["partition"] == "demo"
+    # Cross-invocation dump reads the same ledger file.
+    assert main(["dump", "--ledger", ledger]) == 0
+    out = capsys.readouterr().out
+    assert "train/0" in out and "serve/0" in out
+
+
+def test_top_iterations(tmp_path, capsys):
+    ledger = str(tmp_path / "p.ledger")
+    main(["demo", "--seconds", "0.2", "--ledger", ledger])
+    capsys.readouterr()
+    assert main(["top", "--ledger", ledger, "--iterations", "2",
+                 "--interval", "0.01"]) == 0
+    out = capsys.readouterr().out
+    assert "pbst top" in out and "train/0" in out
+
+
+def test_store_cli(tmp_path, capsys):
+    db = str(tmp_path / "store.json")
+    assert main(["store", "write", "/jobs/a/weight", "512", "--db", db]) == 0
+    assert main(["store", "read", "/jobs/a/weight", "--db", db]) == 0
+    assert capsys.readouterr().out.strip() == "512"
+    assert main(["store", "ls", "/jobs", "--db", db]) == 0
+    assert capsys.readouterr().out.strip() == "a"
+    # Missing key is a clean error, not a traceback.
+    assert main(["store", "read", "/nope", "--db", db]) == 1
+
+
+def test_sched_credit_cli(tmp_path, capsys):
+    db = str(tmp_path / "store.json")
+    assert main(["sched-credit", "-d", "train", "-w", "512", "-c", "25",
+                 "--db", db]) == 0
+    assert main(["sched-credit", "-d", "train", "--db", db]) == 0
+    got = json.loads(capsys.readouterr().out)
+    assert got == {"weight": 512, "cap": 25, "tslice_us": 100}
+    # Out-of-bounds tslice rejected (sysctl bounds).
+    assert main(["sched-credit", "-d", "train", "-t", "5", "--db", db]) == 1
+
+
+def test_trace_cli(tmp_path, capsys):
+    from pbs_tpu.obs import Ev, TraceBuffer
+
+    tb = TraceBuffer(capacity=16)
+    tb.emit(1_000_000, Ev.SCHED_PICK, 3, 100_000)
+    recs = tb.consume()
+    f = str(tmp_path / "trace.npy")
+    np.save(f, recs)
+    assert main(["trace", f]) == 0
+    assert "SCHED_PICK" in capsys.readouterr().out
+
+
+def test_ckpt_info_cli(tmp_path, capsys):
+    from pbs_tpu.ckpt import save_checkpoint
+
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, {"w": np.zeros(4)}, metadata={"job": "j"})
+    assert main(["ckpt-info", path]) == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info["n_leaves"] == 1 and info["metadata"]["job"] == "j"
