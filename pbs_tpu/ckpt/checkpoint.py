@@ -178,9 +178,26 @@ class Replicator:
         self.snapshot_fn = snapshot_fn
         self.period_s = period_s
         self.keep = keep
-        self.epochs = 0
+        # Resume numbering past any epochs already on disk — a restarted
+        # replicator must not write below the retained epochs (they'd be
+        # pruned as "oldest" and latest() would pin the stale snapshot).
+        self.epochs = self._next_epoch()
+        self.failures = 0
+        self.last_error: str | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+
+    def _next_epoch(self) -> int:
+        if not os.path.isdir(self.base_dir):
+            return 0
+        nums = []
+        for d in os.listdir(self.base_dir):
+            if d.startswith("epoch_"):
+                try:
+                    nums.append(int(d[len("epoch_"):]))
+                except ValueError:
+                    continue
+        return max(nums) + 1 if nums else 0
 
     def replicate_once(self) -> str:
         state, metadata, telemetry = self.snapshot_fn()
@@ -215,8 +232,10 @@ class Replicator:
             while not self._stop.wait(self.period_s):
                 try:
                     self.replicate_once()
-                except Exception:
-                    pass  # replication must never kill the job
+                    self.last_error = None
+                except Exception as e:  # must never kill the job, but
+                    self.failures += 1  # dead replication must be visible
+                    self.last_error = f"{type(e).__name__}: {e}"
 
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()

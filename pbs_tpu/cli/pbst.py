@@ -212,7 +212,6 @@ def main(argv=None) -> int:
 
     def ledger_args(sp):
         sp.add_argument("--ledger", required=True, help="ledger file path")
-        sp.add_argument("--slots", type=int, default=128)
 
     sp = sub.add_parser("dump", help="one-shot counter dump ('z' key)")
     ledger_args(sp)

@@ -1,3 +1,10 @@
+from pbs_tpu.models.moe import (
+    MoEConfig,
+    init_moe_params,
+    make_moe_train_step,
+    moe_forward,
+    moe_loss,
+)
 from pbs_tpu.models.transformer import (
     TransformerConfig,
     forward,
@@ -8,10 +15,15 @@ from pbs_tpu.models.transformer import (
 )
 
 __all__ = [
+    "MoEConfig",
     "TransformerConfig",
     "forward",
+    "init_moe_params",
     "init_params",
     "make_eval_step",
+    "make_moe_train_step",
     "make_train_step",
+    "moe_forward",
+    "moe_loss",
     "next_token_loss",
 ]

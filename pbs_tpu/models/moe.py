@@ -1,0 +1,280 @@
+"""Mixture-of-Experts decoder: second model family, expert-parallel.
+
+The reference has no ML models (SURVEY.md §0) — as with the dense
+flagship, the MoE decoder is a *workload* the framework schedules, and
+it exists specifically to exercise the parallelism axes the dense model
+does not: expert parallelism (`ep`) with all-to-all token exchange, the
+TPU-native seat of SURVEY.md §2e's "parallelism strategies to map".
+
+TPU-first design:
+
+- **Static-shape token-choice routing** (Switch/Mesh-TF lineage): top-k
+  gating with a fixed per-expert capacity; dispatch/combine are dense
+  one-hot tensors consumed by einsums, so the whole MoE layer is MXU
+  matmuls — no gather/scatter, no dynamic shapes, nothing XLA cannot
+  tile.
+- **Experts as a leading array axis** (L, E, d, f): one compiled layer
+  body under ``lax.scan``; sharding the E axis over the ``ep`` mesh axis
+  turns the dispatch einsum into an XLA all-to-all (annotation-driven,
+  no hand-rolled collectives).
+- **Router overflow as contention telemetry**: the fraction of dropped
+  tokens is returned in step metrics — the in-graph analog of the
+  reference's spin-latency hint (``vcrd_op``, ``sched_credit.c:249-259``):
+  a cheap, workload-reported congestion signal the feedback scheduler
+  can consume.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pbs_tpu.models.transformer import (
+    TransformerConfig,
+    apply_rope,
+    causal_attention,
+    default_optimizer,
+    rms_norm,
+    rope_tables,
+    token_xent,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(TransformerConfig):
+    n_experts: int = 8
+    top_k: int = 2
+    # Per-expert slots = capacity_factor * top_k * group_tokens / n_experts.
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 1e-2
+    # Tokens are routed within fixed-size groups (Mesh-TF style) so the
+    # dense (g, E, C) dispatch tensors stay O(g) per group — memory
+    # linear in total tokens, not quadratic. Groups that don't divide T
+    # fall back to one group (tiny shapes / tests).
+    router_group_size: int = 4096
+
+    def num_params(self) -> int:
+        d, f, v = self.d_model, self.d_ff, self.vocab
+        hd, E = self.head_dim, self.n_experts
+        per_layer = (
+            d * (self.n_heads * hd)
+            + 2 * d * (self.n_kv_heads * hd)
+            + (self.n_heads * hd) * d
+            + d * E  # router
+            + E * 3 * d * f  # we1, we3, we2
+            + 2 * d  # norms
+        )
+        return v * d + self.n_layers * per_layer + d + d * v
+
+    def capacity(self, n_tokens: int) -> int:
+        per = self.capacity_factor * self.top_k * n_tokens / self.n_experts
+        return max(1, int(np.ceil(per)))
+
+
+def init_moe_params(cfg: MoEConfig, key: jax.Array) -> dict:
+    """fp32 master params; layers stacked on axis 0, experts on axis 1."""
+    k_emb, k_layers, k_head = jax.random.split(key, 3)
+    d, f, E = cfg.d_model, cfg.d_ff, cfg.n_experts
+    hd, nh, nkv, L = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads, cfg.n_layers
+
+    def dense(key, shape):
+        fan_in = shape[-2] if len(shape) > 1 else shape[-1]
+        return jax.random.normal(key, shape, jnp.float32) / np.sqrt(fan_in)
+
+    ks = jax.random.split(k_layers, 9)
+    layers = {
+        "attn_norm": jnp.ones((L, d), jnp.float32),
+        "wq": dense(ks[0], (L, d, nh * hd)),
+        "wk": dense(ks[1], (L, d, nkv * hd)),
+        "wv": dense(ks[2], (L, d, nkv * hd)),
+        "wo": dense(ks[3], (L, nh * hd, d)),
+        "mlp_norm": jnp.ones((L, d), jnp.float32),
+        "router": dense(ks[4], (L, d, E)),
+        "we1": dense(ks[5], (L, E, d, f)),  # gate
+        "we3": dense(ks[6], (L, E, d, f)),  # up
+        "we2": dense(ks[7], (L, E, f, d)),  # down
+    }
+    return {
+        "embed": dense(k_emb, (cfg.vocab, d)) * np.sqrt(d),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "head": dense(k_head, (d, cfg.vocab)),
+    }
+
+
+# -- routing ----------------------------------------------------------------
+
+
+def top_k_dispatch(probs: jax.Array, k: int, capacity: int):
+    """Static-shape top-k routing with capacity dropping.
+
+    probs (T, E) fp32 -> dispatch/combine (T, E, C), plus (aux_loss,
+    drop_frac). dispatch is 0/1 token->slot assignment; combine carries
+    the renormalized gate weight. Tokens overflowing an expert's C slots
+    are dropped for that choice (residual connection carries them).
+    """
+    T, E = probs.shape
+    topv, topi = jax.lax.top_k(probs, k)  # (T, k)
+    topv = topv / jnp.clip(jnp.sum(topv, axis=-1, keepdims=True), 1e-9)
+
+    dispatch = jnp.zeros((T, E, capacity), probs.dtype)
+    combine = jnp.zeros((T, E, capacity), probs.dtype)
+    base = jnp.zeros((E,), jnp.int32)  # slots used by earlier choices
+    for i in range(k):  # k is tiny and static: unrolled
+        onehot = jax.nn.one_hot(topi[:, i], E, dtype=probs.dtype)  # (T, E)
+        # Slot index within each expert: running count of earlier tokens
+        # making the same choice, offset by slots burned by choice < i.
+        pos = jnp.cumsum(onehot, axis=0) - onehot + base[None, :].astype(
+            probs.dtype
+        )
+        pos_t = jnp.sum(pos * onehot, axis=1)  # (T,)
+        keep = (pos_t < capacity).astype(probs.dtype)
+        slot = jax.nn.one_hot(
+            jnp.clip(pos_t.astype(jnp.int32), 0, capacity - 1),
+            capacity,
+            dtype=probs.dtype,
+        )
+        d_i = onehot[:, :, None] * slot[:, None, :] * keep[:, None, None]
+        dispatch = dispatch + d_i
+        combine = combine + topv[:, i][:, None, None] * d_i
+        base = base + jnp.sum(
+            onehot * keep[:, None], axis=0
+        ).astype(jnp.int32)
+
+    # Switch-style load-balance aux loss on the top-1 assignment:
+    # E * mean_e(frac_tokens_e * mean_prob_e).
+    top1 = jax.nn.one_hot(topi[:, 0], E, dtype=probs.dtype)
+    frac = jnp.mean(top1, axis=0)
+    mean_p = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac * mean_p)
+    drop_frac = 1.0 - jnp.sum(dispatch) / (T * k)
+    return dispatch, combine, aux, drop_frac
+
+
+def moe_mlp(cfg: MoEConfig, x: jax.Array, lp: dict, constrain_ec):
+    """Routed SwiGLU experts. x (B, S, d) -> (y, aux, drop_frac).
+
+    Routing happens independently within fixed-size token groups, so the
+    dense dispatch/combine tensors are (G, g, E, Cg) with Cg ∝ g/E —
+    total memory O(T·g·k·cf), linear in T. The expert buffers flatten
+    group slots into (E, G·Cg, d); ``constrain_ec`` pins them to the
+    ``ep`` mesh axis, where the dispatch einsum (token-sharded in,
+    expert-sharded out) becomes the all-to-all.
+    """
+    B, S, d = x.shape
+    dt = cfg.dtype
+    T = B * S
+    g = cfg.router_group_size
+    if g <= 0 or T % g != 0:
+        g = T  # single group (tiny shapes / tests)
+    G = T // g
+    Cg = cfg.capacity(g)
+    xg = x.reshape(G, g, d)
+
+    logits = xg.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)  # (G, g, E)
+    dispatch, combine, aux, drop = jax.vmap(
+        lambda p: top_k_dispatch(p, cfg.top_k, Cg)
+    )(probs)
+
+    ein = jnp.einsum("gtec,gtd->egcd", dispatch.astype(dt), xg)
+    ein = constrain_ec(ein.reshape(cfg.n_experts, G * Cg, d))
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", ein, lp["we1"].astype(dt)))
+    up = jnp.einsum("ecd,edf->ecf", ein, lp["we3"].astype(dt))
+    eout = jnp.einsum("ecf,efd->ecd", constrain_ec(gate * up),
+                      lp["we2"].astype(dt))
+    eout = constrain_ec(eout).reshape(cfg.n_experts, G, Cg, d)
+    y = jnp.einsum("gtec,egcd->gtd", combine.astype(dt), eout)
+    return y.reshape(B, S, d), jnp.mean(aux), jnp.mean(drop)
+
+
+def moe_layer_body(cfg: MoEConfig, x: jax.Array, lp: dict, cos, sin,
+                   constrain, constrain_ec):
+    B, S, _ = x.shape
+    nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    dt = cfg.dtype
+
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"].astype(dt)).reshape(B, S, nh, hd)
+    k = (h @ lp["wk"].astype(dt)).reshape(B, S, nkv, hd)
+    v = (h @ lp["wv"].astype(dt)).reshape(B, S, nkv, hd)
+    q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+    attn = causal_attention(q, k, v, cfg).reshape(B, S, nh * hd)
+    x = constrain(x + attn @ lp["wo"].astype(dt))
+
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    y, aux, drop = moe_mlp(cfg, h, lp, constrain_ec)
+    x = constrain(x + y)
+    return x, aux, drop
+
+
+def moe_forward(cfg: MoEConfig, params: dict, tokens: jax.Array,
+                constrain=lambda x: x, constrain_ec=lambda x: x):
+    """tokens (B, S) -> (logits (B, S, V) fp32, aux_loss, drop_frac)."""
+    B, S = tokens.shape
+    dt = cfg.dtype
+    x = constrain(params["embed"].astype(dt)[tokens])
+    cos, sin = rope_tables(cfg, S)
+
+    def body(x, lp, cos, sin):
+        return moe_layer_body(cfg, x, lp, cos, sin, constrain, constrain_ec)
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+
+    def scan_fn(carry, lp):
+        x, aux, drop = carry
+        x, a, d = body(x, lp, cos, sin)
+        return (x, aux + a, drop + d), None
+
+    zero = jnp.zeros((), jnp.float32)
+    (x, aux, drop), _ = jax.lax.scan(
+        scan_fn, (x, zero, zero), params["layers"]
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["head"].astype(dt)).astype(jnp.float32)
+    return logits, aux / cfg.n_layers, drop / cfg.n_layers
+
+
+def moe_loss(cfg: MoEConfig, params: dict, tokens: jax.Array,
+             constrain=lambda x: x, constrain_ec=lambda x: x):
+    logits, aux, drop = moe_forward(
+        cfg, params, tokens[:, :-1], constrain, constrain_ec
+    )
+    lm = token_xent(logits, tokens[:, 1:])
+    return lm + cfg.aux_loss_weight * aux, (lm, aux, drop)
+
+
+def make_moe_train_step(cfg: MoEConfig, learning_rate: float = 3e-4,
+                        constrain=lambda x: x, constrain_ec=lambda x: x):
+    """Returns (init_opt_state, train_step); metrics include the router
+    drop fraction — the batched in-graph contention hint (vcrd_op
+    analog) the feedback policy consumes."""
+    import optax
+
+    tx = default_optimizer(learning_rate)
+
+    def init_opt_state(params):
+        return tx.init(params)
+
+    def train_step(state, tokens):
+        params, opt_state, step = state
+        (loss, (lm, aux, drop)), grads = jax.value_and_grad(
+            lambda p: moe_loss(cfg, p, tokens, constrain, constrain_ec),
+            has_aux=True,
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        ntok = tokens.shape[0] * (tokens.shape[1] - 1)
+        metrics = {
+            "loss": lm,
+            "aux_loss": aux,
+            "moe_drop_frac": drop,
+            "tokens": jnp.asarray(ntok, jnp.int32),
+        }
+        return (params, opt_state, step + 1), metrics
+
+    return init_opt_state, train_step

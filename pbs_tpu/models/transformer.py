@@ -222,14 +222,26 @@ def forward(cfg: TransformerConfig, params: dict, tokens: jax.Array,
     return logits
 
 
+def token_xent(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean cross-entropy of int targets under fp32 logits — the one
+    loss tail shared by every model family / parallelism schedule."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def default_optimizer(learning_rate: float):
+    """The framework-standard AdamW recipe (shared by all train steps)."""
+    import optax
+
+    return optax.adamw(learning_rate, b1=0.9, b2=0.95, weight_decay=0.1)
+
+
 def next_token_loss(cfg: TransformerConfig, params: dict,
                     tokens: jax.Array, constrain=lambda x: x) -> jax.Array:
     """Causal LM loss: predict tokens[:, 1:] from tokens[:, :-1]."""
     logits = forward(cfg, params, tokens[:, :-1], constrain)
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    return token_xent(logits, tokens[:, 1:])
 
 
 # -- training step ----------------------------------------------------------
@@ -245,7 +257,7 @@ def make_train_step(cfg: TransformerConfig, learning_rate: float = 3e-4,
     """
     import optax
 
-    tx = optax.adamw(learning_rate, b1=0.9, b2=0.95, weight_decay=0.1)
+    tx = default_optimizer(learning_rate)
 
     def init_opt_state(params):
         return tx.init(params)

@@ -1,3 +1,10 @@
+from pbs_tpu.parallel.expert import (
+    expert_constrainer,
+    make_sharded_moe_train,
+    moe_batch_sharding,
+    moe_param_specs,
+    shard_moe_params,
+)
 from pbs_tpu.parallel.gang import GangMonitor, anti_stack_pick
 from pbs_tpu.parallel.mesh import make_mesh, split_devices
 from pbs_tpu.parallel.ring_attention import ring_attention
@@ -11,6 +18,11 @@ from pbs_tpu.parallel.sharding import (
 
 __all__ = [
     "GangMonitor",
+    "expert_constrainer",
+    "make_sharded_moe_train",
+    "moe_batch_sharding",
+    "moe_param_specs",
+    "shard_moe_params",
     "anti_stack_pick",
     "make_mesh",
     "ring_attention",

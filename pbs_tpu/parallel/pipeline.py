@@ -1,0 +1,199 @@
+"""Pipeline parallelism: GPipe-style microbatch pipeline over a ``pp`` axis.
+
+Where dp/tp/sp/ep are pure annotation (XLA infers the collectives),
+pipelining is inherently a *schedule* — so this is the one place the
+framework drops into ``shard_map`` and moves activations explicitly with
+``lax.ppermute`` over the ICI ring (SURVEY.md §2e: the reference's only
+"pipeline" analog is vCPU migration between pCPUs; this is the TPU-first
+replacement, not a translation).
+
+Design:
+
+- The layer-stacked params (L, ...) are sharded ``P('pp', ...)``: stage i
+  holds layers [i*L/pp, (i+1)*L/pp) — no resharding, the scan-over-layers
+  layout *is* the pipeline layout.
+- Inside ``shard_map`` each tick runs every stage on its current
+  microbatch, then ``ppermute`` shifts activations one stage down the
+  ring. M microbatches drain in M + pp - 1 ticks (the GPipe bubble;
+  bubble fraction = (pp-1)/(M+pp-1), amortized by raising M).
+- The batch stays sharded over ``dp`` *inside* the manual region (specs
+  carry both axes), so dp x pp compose; tp/sp can ride the remaining
+  in-stage axes via the activation constrainer as in the dense path.
+- Backward is plain autodiff through the schedule: ppermute transposes
+  to the reverse permute, param cotangents psum over dp at the shard_map
+  boundary. Stage bodies are rematerialized (``jax.checkpoint``) so live
+  activation memory is one microbatch per in-flight tick, the GPipe
+  memory contract.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pbs_tpu.models.transformer import (
+    TransformerConfig,
+    default_optimizer,
+    layer_body,
+    rms_norm,
+    rope_tables,
+    token_xent,
+)
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+
+def pipeline_layer_specs() -> dict:
+    """Specs for the layer-stacked subtree: stage-sharded on axis 0."""
+    return {
+        "attn_norm": P("pp", None),
+        "wq": P("pp", None, None),
+        "wk": P("pp", None, None),
+        "wv": P("pp", None, None),
+        "wo": P("pp", None, None),
+        "mlp_norm": P("pp", None),
+        "w1": P("pp", None, None),
+        "w3": P("pp", None, None),
+        "w2": P("pp", None, None),
+    }
+
+
+def pipeline_param_specs(cfg: TransformerConfig) -> dict:
+    """Full-tree specs: embed/head replicated (they run outside the
+    manual region, dp-sharded by activation), blocks stage-sharded."""
+    return {
+        "embed": P(None, None),
+        "layers": pipeline_layer_specs(),
+        "final_norm": P(None),
+        "head": P(None, None),
+    }
+
+
+def shard_pipeline_params(params: dict, mesh: Mesh,
+                          cfg: TransformerConfig) -> dict:
+    shardings = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), pipeline_param_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return jax.tree.map(jax.device_put, params, shardings)
+
+
+def _pipe_blocks(cfg: TransformerConfig, mesh: Mesh, n_micro: int):
+    """Builds the shard_map'd pipelined block-stack: (layers, xs) -> ys
+    with xs/ys (M, mb, S, d) dp-sharded on mb."""
+    pp = mesh.shape["pp"]
+    if cfg.n_layers % pp != 0:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by pp={pp}"
+        )
+
+    def pipe(layers, xs):
+        # Manual per-device view: layers (L/pp, ...), xs (M, mb/dp, S, d).
+        idx = jax.lax.axis_index("pp")
+        S = xs.shape[2]
+        cos, sin = rope_tables(cfg, S)
+
+        def stage(x):
+            def scan_fn(x, lp):
+                return layer_body(cfg, x, lp, cos, sin, lambda a: a), None
+
+            x, _ = jax.lax.scan(jax.checkpoint(scan_fn), x, layers)
+            return x
+
+        perm = [(i, i + 1) for i in range(pp - 1)]
+        state = jnp.zeros_like(xs[0])
+        outs = jnp.zeros_like(xs)
+        for t in range(n_micro + pp - 1):  # static GPipe schedule
+            x_in = jnp.where(idx == 0, xs[min(t, n_micro - 1)], state)
+            y = stage(x_in)
+            if t >= pp - 1:
+                # Only the last stage's writes are ever read back.
+                outs = outs.at[t - pp + 1].set(y)
+            if perm:
+                state = jax.lax.ppermute(y, "pp", perm)
+        return outs
+
+    kwargs = dict(
+        mesh=mesh,
+        in_specs=(pipeline_layer_specs(), P(None, "dp", None, None)),
+        out_specs=P("pp", "dp", None, None),
+    )
+    try:  # replication-check kwarg was renamed check_rep -> check_vma
+        return shard_map(pipe, check_vma=False, **kwargs)
+    except TypeError:  # pragma: no cover - older jax
+        return shard_map(pipe, check_rep=False, **kwargs)
+
+
+def make_pipelined_loss(cfg: TransformerConfig, mesh: Mesh, n_micro: int):
+    """Causal-LM loss with the block stack pipelined over ``pp``.
+
+    Embedding/head/loss run outside the manual region under plain dp
+    sharding; only the layer stack is scheduled.
+    """
+    pipe = _pipe_blocks(cfg, mesh, n_micro)
+    mb_spec = NamedSharding(mesh, P(None, "dp", None, None))
+
+    def loss_fn(params, tokens):
+        B, S_full = tokens.shape
+        inp = tokens[:, :-1]
+        S = S_full - 1
+        if B % n_micro != 0:
+            raise ValueError(f"batch {B} not divisible by M={n_micro}")
+        mb = B // n_micro
+        dt = cfg.dtype
+        x = params["embed"].astype(dt)[inp]
+        xs = jax.lax.with_sharding_constraint(
+            x.reshape(n_micro, mb, S, cfg.d_model), mb_spec
+        )
+        ys = pipe(params["layers"], xs)
+        # Global ys is (pp*M, mb, S, d); the final M rows live on the
+        # last stage — slicing them is a device-local read, not a gather.
+        y = ys[-n_micro:].reshape(B, S, cfg.d_model)
+        y = rms_norm(y, params["final_norm"], cfg.norm_eps)
+        logits = (y @ params["head"].astype(dt)).astype(jnp.float32)
+        return token_xent(logits, tokens[:, 1:])
+
+    return loss_fn
+
+
+def make_pipelined_train(
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    n_micro: int = 4,
+    learning_rate: float = 3e-4,
+    key: jax.Array | None = None,
+):
+    """Fully-sharded dp x pp train state + jitted step."""
+    import optax
+
+    from pbs_tpu.models.transformer import init_params
+
+    key = key if key is not None else jax.random.PRNGKey(0)
+    loss_fn = make_pipelined_loss(cfg, mesh, n_micro)
+    tx = default_optimizer(learning_rate)
+
+    def train_step(state, tokens):
+        params, opt_state, step = state
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        ntok = tokens.shape[0] * (tokens.shape[1] - 1)
+        return (params, opt_state, step + 1), {
+            "loss": loss, "tokens": jnp.asarray(ntok, jnp.int32),
+        }
+
+    params = shard_pipeline_params(init_params(cfg, key), mesh, cfg)
+    opt_state = jax.jit(tx.init)(params)
+    state = (params, opt_state, jax.device_put(0))
+    step = jax.jit(train_step, donate_argnums=(0,))
+    return state, step
+
+
+def pipeline_batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("dp", None))

@@ -80,6 +80,44 @@ def test_replicator_epochs_and_retention(tmp_path):
     assert int(restored["step"]) == 5
 
 
+def test_replicator_resumes_epoch_numbering(tmp_path):
+    """A restarted replicator must number past retained epochs — else its
+    fresh checkpoints sort below the old ones and get pruned as oldest."""
+    base = str(tmp_path / "remus")
+    counter = {"n": 0}
+
+    def snap():
+        counter["n"] += 1
+        return {"step": np.int64(counter["n"])}, {}, None
+
+    rep = Replicator(base, snap, keep=2)
+    for _ in range(5):
+        rep.replicate_once()
+
+    rep2 = Replicator(base, snap, keep=2)  # process restart
+    rep2.replicate_once()
+    restored, _ = restore_checkpoint(
+        rep2.latest(), like={"step": np.int64(0)}
+    )
+    assert int(restored["step"]) == 6  # newest, not the stale epoch
+
+
+def test_replicator_records_failures(tmp_path):
+    def bad_snap():
+        raise OSError("disk full")
+
+    rep = Replicator(str(tmp_path / "r"), bad_snap, period_s=0.01)
+    rep.start()
+    import time
+
+    deadline = time.time() + 2.0
+    while rep.failures == 0 and time.time() < deadline:
+        time.sleep(0.01)
+    rep.stop()
+    assert rep.failures > 0
+    assert "disk full" in rep.last_error
+
+
 # -- store ------------------------------------------------------------------
 
 
