@@ -1,0 +1,31 @@
+"""Distributed control plane: controller <-> per-host agents over DCN.
+
+The data plane (tensors) always rides XLA collectives over ICI/DCN
+inside compiled programs; this package is only the *control* plane —
+the toolstack surface (xend/xl, ``tools/python``, ``tools/libxl``)
+re-expressed as a framed-JSON RPC between one controller and one agent
+per host, with multicall batching (``xen/common/multicall.c``),
+heartbeat failure detection (``tools/misc/xenwatchdogd.c``), and
+restore-elsewhere recovery (``tools/remus``).
+"""
+
+from pbs_tpu.dist.agent import Agent, sim_workload
+from pbs_tpu.dist.controller import (
+    ClusterRoundError,
+    Controller,
+    JobRecord,
+    MemberRef,
+)
+from pbs_tpu.dist.rpc import RpcClient, RpcError, RpcServer
+
+__all__ = [
+    "Agent",
+    "ClusterRoundError",
+    "Controller",
+    "JobRecord",
+    "MemberRef",
+    "RpcClient",
+    "RpcError",
+    "RpcServer",
+    "sim_workload",
+]

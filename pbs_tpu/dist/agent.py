@@ -1,0 +1,180 @@
+"""Per-host agent: exposes one host's partition over the control plane.
+
+Reference mapping: the xend management daemon (``tools/python``, one per
+host) plus the privcmd hypercall surface — every operation the ``xl``/
+``xm`` toolstack performs on a host (create/destroy/pause/unpause a
+domain, adjust scheduler parameters, read telemetry, dump state) becomes
+a registered RPC op against the host's :class:`Partition`. Workload
+*factories* stand in for domain images: the controller names a workload,
+the agent instantiates it locally (like ``xl create`` building a guest
+from a config).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pbs_tpu.dist.rpc import RpcServer
+from pbs_tpu.runtime.job import Job, SchedParams
+from pbs_tpu.runtime.partition import Partition
+from pbs_tpu.telemetry.counters import Counter
+from pbs_tpu.telemetry.source import SimBackend, SimPhase, SimProfile
+
+WorkloadFactory = Callable[[Partition, str, dict], Job]
+
+
+def sim_workload(partition: Partition, job_name: str, spec: dict) -> Job:
+    """Default workload: a synthetic SimBackend job.
+
+    spec keys: phases=[{steps, step_time_ns, stall_frac, ...}] or flat
+    SimPhase kwargs; sched={weight, cap, tslice_us, boost_on_wake};
+    n_contexts; gang; max_steps.
+    """
+    if not isinstance(partition.source, SimBackend):
+        raise TypeError("sim workload needs a SimBackend partition")
+    if "phases" in spec:
+        prof = SimProfile([SimPhase(**p) for p in spec["phases"]])
+    else:
+        keys = ("step_time_ns", "hbm_bytes", "stall_frac",
+                "collective_wait_ns", "flops", "tokens")
+        prof = SimProfile.steady(**{k: spec[k] for k in keys if k in spec})
+    partition.source.register(job_name, prof)
+    job = Job(
+        job_name,
+        params=SchedParams(**spec.get("sched", {})),
+        n_contexts=int(spec.get("n_contexts", 1)),
+        gang=bool(spec.get("gang", False)),
+        max_steps=spec.get("max_steps"),
+    )
+    return partition.add_job(job)
+
+
+class Agent:
+    """One host's control-plane endpoint."""
+
+    def __init__(
+        self,
+        name: str,
+        partition: Partition | None = None,
+        workloads: dict[str, WorkloadFactory] | None = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        n_executors: int = 2,
+        scheduler: str = "credit",
+    ):
+        self.name = name
+        if partition is None:
+            partition = Partition(
+                f"{name}.pool", SimBackend(), scheduler=scheduler,
+                n_executors=n_executors,
+            )
+        self.partition = partition
+        self.workloads: dict[str, WorkloadFactory] = {"sim": sim_workload}
+        self.workloads.update(workloads or {})
+        self.server = RpcServer(host=host, port=port)
+        for op in ("info", "create_job", "remove_job", "sched_setparams",
+                   "pause_job", "unpause_job", "run", "dump", "telemetry",
+                   "list_jobs"):
+            self.server.register(op, getattr(self, "op_" + op))
+
+    # -- ops (the per-host hypercall surface) ----------------------------
+
+    def op_info(self) -> dict:
+        part = self.partition
+        return {
+            "agent": self.name,
+            "partition": part.name,
+            "scheduler": part.scheduler.name,
+            "n_executors": len(part.executors),
+            "n_jobs": len(part.jobs),
+            "n_contexts": sum(len(j.contexts) for j in part.jobs),
+        }
+
+    def op_create_job(self, job: str, workload: str = "sim",
+                      spec: dict | None = None) -> dict:
+        factory = self.workloads.get(workload)
+        if factory is None:
+            raise LookupError(f"unknown workload {workload!r}")
+        if any(j.name == job for j in self.partition.jobs):
+            raise ValueError(f"job {job!r} already exists")
+        j = factory(self.partition, job, spec or {})
+        return {"job": j.name, "n_contexts": len(j.contexts)}
+
+    def op_remove_job(self, job: str) -> bool:
+        self.partition.remove_job(self.partition.job(job))
+        return True
+
+    def op_sched_setparams(self, job: str, weight: int | None = None,
+                           cap: int | None = None,
+                           tslice_us: int | None = None) -> dict:
+        j = self.partition.job(job)
+        changes = {k: int(v) for k, v in
+                   (("weight", weight), ("cap", cap), ("tslice_us", tslice_us))
+                   if v is not None}
+        # Through the scheduler's control-plane hook (csched_dom_cntl),
+        # so policies that react to param changes see them.
+        self.partition.scheduler.adjust_job(j, **changes)
+        p = j.params
+        return {"weight": p.weight, "cap": p.cap, "tslice_us": p.tslice_us}
+
+    def op_pause_job(self, job: str) -> bool:
+        self.partition.sleep_job(self.partition.job(job))
+        return True
+
+    def op_unpause_job(self, job: str) -> bool:
+        self.partition.wake_job(self.partition.job(job))
+        return True
+
+    def op_run(self, max_rounds: int | None = None,
+               for_us: int | None = None) -> int:
+        until = None
+        if for_us is not None:
+            until = self.partition.clock.now_ns() + 1000 * int(for_us)
+        return self.partition.run(until_ns=until, max_rounds=max_rounds)
+
+    def op_dump(self) -> dict:
+        return self.partition.dump()
+
+    def op_list_jobs(self) -> list[dict]:
+        return [
+            {
+                "job": j.name,
+                "weight": j.params.weight,
+                "cap": j.params.cap,
+                "tslice_us": j.params.tslice_us,
+                "gang": j.gang,
+                "steps": j.steps_retired(),
+                "finished": j.finished(),
+            }
+            for j in self.partition.jobs
+        ]
+
+    def op_telemetry(self, job: str) -> dict:
+        j = self.partition.job(job)
+        return {
+            "job": j.name,
+            "contexts": [
+                {
+                    "ctx": c.name,
+                    "sched_count": c.sched_count,
+                    "counters": {
+                        Counter(i).name.lower(): int(v)
+                        for i, v in enumerate(c.counters)
+                    },
+                }
+                for c in j.contexts
+            ],
+        }
+
+    # -- lifecycle -------------------------------------------------------
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self.server.address
+
+    def start(self) -> "Agent":
+        self.server.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.stop()

@@ -1,0 +1,335 @@
+"""Cluster controller: placement, gang coordination, failure recovery.
+
+Reference mapping: the controller is the cluster-wide analog of the
+toolstack brain — ``xl``/xend issuing domain lifecycle and scheduler
+ops (``tools/libxl/xl_cmdimpl.c:4805-4896``), plus the pieces the
+reference only has in single-host form, generalized across hosts:
+
+- *Placement* re-expresses the atc variant's least-loaded, anti-stacking
+  vCPU placement (``sched_credit_atc.c:545-570``): gang members are
+  never co-located on one host, because a gang spanning hosts dies by
+  lock-holder preemption if any one host stalls (SURVEY.md §7 risks).
+- *Gang rounds* are barrier-coordinated lockstep quanta across agents —
+  the distributed form of "never split a ring across a preemption
+  boundary".
+- *Failure detection* is the xenwatchdogd / heartbeat analog
+  (``tools/misc/xenwatchdogd.c``): agents that miss pings are declared
+  dead and their jobs re-placed on live hosts (recovery = restore
+  elsewhere, exactly the reference's Remus model, ``tools/remus``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any
+
+from pbs_tpu.dist.rpc import RpcClient, RpcError
+
+
+class ClusterRoundError(RuntimeError):
+    """One or more agents failed during a lockstep round."""
+
+    def __init__(self, errors: dict[str, Exception], quanta: dict[str, int]):
+        super().__init__(
+            "round failed on " + ", ".join(sorted(errors)))
+        self.errors = errors
+        self.quanta = quanta
+
+
+@dataclasses.dataclass
+class AgentHandle:
+    name: str
+    client: RpcClient
+    alive: bool = True
+    missed: int = 0
+    info: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class MemberRef:
+    agent: str
+    job: str  # job name on that agent
+
+
+@dataclasses.dataclass
+class JobRecord:
+    """Controller-side record of a (possibly multi-host) job."""
+
+    name: str
+    workload: str
+    spec: dict
+    members: list[MemberRef]
+    gang: bool = False
+
+
+class Controller:
+    def __init__(self, dead_after_missed: int = 2):
+        self.agents: dict[str, AgentHandle] = {}
+        self.jobs: dict[str, JobRecord] = {}
+        self.dead_after_missed = dead_after_missed
+        self.last_round_errors: dict[str, Exception] = {}
+
+    # -- membership ------------------------------------------------------
+
+    def add_agent(self, name: str, address: tuple[str, int]) -> AgentHandle:
+        h = AgentHandle(name, RpcClient(address))
+        h.info = h.client.call("info")
+        self.agents[name] = h
+        return h
+
+    def live_agents(self) -> list[AgentHandle]:
+        return [h for h in self.agents.values() if h.alive]
+
+    # -- failure detection (xenwatchdogd analog) -------------------------
+
+    def heartbeat(self) -> dict[str, bool]:
+        """Ping every agent once; mark dead after N consecutive misses.
+        Returns {agent: alive}."""
+        for h in self.agents.values():
+            if h.client.try_ping():
+                if not h.alive and not self._reconcile(h):
+                    # Fence failed: keep it dead; a later heartbeat
+                    # retries the fence before readmission.
+                    continue
+                h.missed = 0
+                h.alive = True
+            else:
+                h.missed += 1
+                if h.missed >= self.dead_after_missed:
+                    h.alive = False
+        return {name: h.alive for name, h in self.agents.items()}
+
+    def _reconcile(self, h: AgentHandle) -> bool:
+        """Remove jobs on ``h`` the controller no longer maps there.
+        Returns True only if the host is verifiably clean — an agent
+        declared dead may have had its jobs re-placed by recover(), and
+        readmitting it with a stale member still running is split-brain
+        (the failure mode Remus fences with its commit protocol,
+        tools/remus)."""
+        expected = {m.job for rec in self.jobs.values()
+                    for m in rec.members if m.agent == h.name}
+        try:
+            present = {j["job"] for j in h.client.call("list_jobs")}
+            stale = present - expected
+            if stale:
+                results = h.client.multicall(
+                    [("remove_job", {"job": j}) for j in sorted(stale)])
+                if not all(r.get("ok") for r in results):
+                    return False
+        except Exception:  # noqa: BLE001 — it may have died again
+            h.missed += 1
+            return False
+        return True
+
+    # -- placement -------------------------------------------------------
+
+    def _load(self, h: AgentHandle) -> tuple[int, int]:
+        try:
+            info = h.client.call("info")
+            h.info = info
+            return (info["n_contexts"], info["n_jobs"])
+        except Exception:  # noqa: BLE001 — treated as a missed heartbeat
+            h.missed += 1
+            if h.missed >= self.dead_after_missed:
+                h.alive = False
+            return (1 << 30, 1 << 30)
+
+    def place(self, n: int, distinct: bool = False) -> list[AgentHandle]:
+        """Pick n target agents, least-loaded first. ``distinct`` forces
+        n different hosts (gang anti-stacking); otherwise hosts repeat in
+        load order."""
+        live = self.live_agents()
+        if not live:
+            raise RuntimeError("no live agents")
+        ranked = sorted(live, key=self._load)
+        # _load() may have just marked hosts dead; never place on them.
+        ranked = [h for h in ranked if h.alive]
+        if not ranked:
+            raise RuntimeError("no live agents")
+        if distinct:
+            if len(ranked) < n:
+                raise RuntimeError(
+                    f"gang of {n} needs {n} live hosts, have {len(ranked)}")
+            return ranked[:n]
+        return [ranked[i % len(ranked)] for i in range(n)]
+
+    # -- job lifecycle ---------------------------------------------------
+
+    def create_job(
+        self,
+        name: str,
+        workload: str = "sim",
+        spec: dict | None = None,
+        n_members: int = 1,
+        gang: bool = False,
+    ) -> JobRecord:
+        """Create a job with ``n_members`` member jobs placed across
+        agents; gang members land on distinct hosts."""
+        if name in self.jobs:
+            raise ValueError(f"job {name!r} already exists")
+        spec = dict(spec or {})
+        targets = self.place(n_members, distinct=gang and n_members > 1)
+        members: list[MemberRef] = []
+        try:
+            for i, h in enumerate(targets):
+                member_name = name if n_members == 1 else f"{name}.{i}"
+                h.client.call("create_job", job=member_name,
+                              workload=workload, spec=spec)
+                members.append(MemberRef(h.name, member_name))
+        except Exception:
+            # Roll back already-placed members so a failed fan-out
+            # leaves no orphans and the name stays retryable.
+            for m in members:
+                try:
+                    self.agents[m.agent].client.call("remove_job", job=m.job)
+                except Exception:  # noqa: BLE001 — host may be dead too
+                    pass
+            raise
+        rec = JobRecord(name, workload, spec, members, gang=gang)
+        self.jobs[name] = rec
+        return rec
+
+    def remove_job(self, name: str) -> None:
+        rec = self.jobs.pop(name)
+        for m in rec.members:
+            h = self.agents.get(m.agent)
+            if h is None or not h.alive:
+                continue
+            try:
+                h.client.call("remove_job", job=m.job)
+            except Exception:  # noqa: BLE001 — host may have just died
+                pass
+
+    def sched_setparams(self, name: str, **params: Any) -> None:
+        """One batched multicall per agent (the multicall.c pattern)."""
+        rec = self.jobs[name]
+        by_agent: dict[str, list] = {}
+        for m in rec.members:
+            by_agent.setdefault(m.agent, []).append(
+                ("sched_setparams", {"job": m.job, **params}))
+        for agent, calls in by_agent.items():
+            for call, r in zip(
+                    calls, self.agents[agent].client.multicall(calls)):
+                if not r.get("ok"):
+                    raise RpcError(f"{agent}:{call[0]}",
+                                   r.get("error", "?"), r.get("message", ""))
+
+    # -- gang rounds (barrier-coordinated lockstep) ----------------------
+
+    def run_round(self, max_rounds: int = 64,
+                  strict: bool = True) -> dict[str, int]:
+        """One cluster round: every live agent runs up to ``max_rounds``
+        scheduler rounds concurrently, with a barrier at the end — no
+        agent starts round k+1 until all finished round k. This is the
+        distributed gang-switch: a ring job spanning hosts advances in
+        lockstep, so no member outruns a preempted peer.
+
+        A failed agent breaks the lockstep guarantee, so with
+        ``strict`` (default) the round raises :class:`ClusterRoundError`
+        after the barrier; the caller heartbeats/recovers and retries.
+        With ``strict=False`` errors are kept on ``self.last_round_errors``
+        and surviving agents' quanta are returned."""
+        quanta: dict[str, int] = {}
+        errs: dict[str, Exception] = {}
+
+        def _one(h: AgentHandle) -> None:
+            try:
+                quanta[h.name] = h.client.call(
+                    "run", _timeout=600.0, max_rounds=max_rounds)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errs[h.name] = e
+                h.missed += 1
+                if h.missed >= self.dead_after_missed:
+                    h.alive = False
+
+        threads = [threading.Thread(target=_one, args=(h,), daemon=True)
+                   for h in self.live_agents()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()  # <- the barrier
+        self.last_round_errors = errs
+        if errs and strict:
+            raise ClusterRoundError(errs, quanta)
+        return quanta
+
+    def run_rounds(self, n: int, max_rounds: int = 64,
+                   strict: bool = True) -> int:
+        total = 0
+        for _ in range(n):
+            total += sum(
+                self.run_round(max_rounds=max_rounds, strict=strict).values())
+        return total
+
+    # -- recovery (Remus model: restore elsewhere) -----------------------
+
+    def recover(self) -> list[str]:
+        """Re-place member jobs stranded on dead agents. Returns the
+        names of jobs that were moved. Sim/stateless members restart from
+        their spec; checkpointed workloads resume from their last epoch
+        (the workload factory reads the checkpoint — same contract as
+        ``xc_domain_restore``)."""
+        moved = []
+        for rec in self.jobs.values():
+            for m in rec.members:
+                h = self.agents.get(m.agent)
+                if h is not None and h.alive:
+                    continue
+                live = self.live_agents()
+                if not live:
+                    raise RuntimeError(f"no live host for {rec.name}/{m.job}")
+                # Prefer a host with no sibling (anti-stacking); fall
+                # back to least-loaded when the cluster has shrunk below
+                # the gang width — same fallback as anti_stack_pick
+                # returning None (sched_credit_atc.c:545-570).
+                exclude = {mm.agent for mm in rec.members if mm is not m}
+                candidates = [a for a in live
+                              if not (rec.gang and a.name in exclude)]
+                ranked = sorted(candidates or live, key=self._load)
+                # _load() may have just marked hosts dead (place() does
+                # the same re-filter).
+                ranked = [a for a in ranked if a.alive]
+                if not ranked:
+                    raise RuntimeError(f"no live host for {rec.name}/{m.job}")
+                target = ranked[0]
+                target.client.call("create_job", job=m.job,
+                                   workload=rec.workload, spec=rec.spec)
+                m.agent = target.name
+                moved.append(m.job)
+        return moved
+
+    # -- observability ---------------------------------------------------
+
+    def cluster_dump(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"agents": {}, "jobs": {}}
+        for name, h in self.agents.items():
+            if not h.alive:
+                out["agents"][name] = {"alive": False}
+                continue
+            try:
+                out["agents"][name] = {"alive": True, **h.client.call("dump")}
+            except Exception as e:  # noqa: BLE001 — snapshot best-effort
+                out["agents"][name] = {"alive": False, "error": str(e)}
+        for jname, rec in self.jobs.items():
+            out["jobs"][jname] = {
+                "workload": rec.workload,
+                "gang": rec.gang,
+                "members": [{"agent": m.agent, "job": m.job}
+                            for m in rec.members],
+            }
+        return out
+
+    def job_steps(self, name: str) -> dict[str, int]:
+        """Per-member retired steps (cluster progress view)."""
+        rec = self.jobs[name]
+        steps = {}
+        for m in rec.members:
+            tel = self.agents[m.agent].client.call("telemetry", job=m.job)
+            steps[m.job] = sum(c["counters"]["steps_retired"]
+                               for c in tel["contexts"])
+        return steps
+
+    def close(self) -> None:
+        for h in self.agents.values():
+            h.client.close()

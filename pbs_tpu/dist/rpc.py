@@ -1,0 +1,238 @@
+"""DCN control-plane RPC: length-prefixed JSON over TCP.
+
+Reference mapping: the dom0 toolstack reaches the hypervisor through
+privcmd ioctls -> hypercalls and reaches remote hosts over plain TCP
+(live migration, ``tools/libxc/xc_domain_save.c``); batches of hypercalls
+are issued through the multicall interface (``xen/common/multicall.c``)
+to amortize boundary crossings. Here the boundary is the data-center
+network between the controller and per-host agents, so the same three
+ideas appear as: a tiny framed-JSON RPC (the hypercall ABI), a
+server-side op table (the hypercall dispatch table,
+``arch/x86/x86_64/entry.S:663-770``), and a first-class ``multicall``
+op executing a batch in one round trip.
+
+Deliberately dependency-free (stdlib sockets): the data plane never
+touches this path — tensors move over ICI/DCN inside XLA collectives;
+this carries only control messages, telemetry summaries, and checkpoint
+metadata.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Callable
+
+MAX_MSG_BYTES = 64 << 20
+_LEN = struct.Struct(">I")
+
+
+class RpcError(Exception):
+    """Remote op raised; .remote_type / .remote_message carry details."""
+
+    def __init__(self, op: str, remote_type: str, remote_message: str):
+        super().__init__(f"{op}: {remote_type}: {remote_message}")
+        self.op = op
+        self.remote_type = remote_type
+        self.remote_message = remote_message
+
+
+def send_msg(sock: socket.socket, obj: Any) -> None:
+    data = json.dumps(obj, separators=(",", ":")).encode()
+    if len(data) > MAX_MSG_BYTES:
+        raise ValueError(f"message too large: {len(data)} bytes")
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    hdr = _recv_exact(sock, _LEN.size)
+    (n,) = _LEN.unpack(hdr)
+    if n > MAX_MSG_BYTES:
+        raise ValueError(f"message too large: {n} bytes")
+    return json.loads(_recv_exact(sock, n).decode())
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class RpcServer:
+    """Threaded TCP server with a registered op table.
+
+    Dispatch is serialized by a single lock — the moral equivalent of
+    entering the hypervisor: op handlers may freely mutate the hosted
+    partition without their own locking.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.ops: dict[str, Callable[..., Any]] = {}
+        self._lock = threading.Lock()
+        self._conns: set[socket.socket] = set()
+        # Liveness probes must answer while a long op holds the dispatch
+        # lock — otherwise a busy host reads as dead and gets its jobs
+        # double-placed (the NMI watchdog answers from interrupt context
+        # for the same reason, xen/arch/x86/nmi.c).
+        self._lockfree_ops = {"ping", "ops"}
+        self.register("ping", lambda: "pong")
+        self.register("ops", lambda: sorted(self.ops))
+
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:  # one connection = many requests
+                sock = self.request
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                with outer._lock:
+                    outer._conns.add(sock)
+                try:
+                    while True:
+                        req = recv_msg(sock)
+                        send_msg(sock, outer._handle(req))
+                except (ConnectionError, OSError, ValueError):
+                    return
+                finally:
+                    with outer._lock:
+                        outer._conns.discard(sock)
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        self.address: tuple[str, int] = self._server.server_address[:2]
+        self._thread: threading.Thread | None = None
+
+    # -- op table (hypercall registry) -----------------------------------
+
+    def register(self, name: str, fn: Callable[..., Any]) -> None:
+        self.ops[name] = fn
+
+    def _handle(self, req: Any) -> dict:
+        if not isinstance(req, dict) or "op" not in req:
+            return {"ok": False, "error": "ValueError", "message": "bad request"}
+        op = req["op"]
+        kwargs = req.get("args") or {}
+        if op == "multicall":
+            # xen/common/multicall.c: execute each entry in order; a
+            # failing entry doesn't abort the batch — per-entry status.
+            results = [self._call_one(c.get("op"), c.get("args") or {})
+                       for c in req.get("calls", [])]
+            return {"ok": True, "result": results}
+        return self._call_one(op, kwargs)
+
+    def _call_one(self, op: str, kwargs: dict) -> dict:
+        fn = self.ops.get(op)
+        if fn is None:
+            return {"ok": False, "error": "LookupError",
+                    "message": f"unknown op {op!r}"}
+        try:
+            if op in self._lockfree_ops:
+                return {"ok": True, "result": fn(**kwargs)}
+            with self._lock:
+                return {"ok": True, "result": fn(**kwargs)}
+        except Exception as e:  # noqa: BLE001 — marshalled to caller
+            return {"ok": False, "error": type(e).__name__, "message": str(e)}
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "RpcServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True, name=f"rpc-server-{self.address[1]}",
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        # Handler threads outlive shutdown(); sever their connections so
+        # a stopped host really goes silent (heartbeats must fail).
+        with self._lock:
+            conns = list(self._conns)
+        for s in conns:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            s.close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+
+class RpcClient:
+    """Persistent connection to one RpcServer."""
+
+    def __init__(self, address: tuple[str, int], timeout_s: float = 5.0):
+        self.address = (address[0], int(address[1]))
+        self.timeout_s = timeout_s
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+
+    def _ensure(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection(self.address, timeout=self.timeout_s)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def _roundtrip(self, req: dict, timeout_s: float | None = None) -> Any:
+        with self._lock:
+            try:
+                sock = self._ensure()
+                if timeout_s is not None:
+                    sock.settimeout(timeout_s)
+                try:
+                    send_msg(sock, req)
+                    return recv_msg(sock)
+                finally:
+                    if timeout_s is not None:
+                        sock.settimeout(self.timeout_s)
+            except (ConnectionError, OSError):
+                self.close()
+                raise
+
+    def call(self, op: str, _timeout: float | None = None,
+             **kwargs: Any) -> Any:
+        """One op. ``_timeout`` overrides the connection timeout for this
+        call only (long-running ops like agent ``run``)."""
+        resp = self._roundtrip({"op": op, "args": kwargs},
+                               timeout_s=_timeout)
+        if not resp.get("ok"):
+            raise RpcError(op, resp.get("error", "?"), resp.get("message", ""))
+        return resp["result"]
+
+    def multicall(self, calls: list[tuple[str, dict]]) -> list[Any]:
+        """Batch of (op, kwargs) in one round trip; per-entry results.
+        Raises only on transport failure — op errors come back in-band
+        as ``{"ok": False, ...}`` entries, like multicall entry status."""
+        resp = self._roundtrip({
+            "op": "multicall",
+            "calls": [{"op": op, "args": kw} for op, kw in calls],
+        })
+        if not resp.get("ok"):
+            raise RpcError("multicall", resp.get("error", "?"),
+                           resp.get("message", ""))
+        return resp["result"]
+
+    def try_ping(self) -> bool:
+        try:
+            return self.call("ping") == "pong"
+        except Exception:  # noqa: BLE001 — liveness probe
+            return False
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None

@@ -1,0 +1,188 @@
+"""Distributed control plane: RPC, agents, controller, failure recovery.
+
+The reference's only multi-node story is multiple domUs on one host plus
+live migration over localhost (SURVEY.md §4 "multi-node without a
+cluster"); same spirit here — real TCP sockets, multiple agents, one
+process.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from pbs_tpu.dist import Agent, Controller, RpcClient, RpcError, RpcServer
+
+
+@pytest.fixture()
+def cluster():
+    agents = [Agent(f"host{i}").start() for i in range(3)]
+    ctl = Controller()
+    for a in agents:
+        ctl.add_agent(a.name, a.address)
+    yield ctl, agents
+    ctl.close()
+    for a in agents:
+        a.stop()
+
+
+def test_rpc_roundtrip_and_errors():
+    srv = RpcServer().start()
+    srv.register("add", lambda a, b: a + b)
+    srv.register("boom", lambda: 1 / 0)
+    try:
+        cli = RpcClient(srv.address)
+        assert cli.call("ping") == "pong"
+        assert cli.call("add", a=2, b=3) == 5
+        with pytest.raises(RpcError) as ei:
+            cli.call("boom")
+        assert ei.value.remote_type == "ZeroDivisionError"
+        with pytest.raises(RpcError):
+            cli.call("nope")
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_multicall_batches_with_per_entry_status():
+    srv = RpcServer().start()
+    srv.register("add", lambda a, b: a + b)
+    try:
+        cli = RpcClient(srv.address)
+        res = cli.multicall([
+            ("add", {"a": 1, "b": 2}),
+            ("missing", {}),
+            ("add", {"a": 10, "b": 20}),
+        ])
+        assert res[0] == {"ok": True, "result": 3}
+        assert res[1]["ok"] is False  # entry fails, batch continues
+        assert res[2] == {"ok": True, "result": 30}
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_agent_job_lifecycle_and_telemetry():
+    a = Agent("solo").start()
+    try:
+        cli = RpcClient(a.address)
+        cli.call("create_job", job="train", workload="sim",
+                 spec={"step_time_ns": 1_000_000, "max_steps": 50})
+        assert cli.call("run", max_rounds=200) > 0
+        tel = cli.call("telemetry", job="train")
+        steps = sum(c["counters"]["steps_retired"] for c in tel["contexts"])
+        assert steps == 50
+        jobs = cli.call("list_jobs")
+        assert jobs[0]["finished"] is True
+        # sched params round-trip (xl sched-credit surface)
+        out = cli.call("sched_setparams", job="train", weight=512, cap=50)
+        assert (out["weight"], out["cap"]) == (512, 50)
+        assert cli.call("remove_job", job="train") is True
+        cli.close()
+    finally:
+        a.stop()
+
+
+def test_controller_places_gang_on_distinct_hosts(cluster):
+    ctl, _ = cluster
+    rec = ctl.create_job("ring", spec={"step_time_ns": 500_000},
+                         n_members=3, gang=True)
+    hosts = {m.agent for m in rec.members}
+    assert len(hosts) == 3  # anti-stacking: never two members per host
+
+    ctl.run_rounds(3, max_rounds=50)
+    steps = ctl.job_steps("ring")
+    assert all(v > 0 for v in steps.values())
+    # Barrier lockstep keeps members within one round of each other.
+    assert max(steps.values()) <= 3 * min(steps.values()) + 64
+
+
+def test_controller_load_balances_singletons(cluster):
+    ctl, _ = cluster
+    for i in range(6):
+        ctl.create_job(f"j{i}", spec={"step_time_ns": 100_000})
+    per_host: dict[str, int] = {}
+    for rec in ctl.jobs.values():
+        per_host[rec.members[0].agent] = per_host.get(rec.members[0].agent, 0) + 1
+    assert max(per_host.values()) - min(per_host.values()) <= 1
+
+
+def test_heartbeat_detects_death_and_recover_replaces(cluster):
+    ctl, agents = cluster
+    ctl.create_job("work", spec={"step_time_ns": 1_000_000}, n_members=2,
+                   gang=True)
+    victim_agent = ctl.jobs["work"].members[0].agent
+    victim = next(a for a in agents if a.name == victim_agent)
+    victim.stop()
+
+    for _ in range(ctl.dead_after_missed):
+        alive = ctl.heartbeat()
+    assert alive[victim_agent] is False
+
+    moved = ctl.recover()
+    assert moved == ["work.0"]
+    new_home = ctl.jobs["work"].members[0].agent
+    assert new_home != victim_agent
+    # gang anti-stacking survives recovery
+    assert new_home != ctl.jobs["work"].members[1].agent
+
+    ctl.run_rounds(2, max_rounds=20)
+    assert all(v > 0 for v in ctl.job_steps("work").values())
+
+
+def test_strict_round_raises_when_agent_dies_mid_round(cluster):
+    from pbs_tpu.dist import ClusterRoundError
+
+    ctl, agents = cluster
+    ctl.create_job("j", spec={"step_time_ns": 1_000_000})
+    agents[2].stop()  # dies without the controller noticing
+    with pytest.raises(ClusterRoundError) as ei:
+        ctl.run_round(max_rounds=10)
+    assert "host2" in ei.value.errors
+    # non-strict mode reports instead of raising
+    quanta = ctl.run_round(max_rounds=10, strict=False)
+    assert "host2" not in quanta or ctl.last_round_errors
+
+
+def test_create_job_rolls_back_orphans_on_partial_failure(cluster):
+    ctl, agents = cluster
+    agents[2].stop()  # still marked alive in the controller
+    with pytest.raises(Exception):
+        ctl.create_job("g", spec={"step_time_ns": 1_000_000},
+                       n_members=3, gang=True)
+    assert "g" not in ctl.jobs
+    # no orphaned member jobs anywhere, and the name is retryable
+    for a in agents[:2]:
+        assert a.partition.jobs == []
+    ctl.heartbeat()
+    ctl.heartbeat()
+    rec = ctl.create_job("g", spec={"step_time_ns": 1_000_000},
+                         n_members=2, gang=True)
+    assert len(rec.members) == 2
+
+
+def test_resurrected_agent_is_fenced_before_readmission(cluster):
+    ctl, agents = cluster
+    rec = ctl.create_job("solo", spec={"step_time_ns": 1_000_000})
+    home = rec.members[0].agent
+    # Simulate a slow host declared dead while still running (the
+    # split-brain window): mark dead without stopping its server.
+    ctl.agents[home].alive = False
+    moved = ctl.recover()
+    assert moved == ["solo"]
+    assert rec.members[0].agent != home
+    # The slow host answers pings again: heartbeat must remove the
+    # stale member before readmitting it.
+    alive = ctl.heartbeat()
+    assert alive[home] is True
+    stale_host = next(a for a in agents if a.name == home)
+    assert stale_host.partition.jobs == []
+
+
+def test_sched_setparams_fans_out_via_multicall(cluster):
+    ctl, agents = cluster
+    ctl.create_job("fleet", spec={"step_time_ns": 1_000_000}, n_members=3)
+    ctl.sched_setparams("fleet", weight=1024, tslice_us=250)
+    for m in ctl.jobs["fleet"].members:
+        a = next(x for x in agents if x.name == m.agent)
+        p = a.partition.job(m.job).params
+        assert (p.weight, p.tslice_us) == (1024, 250)
