@@ -17,7 +17,7 @@ from typing import Any, Callable
 from pbs_tpu.dist.rpc import RpcServer
 from pbs_tpu.runtime.job import Job, SchedParams
 from pbs_tpu.runtime.partition import Partition
-from pbs_tpu.telemetry.counters import Counter
+from pbs_tpu.telemetry.counters import counters_dict
 from pbs_tpu.telemetry.source import SimBackend, SimPhase, SimProfile
 
 WorkloadFactory = Callable[[Partition, str, dict], Job]
@@ -157,10 +157,7 @@ class Agent:
                 {
                     "ctx": c.name,
                     "sched_count": c.sched_count,
-                    "counters": {
-                        Counter(i).name.lower(): int(v)
-                        for i, v in enumerate(c.counters)
-                    },
+                    "counters": counters_dict(c.counters),
                 }
                 for c in j.contexts
             ],

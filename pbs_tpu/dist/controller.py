@@ -40,7 +40,8 @@ class ClusterRoundError(RuntimeError):
 @dataclasses.dataclass
 class AgentHandle:
     name: str
-    client: RpcClient
+    client: RpcClient  # control ops (may be busy for a whole round)
+    probe: RpcClient  # liveness pings only — never blocked behind ops
     alive: bool = True
     missed: int = 0
     info: dict = dataclasses.field(default_factory=dict)
@@ -73,7 +74,8 @@ class Controller:
     # -- membership ------------------------------------------------------
 
     def add_agent(self, name: str, address: tuple[str, int]) -> AgentHandle:
-        h = AgentHandle(name, RpcClient(address))
+        h = AgentHandle(name, RpcClient(address),
+                        probe=RpcClient(address, timeout_s=2.0))
         h.info = h.client.call("info")
         self.agents[name] = h
         return h
@@ -84,20 +86,26 @@ class Controller:
     # -- failure detection (xenwatchdogd analog) -------------------------
 
     def heartbeat(self) -> dict[str, bool]:
-        """Ping every agent once; mark dead after N consecutive misses.
-        Returns {agent: alive}."""
-        for h in self.agents.values():
-            if h.client.try_ping():
+        """Ping every agent once (concurrently — a hung host must not
+        delay detection of the others); mark dead after N consecutive
+        misses. Pings ride each handle's dedicated probe connection and
+        the server answers them lock-free, so a host busy in a long
+        ``run`` op still reads alive. Returns {agent: alive}."""
+
+        def _beat(h: AgentHandle) -> None:
+            if h.probe.try_ping():
                 if not h.alive and not self._reconcile(h):
                     # Fence failed: keep it dead; a later heartbeat
                     # retries the fence before readmission.
-                    continue
+                    return
                 h.missed = 0
                 h.alive = True
             else:
                 h.missed += 1
                 if h.missed >= self.dead_after_missed:
                     h.alive = False
+
+        self._fanout(list(self.agents.values()), _beat)
         return {name: h.alive for name, h in self.agents.items()}
 
     def _reconcile(self, h: AgentHandle) -> bool:
@@ -125,15 +133,29 @@ class Controller:
     # -- placement -------------------------------------------------------
 
     def _load(self, h: AgentHandle) -> tuple[int, int]:
+        """Placement heuristic only — a failed info read ranks the host
+        last but NEVER counts toward liveness (a busy host whose info op
+        times out behind a long run is alive; only the probe-connection
+        heartbeat, which the server answers lock-free, decides death)."""
         try:
             info = h.client.call("info")
             h.info = info
             return (info["n_contexts"], info["n_jobs"])
-        except Exception:  # noqa: BLE001 — treated as a missed heartbeat
-            h.missed += 1
-            if h.missed >= self.dead_after_missed:
-                h.alive = False
+        except Exception:  # noqa: BLE001 — rank last, don't condemn
             return (1 << 30, 1 << 30)
+
+    def _ranked_live(self, candidates: list[AgentHandle]) -> list[AgentHandle]:
+        ranked = sorted(candidates, key=self._load)
+        return [h for h in ranked if h.alive]
+
+    @staticmethod
+    def _fanout(handles: list[AgentHandle], fn) -> None:
+        threads = [threading.Thread(target=fn, args=(h,), daemon=True)
+                   for h in handles]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
 
     def place(self, n: int, distinct: bool = False) -> list[AgentHandle]:
         """Pick n target agents, least-loaded first. ``distinct`` forces
@@ -142,9 +164,7 @@ class Controller:
         live = self.live_agents()
         if not live:
             raise RuntimeError("no live agents")
-        ranked = sorted(live, key=self._load)
-        # _load() may have just marked hosts dead; never place on them.
-        ranked = [h for h in ranked if h.alive]
+        ranked = self._ranked_live(live)
         if not ranked:
             raise RuntimeError("no live agents")
         if distinct:
@@ -243,12 +263,7 @@ class Controller:
                 if h.missed >= self.dead_after_missed:
                     h.alive = False
 
-        threads = [threading.Thread(target=_one, args=(h,), daemon=True)
-                   for h in self.live_agents()]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()  # <- the barrier
+        self._fanout(self.live_agents(), _one)  # join = the barrier
         self.last_round_errors = errs
         if errs and strict:
             raise ClusterRoundError(errs, quanta)
@@ -286,10 +301,7 @@ class Controller:
                 exclude = {mm.agent for mm in rec.members if mm is not m}
                 candidates = [a for a in live
                               if not (rec.gang and a.name in exclude)]
-                ranked = sorted(candidates or live, key=self._load)
-                # _load() may have just marked hosts dead (place() does
-                # the same re-filter).
-                ranked = [a for a in ranked if a.alive]
+                ranked = self._ranked_live(candidates or live)
                 if not ranked:
                     raise RuntimeError(f"no live host for {rec.name}/{m.job}")
                 target = ranked[0]
@@ -333,3 +345,4 @@ class Controller:
     def close(self) -> None:
         for h in self.agents.values():
             h.client.close()
+            h.probe.close()

@@ -76,6 +76,9 @@ class RpcServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.ops: dict[str, Callable[..., Any]] = {}
         self._lock = threading.Lock()
+        # Connection bookkeeping must never wait on the dispatch lock,
+        # or a fresh ping connection blocks behind a long-running op.
+        self._conns_lock = threading.Lock()
         self._conns: set[socket.socket] = set()
         # Liveness probes must answer while a long op holds the dispatch
         # lock — otherwise a busy host reads as dead and gets its jobs
@@ -91,7 +94,7 @@ class RpcServer:
             def handle(self) -> None:  # one connection = many requests
                 sock = self.request
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                with outer._lock:
+                with outer._conns_lock:
                     outer._conns.add(sock)
                 try:
                     while True:
@@ -100,7 +103,7 @@ class RpcServer:
                 except (ConnectionError, OSError, ValueError):
                     return
                 finally:
-                    with outer._lock:
+                    with outer._conns_lock:
                         outer._conns.discard(sock)
 
         class Server(socketserver.ThreadingTCPServer):
@@ -117,17 +120,29 @@ class RpcServer:
         self.ops[name] = fn
 
     def _handle(self, req: Any) -> dict:
-        if not isinstance(req, dict) or "op" not in req:
-            return {"ok": False, "error": "ValueError", "message": "bad request"}
-        op = req["op"]
-        kwargs = req.get("args") or {}
-        if op == "multicall":
-            # xen/common/multicall.c: execute each entry in order; a
-            # failing entry doesn't abort the batch — per-entry status.
-            results = [self._call_one(c.get("op"), c.get("args") or {})
-                       for c in req.get("calls", [])]
-            return {"ok": True, "result": results}
-        return self._call_one(op, kwargs)
+        # A malformed request must produce an error reply, never kill
+        # the connection (the client would block until timeout).
+        try:
+            if not isinstance(req, dict) or "op" not in req:
+                raise ValueError("bad request")
+            op = req["op"]
+            kwargs = req.get("args") or {}
+            if op == "multicall":
+                # xen/common/multicall.c: execute each entry in order; a
+                # failing entry doesn't abort the batch — per-entry status.
+                calls = req.get("calls", [])
+                if not isinstance(calls, list) or not all(
+                        isinstance(c, dict) for c in calls):
+                    raise ValueError("multicall 'calls' must be a list of "
+                                     "{op, args} objects")
+                results = [self._call_one(c.get("op"), c.get("args") or {})
+                           for c in calls]
+                return {"ok": True, "result": results}
+            if not isinstance(kwargs, dict):
+                raise ValueError("'args' must be an object")
+            return self._call_one(op, kwargs)
+        except Exception as e:  # noqa: BLE001 — marshalled to caller
+            return {"ok": False, "error": type(e).__name__, "message": str(e)}
 
     def _call_one(self, op: str, kwargs: dict) -> dict:
         fn = self.ops.get(op)
@@ -157,7 +172,7 @@ class RpcServer:
         self._server.server_close()
         # Handler threads outlive shutdown(); sever their connections so
         # a stopped host really goes silent (heartbeats must fail).
-        with self._lock:
+        with self._conns_lock:
             conns = list(self._conns)
         for s in conns:
             try:

@@ -45,6 +45,7 @@ class Ev(enum.IntEnum):
     JOB_ADD = 0x0301  # args: job_slot, n_contexts, weight
     JOB_REMOVE = 0x0302
     JOB_DONE = 0x0303
+    JOB_FAILED = 0x0304  # args: ctx_slot
     # checkpoint (0x04xx)
     CKPT_BEGIN = 0x0401  # args: job_slot, step
     CKPT_END = 0x0402  # args: job_slot, bytes, dur_ns

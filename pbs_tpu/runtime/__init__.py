@@ -3,6 +3,12 @@ from pbs_tpu.runtime.executor import Executor, quantum_to_steps
 from pbs_tpu.runtime.job import ContextState, ExecutionContext, Job, SchedParams
 from pbs_tpu.runtime.partition import Partition
 from pbs_tpu.runtime.timer import Timer, TimerWheel
+from pbs_tpu.runtime.watchdog import (
+    WallWatchdog,
+    Watchdog,
+    install_crash_handler,
+    write_crash_dump,
+)
 
 __all__ = [
     "ContextState",
@@ -16,5 +22,9 @@ __all__ = [
     "SchedParams",
     "Timer",
     "TimerWheel",
+    "WallWatchdog",
+    "Watchdog",
+    "install_crash_handler",
     "quantum_to_steps",
+    "write_crash_dump",
 ]

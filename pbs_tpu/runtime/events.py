@@ -28,6 +28,7 @@ class Virq(enum.IntEnum):
     WATCHDOG = 17  # job heartbeat missed
     CKPT_DONE = 32  # checkpoint epoch finished
     JOB_DONE = 33
+    JOB_FAILED = 34  # fault contained to a job (MCE containment)
 
 
 class EventChannel:

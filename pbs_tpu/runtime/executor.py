@@ -22,7 +22,7 @@ import numpy as np
 
 from pbs_tpu.obs.trace import Ev
 from pbs_tpu.runtime.job import ContextState, ExecutionContext
-from pbs_tpu.telemetry.counters import Counter
+from pbs_tpu.telemetry.counters import NUM_COUNTERS, Counter
 
 if TYPE_CHECKING:
     from pbs_tpu.runtime.partition import Partition
@@ -48,6 +48,9 @@ class Executor:
         self.current: ExecutionContext | None = None
         self.idle_ns = 0
         self.sched_invocations = 0
+        # Quanta actually dispatched (sched_invocations counts no-work
+        # trips too — the watchdog must see real dispatches only).
+        self.dispatch_count = 0
 
     # ------------------------------------------------------------------
 
@@ -81,6 +84,7 @@ class Executor:
         self.current = ctx
         ctx.state = ContextState.RUNNING
         ctx.sched_count += 1
+        self.dispatch_count += 1
         if ctx.ledger_slot >= 0:
             part.ledger.resume(ctx.ledger_slot, now)
         part.trace_emit(self.index, Ev.SCHED_PICK, ctx.ledger_slot, quantum_ns)
@@ -90,7 +94,18 @@ class Executor:
             remaining = ctx.job.max_steps - ctx.job.steps_retired()
             n_steps = max(1, min(n_steps, remaining))
 
-        deltas = part.source.execute(ctx, n_steps)
+        try:
+            deltas = part.source.execute(ctx, n_steps)
+        except Exception as exc:  # noqa: BLE001 — contained below
+            # Fault containment (the MCE model, tools/tests/mce-test):
+            # a device/step fault poisons only the faulting job; the
+            # partition and its other tenants keep running.
+            if ctx.ledger_slot >= 0:
+                part.ledger.suspend(
+                    ctx.ledger_slot, np.zeros(NUM_COUNTERS, dtype=np.uint64))
+            self.current = None
+            part.fail_job(ctx.job, exc)
+            return
 
         # -- context switch out: pmu_save_regs (perfctr_cpu_vsuspend
         # publishes sums into vcpu->pmc[], perfctr.c:1547-1573) ----------
@@ -101,6 +116,7 @@ class Executor:
         if ctx.ledger_slot >= 0:
             part.ledger.suspend(ctx.ledger_slot, deltas)
         self.current = None
+        part.progress_epoch += 1
 
         end = part.clock.now_ns()
         part.trace_emit(self.index, Ev.SCHED_DESCHED, ctx.ledger_slot, ran_ns)

@@ -38,6 +38,7 @@ class ContextState(enum.Enum):
     BLOCKED = "blocked"  # sleeping; waits for wake()
     PARKED = "parked"  # cap exceeded (CSCHED_FLAG_VCPU_PARKED analog)
     DONE = "done"
+    FAILED = "failed"  # contained fault (MCE-containment analog)
 
 
 @dataclasses.dataclass
@@ -93,6 +94,8 @@ class Job:
         # (sdom->cache_miss_rate / cpi, sched_credit.c:427-435).
         self.stall_rate: float = 0.0
         self.nspi: float = 0.0  # ns per step (CPI analog)
+        # Set by Partition.fail_job when a fault is contained to this job.
+        self.error: str | None = None
         # Scheduler-private per-job state hangs here (sched "domdata").
         self.sched_priv: Any = None
 

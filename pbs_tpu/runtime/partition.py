@@ -19,7 +19,7 @@ from typing import Any, Callable
 
 import numpy as np
 
-from pbs_tpu.obs.trace import TraceBuffer
+from pbs_tpu.obs.trace import Ev, TraceBuffer
 from pbs_tpu.runtime.events import EventBus, Virq
 from pbs_tpu.runtime.executor import Executor
 from pbs_tpu.runtime.job import ContextState, Job, SchedParams
@@ -63,6 +63,10 @@ class Partition:
         self.events = EventBus()
         self._free_slots = list(range(ledger_slots - 1, -1, -1))
         self.jobs: list[Job] = []
+        # Monotone quantum counter; WallWatchdog reads it out-of-band.
+        self.progress_epoch = 0
+        # Hook invoked on contained job failures (crash-dump wiring).
+        self.on_job_failure: Callable[[Job, BaseException], None] | None = None
         self.executors: list[Executor] = []
         self.scheduler: Scheduler = make_scheduler(
             scheduler, self, **(sched_params or {})
@@ -129,6 +133,21 @@ class Partition:
             if ctx.state is ContextState.BLOCKED:
                 ctx.state = ContextState.RUNNABLE
                 self.scheduler.wake(ctx)
+
+    def fail_job(self, job: Job, exc: BaseException) -> None:
+        """Contain a fault to one job (the MCE-containment model,
+        ``tools/tests/mce-test``): mark every context FAILED, notify,
+        dump — the partition and its other tenants keep running."""
+        job.error = f"{type(exc).__name__}: {exc}"
+        for ctx in job.contexts:
+            if ctx.state is not ContextState.FAILED:
+                ctx.state = ContextState.FAILED
+                self.scheduler.sleep(ctx)
+        self.trace_emit(0, Ev.JOB_FAILED,
+                        job.contexts[0].ledger_slot if job.contexts else 0)
+        self.events.send_virq(Virq.JOB_FAILED)
+        if self.on_job_failure is not None:
+            self.on_job_failure(job, exc)
 
     # -- the loop --------------------------------------------------------
 

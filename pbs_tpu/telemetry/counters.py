@@ -84,3 +84,9 @@ DUMP_EVENTS = (
 )
 
 COUNTER_NAMES = {c: c.name for c in Counter}
+
+
+def counters_dict(arr) -> dict[str, int]:
+    """Render a counter array as {name: value} (telemetry RPC, crash
+    dumps, CLI output all share this shape)."""
+    return {Counter(i).name.lower(): int(v) for i, v in enumerate(arr)}

@@ -1,0 +1,220 @@
+"""Fault injection: containment, watchdogs, crash dumps.
+
+The mce-test pattern (``tools/tests/mce-test/cases/*``): inject a fault
+into a specific context and verify it is contained there — the host and
+the other tenants keep running — with a postmortem trail.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from pbs_tpu.runtime import (
+    ContextState,
+    Job,
+    Partition,
+    SchedParams,
+    Virq,
+    WallWatchdog,
+    Watchdog,
+    install_crash_handler,
+    write_crash_dump,
+)
+from pbs_tpu.telemetry import Counter, SimBackend, SimProfile
+from pbs_tpu.utils.clock import MS, MonotonicClock
+
+
+class DeviceFault(RuntimeError):
+    pass
+
+
+class FaultyBackend(SimBackend):
+    """SimBackend that raises on a chosen job after N successful steps
+    (the xen-mceinj analog: a targeted, repeatable fault)."""
+
+    def __init__(self, victim: str, fault_after_steps: int):
+        super().__init__()
+        self.victim = victim
+        self.fault_after = fault_after_steps
+
+    def execute(self, ctx, n_steps: int) -> np.ndarray:
+        if (ctx.job.name == self.victim
+                and self._steps_done[ctx.job.name] >= self.fault_after):
+            raise DeviceFault(f"injected fault in {ctx.name}")
+        return super().execute(ctx, n_steps)
+
+
+def _two_tenant_partition(be):
+    part = Partition("p", source=be, scheduler="credit")
+    be.register("victim", SimProfile.steady(step_time_ns=1 * MS))
+    be.register("bystander", SimProfile.steady(step_time_ns=1 * MS))
+    victim = part.add_job(Job("victim", params=SchedParams(weight=256)))
+    bystander = part.add_job(
+        Job("bystander", params=SchedParams(weight=256), max_steps=200))
+    return part, victim, bystander
+
+
+def test_fault_contained_to_one_job():
+    be = FaultyBackend("victim", fault_after_steps=10)
+    part, victim, bystander = _two_tenant_partition(be)
+    failed_virqs = []
+    part.events.bind_virq(Virq.JOB_FAILED, lambda p: failed_virqs.append(p))
+
+    part.run(until_ns=1_000 * MS)
+
+    # victim poisoned, error recorded, contexts FAILED
+    assert victim.error is not None and "injected fault" in victim.error
+    assert all(c.state is ContextState.FAILED for c in victim.contexts)
+    assert victim.steps_retired() >= 10
+    # bystander unscathed: ran to completion on the same partition
+    assert bystander.steps_retired() == 200
+    assert bystander.error is None
+    assert failed_virqs  # JOB_FAILED virq delivered
+
+
+def test_crash_dump_written_on_contained_fault(tmp_path):
+    be = FaultyBackend("victim", fault_after_steps=5)
+    part, victim, _ = _two_tenant_partition(be)
+    install_crash_handler(part, str(tmp_path))
+
+    part.run(until_ns=1_000 * MS)
+
+    dumps = list(tmp_path.glob("crash-*.json"))
+    assert len(dumps) == 1
+    doc = json.loads(dumps[0].read_text())
+    assert doc["failed_job"] == "victim"
+    assert doc["exception"]["type"] == "DeviceFault"
+    assert any(j["job"] == "victim" and j["error"] for j in doc["jobs"])
+    assert isinstance(doc["trace_tail"], list)
+
+
+def test_manual_crash_dump_snapshot(tmp_path):
+    be = SimBackend()
+    part = Partition("p", source=be, scheduler="credit")
+    be.register("j", SimProfile.steady(step_time_ns=1 * MS))
+    part.add_job(Job("j", max_steps=20))
+    part.run(until_ns=100 * MS)
+    path = write_crash_dump(str(tmp_path), part, reason="operator snapshot")
+    doc = json.loads(open(path).read())
+    assert doc["reason"] == "operator snapshot"
+    steps = doc["jobs"][0]["contexts"][0]["counters"]["steps_retired"]
+    assert steps == 20
+
+
+def test_watchdog_flags_logical_stall():
+    """Runnable work + no dispatch for N periods => stall flagged."""
+    be = SimBackend()
+    part = Partition("p", source=be, scheduler="credit")
+    be.register("j", SimProfile.steady(step_time_ns=1 * MS))
+    part.add_job(Job("j"))
+    stalled = []
+    wd = Watchdog(part, period_ns=10 * MS, threshold=2,
+                  on_stall=lambda p: stalled.append(p.name))
+    # Simulate a wedged run loop: time passes, timers fire, but no
+    # executor ever dispatches.
+    for _ in range(5):
+        be.clock.advance(10 * MS)
+        part.timers.fire_due(be.clock.now_ns())
+    assert wd.stalls and stalled == ["p"]
+    # A healthy loop never trips it: reset and actually run.
+    wd2 = Watchdog(part, period_ns=10 * MS, threshold=2)
+    part.run(until_ns=be.clock.now_ns() + 200 * MS)
+    assert wd2.stalls == []
+
+
+def test_watchdog_quiet_with_more_executors_than_contexts():
+    """Regression: a lane with nothing to run is not a stall — the
+    check is partition-global, so one busy executor proves liveness."""
+    be = SimBackend()
+    part = Partition("p", source=be, scheduler="credit", n_executors=2)
+    be.register("j", SimProfile.steady(step_time_ns=1 * MS))
+    part.add_job(Job("j", max_steps=100))  # single context, pinned to one lane
+    wd = Watchdog(part, period_ns=10 * MS, threshold=2)  # default = raise
+    part.run(until_ns=500 * MS)
+    assert wd.stalls == []
+
+
+def test_watchdog_raises_without_stall_policy():
+    """Default action is panic (the NMI watchdog model) — it also stops
+    a stalled run loop from spinning on the watchdog's own timer."""
+    from pbs_tpu.runtime.watchdog import WatchdogStallError
+
+    be = SimBackend()
+    part = Partition("p", source=be, scheduler="credit")
+    be.register("j", SimProfile.steady(step_time_ns=1 * MS))
+    part.add_job(Job("j"))
+    Watchdog(part, period_ns=10 * MS, threshold=2)
+    with pytest.raises(WatchdogStallError):
+        for _ in range(5):
+            be.clock.advance(10 * MS)
+            part.timers.fire_due(be.clock.now_ns())
+
+
+def test_busy_agent_stays_alive_under_heartbeat():
+    """A host mid-run must not read dead: pings ride a dedicated probe
+    connection and the server answers them without the dispatch lock."""
+    from pbs_tpu.dist import Agent, Controller
+    from pbs_tpu.telemetry.source import TpuBackend
+
+    part = Partition("busy.pool", source=TpuBackend(clock=MonotonicClock()),
+                     scheduler="credit")
+    agent = Agent("busy", partition=part).start()
+    part.add_job(Job("slow", step_fn=lambda s: (time.sleep(0.15), s)[1],
+                     state=0, max_steps=8))
+    ctl = Controller()
+    ctl.add_agent("busy", agent.address)
+    try:
+        import threading
+
+        t = threading.Thread(
+            target=lambda: ctl.agents["busy"].client.call(
+                "run", _timeout=30.0, max_rounds=20),
+            daemon=True)
+        t.start()
+        time.sleep(0.1)  # run op now holds the agent's dispatch lock
+        for _ in range(ctl.dead_after_missed + 1):
+            alive = ctl.heartbeat()
+            assert alive["busy"] is True
+        t.join(timeout=10)
+    finally:
+        ctl.close()
+        agent.stop()
+
+
+def test_wall_watchdog_barks_on_hung_step():
+    """A step that blocks past the timeout fires the out-of-band bark."""
+    from pbs_tpu.telemetry.source import TpuBackend
+
+    hang_s = 0.5
+    be = TpuBackend(clock=MonotonicClock())
+    part = Partition("p", source=be, scheduler="credit")
+
+    def hung_step(state):
+        time.sleep(hang_s)  # stands in for a lost collective
+        return state
+
+    part.add_job(Job("hung", step_fn=hung_step, state=0, max_steps=1))
+    barks = []
+    wd = WallWatchdog(part, timeout_s=0.1, poll_s=0.02,
+                      on_bark=lambda p, idle: barks.append(idle))
+    with wd:
+        part.run(max_rounds=2)
+    wd.stop()
+    assert wd.barks >= 1 and barks and barks[0] >= 0.1
+
+
+def test_wall_watchdog_quiet_on_healthy_run():
+    from pbs_tpu.telemetry.source import TpuBackend
+
+    be = TpuBackend(clock=MonotonicClock())
+    part = Partition("p", source=be, scheduler="credit")
+    part.add_job(Job("ok", step_fn=lambda s: s + 1, state=0, max_steps=50))
+    wd = WallWatchdog(part, timeout_s=5.0, poll_s=0.02)
+    with wd:
+        part.run(max_rounds=100)
+    wd.stop()
+    assert wd.barks == 0
