@@ -184,6 +184,56 @@ def cmd_sched_credit(args) -> int:
     return 0
 
 
+def cmd_perf(args) -> int:
+    """xenperf analog: format a published obs dump's software counters."""
+    from pbs_tpu.obs.dumpfile import read_obs_dump
+
+    snap = read_obs_dump(args.file)
+    for name, val in snap.get("perfc", {}).items():
+        print(f"{name:<40} {val:>12}")
+    return 0
+
+
+def cmd_lockprof(args) -> int:
+    """xenlockprof analog: per-lock contention stats, worst wait first."""
+    from pbs_tpu.obs.dumpfile import read_obs_dump
+
+    snap = read_obs_dump(args.file)
+    print(f"{'lock':<16} {'acquires':>10} {'contended':>10} "
+          f"{'wait_ms':>10} {'hold_ms':>10} {'maxwait_us':>10}")
+    for r in snap.get("lockprof", []):
+        print(f"{r['name']:<16} {r['acquires']:>10} {r['contended']:>10} "
+              f"{r['wait_ns'] / 1e6:>10.3f} {r['hold_ns'] / 1e6:>10.3f} "
+              f"{r['max_wait_ns'] / 1e3:>10.1f}")
+    return 0
+
+
+def cmd_params(args) -> int:
+    """Effective boot-param registry (name=value per line)."""
+    from pbs_tpu.utils import params as params_mod
+
+    if args.file:
+        from pbs_tpu.obs.dumpfile import read_obs_dump
+
+        vals = read_obs_dump(args.file).get("params", {})
+    else:
+        # Import the subsystems that declare params so a standalone
+        # invocation sees the full registry (param declaration happens
+        # at module import, like Xen's link-time param sections).
+        import pbs_tpu.obs.lockprof  # noqa: F401
+        import pbs_tpu.obs.trace  # noqa: F401
+        import pbs_tpu.runtime.job  # noqa: F401
+        import pbs_tpu.runtime.partition  # noqa: F401
+
+        if args.cmdline:
+            for tok in params_mod.parse_cmdline(args.cmdline):
+                print(f"pbst: bad param {tok!r}", file=sys.stderr)
+        vals = params_mod.dump()
+    for name, val in vals.items():
+        print(f"{name}={json.dumps(val)}")
+    return 0
+
+
 def cmd_demo(args) -> int:
     from pbs_tpu.runtime import Job, Partition, SchedParams
     from pbs_tpu.sched import FeedbackPolicy
@@ -246,6 +296,20 @@ def main(argv=None) -> int:
     sp.add_argument("-t", "--tslice-us", type=int, dest="tslice_us")
     sp.add_argument("--db", required=True)
     sp.set_defaults(fn=cmd_sched_credit)
+
+    sp = sub.add_parser("perf", help="software counter dump (xenperf)")
+    sp.add_argument("file", help="obs dump JSON (obs.dumpfile)")
+    sp.set_defaults(fn=cmd_perf)
+
+    sp = sub.add_parser("lockprof", help="lock contention (xenlockprof)")
+    sp.add_argument("file", help="obs dump JSON (obs.dumpfile)")
+    sp.set_defaults(fn=cmd_lockprof)
+
+    sp = sub.add_parser("params", help="boot-param registry dump")
+    g = sp.add_mutually_exclusive_group()
+    g.add_argument("--file", help="obs dump JSON; default: this process")
+    g.add_argument("--cmdline", help="apply a 'k=v k2 no-k3' string first")
+    sp.set_defaults(fn=cmd_params)
 
     sp = sub.add_parser("demo", help="run the two-tenant sim demo")
     sp.add_argument("--scheduler", default="credit")

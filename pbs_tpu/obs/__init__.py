@@ -1,4 +1,7 @@
+from pbs_tpu.obs.lockprof import ProfiledLock
 from pbs_tpu.obs.perfc import Perfc, perfc
 from pbs_tpu.obs.trace import Ev, TraceBuffer, format_records
 
-__all__ = ["Ev", "Perfc", "TraceBuffer", "format_records", "perfc"]
+__all__ = [
+    "Ev", "Perfc", "ProfiledLock", "TraceBuffer", "format_records", "perfc",
+]

@@ -1,0 +1,38 @@
+"""Observability dump artifact: the sysctl-read seam for CLIs.
+
+Reference: ``xenperf`` and ``xenlockprof`` read hypervisor-internal
+counters through sysctl hypercalls (``tools/misc/xenperf.c``,
+``tools/misc/xenlockprof.c``). Our CLIs attach to artifacts rather
+than a live daemon (same decoupling as xentop over shared pages), so
+the producing process publishes a JSON snapshot of its software
+counters, lock profile, and effective boot params; ``pbst perf`` /
+``pbst lockprof`` / ``pbst params`` format it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from pbs_tpu.obs import lockprof
+from pbs_tpu.obs.perfc import perfc
+from pbs_tpu.utils import params
+
+
+def write_obs_dump(path: str) -> dict:
+    """Snapshot perfc + lockprof + params to ``path`` (atomic rename)."""
+    snap = {
+        "perfc": perfc.dump(),
+        "lockprof": lockprof.dump(),
+        "params": params.dump(),
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(snap, f)
+    os.replace(tmp, path)
+    return snap
+
+
+def read_obs_dump(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)

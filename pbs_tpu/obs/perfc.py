@@ -10,13 +10,14 @@ internals, distinct from the per-job telemetry ledger.
 from __future__ import annotations
 
 import collections
-import threading
+
+from pbs_tpu.obs.lockprof import ProfiledLock
 
 
 class Perfc:
     def __init__(self):
         self._c: dict[str, int] = collections.defaultdict(int)
-        self._lock = threading.Lock()
+        self._lock = ProfiledLock("perfc")
 
     def incr(self, name: str, by: int = 1) -> None:
         with self._lock:

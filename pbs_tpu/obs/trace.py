@@ -19,8 +19,14 @@ import enum
 
 import numpy as np
 
+from pbs_tpu.utils.params import integer_param
+
 TRACE_HEADER_WORDS = 4
 TRACE_REC_WORDS = 8
+
+# ``tbuf_size=`` boot param analog (xen/common/trace.c): default ring
+# capacity in records for rings whose creator doesn't size them.
+_tbuf_size = integer_param("tbuf_size", 4096)
 
 
 class Ev(enum.IntEnum):
@@ -56,8 +62,10 @@ class Ev(enum.IntEnum):
 class TraceBuffer:
     """One SPSC ring. Producer: an executor. Consumer: a monitor."""
 
-    def __init__(self, capacity: int = 4096, buf=None, native: bool | None = None):
-        self.capacity = capacity
+    def __init__(self, capacity: int | None = None, buf=None,
+                 native: bool | None = None):
+        self.capacity = capacity = (
+            capacity if capacity is not None else _tbuf_size.value)
         nwords = TRACE_HEADER_WORDS + capacity * TRACE_REC_WORDS
         if buf is None:
             buf = bytearray(nwords * 8)

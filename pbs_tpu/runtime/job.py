@@ -41,6 +41,13 @@ class ContextState(enum.Enum):
     FAILED = "failed"  # contained fault (MCE-containment analog)
 
 
+from pbs_tpu.utils.params import integer_param
+
+# Boot-param analog of ``sched_credit_tslice_us=`` (sched_credit.c:126-127):
+# overrides the default per-job slice for jobs that don't set one.
+_tslice_param = integer_param("sched_credit_tslice_us", 100)
+
+
 @dataclasses.dataclass
 class SchedParams:
     """Per-job scheduling knobs (the ``xl sched-credit -w/-c/-t`` surface,
@@ -50,7 +57,8 @@ class SchedParams:
     cap: int = 0  # percent of one executor; 0 = uncapped
     # Per-job time slice in µs; adaptive policy mutates this.
     # CSCHED_DEFAULT_TSLICE_US = 100 (sched_credit.c:52).
-    tslice_us: int = 100
+    tslice_us: int = dataclasses.field(
+        default_factory=lambda: _tslice_param.value)
     # Latency-sensitive jobs get BOOST priority on wake (serving).
     boost_on_wake: bool = True
 

@@ -28,8 +28,13 @@ from pbs_tpu.sched.base import Scheduler, make_scheduler
 from pbs_tpu.telemetry.ledger import Ledger
 from pbs_tpu.telemetry.source import TelemetrySource
 from pbs_tpu.utils.clock import Clock, VirtualClock
+from pbs_tpu.utils.params import string_param
 
 DEFAULT_LEDGER_SLOTS = 128
+
+# ``sched=`` boot param (schedule.c:65-70): the scheduler a partition
+# gets when its creator doesn't pick one explicitly.
+_sched_param = string_param("sched", "credit")
 
 
 class Partition:
@@ -37,7 +42,7 @@ class Partition:
         self,
         name: str,
         source: TelemetrySource,
-        scheduler: str = "credit",
+        scheduler: str | None = None,
         n_executors: int = 1,
         devices: list[Any] | None = None,
         clock: Clock | None = None,
@@ -69,7 +74,8 @@ class Partition:
         self.on_job_failure: Callable[[Job, BaseException], None] | None = None
         self.executors: list[Executor] = []
         self.scheduler: Scheduler = make_scheduler(
-            scheduler, self, **(sched_params or {})
+            scheduler if scheduler is not None else _sched_param.value,
+            self, **(sched_params or {})
         )
         devices = devices or [None] * n_executors
         for i, dev in enumerate(devices):

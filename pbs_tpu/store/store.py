@@ -17,8 +17,9 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 from typing import Any, Callable
+
+from pbs_tpu.obs.lockprof import ProfiledLock
 
 
 def _norm(path: str) -> str:
@@ -38,7 +39,7 @@ class Store:
         self._data: dict[str, Any] = {}
         self._version: dict[str, int] = {}
         self._watches: list[tuple[str, Callable[[str, Any], None]]] = []
-        self._lock = threading.RLock()
+        self._lock = ProfiledLock("store", recursive=True)
         self._persist = persist_path
         if persist_path and os.path.exists(persist_path):
             with open(persist_path) as f:
