@@ -72,10 +72,15 @@ class Agent:
         self.workloads: dict[str, WorkloadFactory] = {"sim": sim_workload}
         self.workloads.update(workloads or {})
         self.server = RpcServer(host=host, port=port)
-        for op in ("info", "create_job", "remove_job", "sched_setparams",
+        for op in ("create_job", "remove_job", "sched_setparams",
                    "pause_job", "unpause_job", "run", "dump", "telemetry",
                    "list_jobs"):
             self.server.register(op, getattr(self, "op_" + op))
+        # info answers without the dispatch lock: it only reads counts
+        # (torn reads are fine for a placement heuristic) and the
+        # controller ranks hosts with it while long `run` ops hold the
+        # lock — blocking would freeze placement cluster-wide.
+        self.server.register("info", self.op_info, lockfree=True)
 
     # -- ops (the per-host hypercall surface) ----------------------------
 

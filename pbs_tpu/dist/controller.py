@@ -134,18 +134,27 @@ class Controller:
 
     def _load(self, h: AgentHandle) -> tuple[int, int]:
         """Placement heuristic only — a failed info read ranks the host
-        last but NEVER counts toward liveness (a busy host whose info op
-        times out behind a long run is alive; only the probe-connection
-        heartbeat, which the server answers lock-free, decides death)."""
+        last but NEVER counts toward liveness. Rides the probe
+        connection (short timeout, never queued behind a long ``run`` op
+        on the shared control connection) and the server answers info
+        lock-free, so one busy host cannot stall place()/recover()."""
         try:
-            info = h.client.call("info")
+            info = h.probe.call("info")
             h.info = info
             return (info["n_contexts"], info["n_jobs"])
         except Exception:  # noqa: BLE001 — rank last, don't condemn
             return (1 << 30, 1 << 30)
 
     def _ranked_live(self, candidates: list[AgentHandle]) -> list[AgentHandle]:
-        ranked = sorted(candidates, key=self._load)
+        # Collect loads concurrently (one wedged probe adds its timeout
+        # once, not once per comparison in a serial sorted(key=...)).
+        loads: dict[str, tuple[int, int]] = {}
+
+        def _collect(h: AgentHandle) -> None:
+            loads[h.name] = self._load(h)
+
+        self._fanout(candidates, _collect)
+        ranked = sorted(candidates, key=lambda h: loads[h.name])
         return [h for h in ranked if h.alive]
 
     @staticmethod

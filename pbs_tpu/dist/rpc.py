@@ -116,8 +116,14 @@ class RpcServer:
 
     # -- op table (hypercall registry) -----------------------------------
 
-    def register(self, name: str, fn: Callable[..., Any]) -> None:
+    def register(self, name: str, fn: Callable[..., Any],
+                 lockfree: bool = False) -> None:
+        """``lockfree=True`` ops dispatch without the serializing lock —
+        only for handlers that are read-only and tolerate torn reads
+        (liveness probes, load heuristics)."""
         self.ops[name] = fn
+        if lockfree:
+            self._lockfree_ops.add(name)
 
     def _handle(self, req: Any) -> dict:
         # A malformed request must produce an error reply, never kill

@@ -129,6 +129,23 @@ class TraceBuffer:
         self._arr[1] = np.uint64(tail + n)
         return recs
 
+    def peek(self, max_records: int = 1024) -> np.ndarray:
+        """Last ``max_records`` undrained records WITHOUT consuming them
+        — postmortem readers (crash dumps) must not steal records from an
+        attached live consumer. Reads the shared header words directly
+        (same layout for the native ring), so it also works on a ring the
+        native library owns; safe in-process where the producer is
+        quiescent or slow relative to the copy."""
+        head, tail, cap = int(self._arr[0]), int(self._arr[1]), self.capacity
+        avail = head - tail
+        n = min(avail, max_records)
+        first = tail + (avail - n)  # newest n records
+        recs = np.empty((n, TRACE_REC_WORDS), dtype="<u8")
+        for i in range(n):
+            off = TRACE_HEADER_WORDS + ((first + i) % cap) * TRACE_REC_WORDS
+            recs[i] = self._arr[off:off + TRACE_REC_WORDS]
+        return recs
+
     @property
     def lost(self) -> int:
         if self._nat is not None:

@@ -104,7 +104,7 @@ class Executor:
                 part.ledger.suspend(
                     ctx.ledger_slot, np.zeros(NUM_COUNTERS, dtype=np.uint64))
             self.current = None
-            part.fail_job(ctx.job, exc)
+            part.fail_job(ctx.job, exc, ctx=ctx, lane=self.index)
             return
 
         # -- context switch out: pmu_save_regs (perfctr_cpu_vsuspend

@@ -140,17 +140,23 @@ class Partition:
                 ctx.state = ContextState.RUNNABLE
                 self.scheduler.wake(ctx)
 
-    def fail_job(self, job: Job, exc: BaseException) -> None:
+    def fail_job(self, job: Job, exc: BaseException,
+                 ctx: "ExecutionContext | None" = None,
+                 lane: int = 0) -> None:
         """Contain a fault to one job (the MCE-containment model,
         ``tools/tests/mce-test``): mark every context FAILED, notify,
-        dump — the partition and its other tenants keep running."""
+        dump — the partition and its other tenants keep running.
+        ``ctx``/``lane`` identify the faulting context and executor so
+        the postmortem trace names the right victim."""
         job.error = f"{type(exc).__name__}: {exc}"
-        for ctx in job.contexts:
-            if ctx.state is not ContextState.FAILED:
-                ctx.state = ContextState.FAILED
-                self.scheduler.sleep(ctx)
-        self.trace_emit(0, Ev.JOB_FAILED,
-                        job.contexts[0].ledger_slot if job.contexts else 0)
+        for c in job.contexts:
+            if c.state is not ContextState.FAILED:
+                c.state = ContextState.FAILED
+                self.scheduler.sleep(c)
+        if ctx is None and job.contexts:
+            ctx = job.contexts[0]
+        self.trace_emit(lane, Ev.JOB_FAILED,
+                        ctx.ledger_slot if ctx is not None else 0)
         self.events.send_virq(Virq.JOB_FAILED)
         if self.on_job_failure is not None:
             self.on_job_failure(job, exc)
@@ -248,6 +254,16 @@ class Partition:
     def trace_emit(self, exi: int, event: int, *args: int) -> None:
         if 0 <= exi < len(self.traces):
             self.traces[exi].emit(self.clock.now_ns(), event, *args)
+
+    def peek_traces(self, max_records: int = 4096):
+        """Non-destructive tail of all rings, merged and time-sorted —
+        for postmortems/snapshots that must not race a live consumer."""
+        chunks = [t.peek(max_records) for t in self.traces]
+        chunks = [c for c in chunks if len(c)]
+        if not chunks:
+            return np.empty((0, 8), dtype="<u8")
+        allr = np.concatenate(chunks, axis=0)
+        return allr[np.argsort(allr[:, 0], kind="stable")]
 
     def drain_traces(self, max_records: int = 4096):
         """xentrace analog: drain all rings, merged and time-sorted."""

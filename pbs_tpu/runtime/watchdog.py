@@ -79,6 +79,11 @@ class Watchdog:
             now + period_ns, self._tick, period_ns=period_ns, name="watchdog"
         )
 
+    def cancel(self) -> None:
+        """Disarm: a watchdog left ticking after its run can panic an
+        unrelated later run of the same partition."""
+        self.timer.stop()
+
     def _tick(self, now_ns: int) -> None:
         part = self.partition
         if not part.pending_work():
@@ -170,7 +175,10 @@ class WallWatchdog:
             self._thread.join(timeout=2)
 
     def __enter__(self) -> "WallWatchdog":
-        if self._thread is None:
+        if self._thread is None or not self._thread.is_alive():
+            # Re-entry after a previous stop(): restart the monitor
+            # thread, otherwise this context would silently watch nothing.
+            self._stop = threading.Event()
             self.start()
         self.arm()
         return self
@@ -215,7 +223,10 @@ def write_crash_dump(
             }
             for j in partition.jobs
         ],
-        "trace_tail": format_records(partition.drain_traces(max_trace)),
+        # peek, not drain: a second dump in the same run must still see
+        # the tail, and a live xentrace-style consumer must not lose
+        # records to a postmortem snapshot.
+        "trace_tail": format_records(partition.peek_traces(max_trace)),
     }
     if job is not None:
         doc["failed_job"] = job.name

@@ -22,12 +22,14 @@ from typing import Any, Callable
 class Param:
     """One registered knob. Read with ``.value`` (cheap, cached)."""
 
-    def __init__(self, name: str, default: Any, parse: Callable[[str], Any]):
+    def __init__(self, name: str, default: Any, parse: Callable[[str], Any],
+                 is_bool: bool = False):
         self.name = name
         self.default = default
         self._parse = parse
         self._value = default
         self._explicit = False  # set via cmdline/env (wins over default)
+        self.is_bool = is_bool  # bare / "no-" cmdline forms allowed
 
     @property
     def value(self) -> Any:
@@ -61,13 +63,14 @@ def _parse_bool(raw: str) -> bool:
     raise ValueError(f"bad boolean param value {raw!r}")
 
 
-def _register(name: str, default: Any, parse: Callable[[str], Any]) -> Param:
+def _register(name: str, default: Any, parse: Callable[[str], Any],
+              is_bool: bool = False) -> Param:
     with _lock:
         if name in _registry:
             # Same-module re-import: keep the existing param (and any
             # explicitly-set value) rather than silently resetting it.
             return _registry[name]
-        p = Param(name, default, parse)
+        p = Param(name, default, parse, is_bool=is_bool)
         env = os.environ.get("PBST_" + name.upper().replace("-", "_"))
         if env is not None:
             # Same contract as parse_cmdline: a bad value is warned about
@@ -85,7 +88,7 @@ def _register(name: str, default: Any, parse: Callable[[str], Any]) -> Param:
 
 
 def boolean_param(name: str, default: bool = False) -> Param:
-    return _register(name, default, _parse_bool)
+    return _register(name, default, _parse_bool, is_bool=True)
 
 
 def integer_param(name: str, default: int = 0) -> Param:
@@ -114,6 +117,12 @@ def parse_cmdline(cmdline: str) -> list[str]:
         with _lock:
             p = _registry.get(name)
         if p is None:
+            rejected.append(tok)
+            continue
+        if (neg or not has_eq) and not p.is_bool:
+            # Bare / "no-" forms only make sense for booleans; applying
+            # them to e.g. a string param would silently set the literal
+            # "on"/"off" and blow up far from the parse site.
             rejected.append(tok)
             continue
         try:

@@ -92,6 +92,34 @@ def test_crash_dump_written_on_contained_fault(tmp_path):
     assert isinstance(doc["trace_tail"], list)
 
 
+def test_two_crash_dumps_both_capture_trace_tail(tmp_path):
+    """Dumps peek (not drain) the rings: a second fault in the same run
+    still gets trace evidence, and a live consumer loses nothing."""
+    be = FaultyBackend("victim", fault_after_steps=5)
+    part, victim, _ = _two_tenant_partition(be)
+    part.run(until_ns=50 * MS)
+    p1 = write_crash_dump(str(tmp_path), part, reason="first")
+    p2 = write_crash_dump(str(tmp_path), part, reason="second")
+    d1, d2 = (json.loads(open(p).read()) for p in (p1, p2))
+    assert d1["trace_tail"] and d2["trace_tail"]
+    # live consumer still sees every record afterwards
+    assert len(part.drain_traces()) == len(d1["trace_tail"])
+
+
+def test_failed_job_trace_names_faulting_context(tmp_path):
+    """JOB_FAILED must carry the faulting context's slot, on the lane
+    that faulted — the postmortem must not misattribute the victim."""
+    from pbs_tpu.obs.trace import Ev
+
+    be = FaultyBackend("victim", fault_after_steps=5)
+    part, victim, _ = _two_tenant_partition(be)
+    part.run(until_ns=50 * MS)
+    recs = part.drain_traces()
+    failed = [r for r in recs if int(r[1]) == Ev.JOB_FAILED]
+    assert len(failed) == 1
+    assert int(failed[0][2]) == victim.contexts[0].ledger_slot
+
+
 def test_manual_crash_dump_snapshot(tmp_path):
     be = SimBackend()
     part = Partition("p", source=be, scheduler="credit")
@@ -120,10 +148,13 @@ def test_watchdog_flags_logical_stall():
         be.clock.advance(10 * MS)
         part.timers.fire_due(be.clock.now_ns())
     assert wd.stalls and stalled == ["p"]
-    # A healthy loop never trips it: reset and actually run.
+    # A healthy loop never trips it: disarm the tripped dog (it must
+    # not keep ticking into later runs) and actually run.
+    wd.cancel()
     wd2 = Watchdog(part, period_ns=10 * MS, threshold=2)
     part.run(until_ns=be.clock.now_ns() + 200 * MS)
     assert wd2.stalls == []
+    assert len(wd.stalls) == 1  # cancelled: saw nothing after disarm
 
 
 def test_watchdog_quiet_with_more_executors_than_contexts():
@@ -179,6 +210,11 @@ def test_busy_agent_stays_alive_under_heartbeat():
         for _ in range(ctl.dead_after_missed + 1):
             alive = ctl.heartbeat()
             assert alive["busy"] is True
+        # Placement must not freeze behind the busy control connection
+        # either: _load rides the probe and info answers lock-free.
+        t0 = time.monotonic()
+        assert ctl.place(1)[0].name == "busy"
+        assert time.monotonic() - t0 < 1.0
         t.join(timeout=10)
     finally:
         ctl.close()
@@ -205,6 +241,25 @@ def test_wall_watchdog_barks_on_hung_step():
         part.run(max_rounds=2)
     wd.stop()
     assert wd.barks >= 1 and barks and barks[0] >= 0.1
+
+
+def test_wall_watchdog_context_reuse_restarts_thread():
+    """A second `with wd:` after the first exit must actually watch —
+    the first __exit__ stops the monitor thread."""
+    from pbs_tpu.telemetry.source import TpuBackend
+
+    be = TpuBackend(clock=MonotonicClock())
+    part = Partition("p", source=be, scheduler="credit")
+    part.add_job(Job("hang2", step_fn=lambda s: (time.sleep(0.4), s)[1],
+                     state=0, max_steps=2))
+    barks = []
+    wd = WallWatchdog(part, timeout_s=0.1, poll_s=0.02,
+                      on_bark=lambda p, idle: barks.append(idle))
+    with wd:
+        pass  # healthy first use
+    with wd:  # must restart the (stopped) monitor thread
+        part.run(max_rounds=1)
+    assert wd.barks >= 1
 
 
 def test_wall_watchdog_quiet_on_healthy_run():

@@ -49,6 +49,13 @@ def test_parse_cmdline_rejects_bad_values_without_raising():
     assert params.get("t_strict").value == 5  # untouched
 
 
+def test_parse_cmdline_bare_forms_only_for_booleans():
+    params.string_param("t_name", "credit")
+    rejected = params.parse_cmdline("t_name no-t_name")
+    assert sorted(rejected) == ["no-t_name", "t_name"]
+    assert params.get("t_name").value == "credit"  # not "on"/"off"
+
+
 def test_reregistration_preserves_set_value():
     p = params.integer_param("t_keep", 1)
     p.set("7")
