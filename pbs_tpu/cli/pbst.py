@@ -184,6 +184,35 @@ def cmd_sched_credit(args) -> int:
     return 0
 
 
+def cmd_mon(args) -> int:
+    """xenmon analog: live per-job sched history from file-backed rings."""
+    from pbs_tpu.obs.mon import Monitor
+
+    mon = Monitor(args.meta, window_ns=int(args.window * 1e9))
+    hdr = (f"{'slot':>4} {'job':<12} {'ctx':<16} {'weight':>6} "
+           f"{'cpu%':>7} {'gotten_ms':>10} {'execs':>7} {'wakes':>7}")
+    n_iter = args.iterations if args.iterations > 0 else 10**9
+    try:
+        for i in range(n_iter):
+            mon.refresh_meta()
+            mon.poll()
+            sys.stdout.write("\x1b[2J\x1b[H" if args.clear else "")
+            print(f"pbst mon — partition={mon.meta.get('partition')} "
+                  f"window={args.window}s "
+                  f"records={mon.history.records_seen}")
+            print(hdr)
+            for r in mon.rows(windows=args.windows):
+                print(f"{r['slot']:>4} {r['job']:<12} {r['ctx']:<16} "
+                      f"{(r['weight'] if r['weight'] is not None else ''):>6} "
+                      f"{r['cpu_pct']:>7.2f} {r['gotten_ms']:>10.3f} "
+                      f"{r['execs']:>7} {r['wakes']:>7}")
+            if i + 1 < n_iter:  # no pointless sleep after the last frame
+                time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_perf(args) -> int:
     """xenperf analog: format a published obs dump's software counters."""
     from pbs_tpu.obs.dumpfile import read_obs_dump
@@ -296,6 +325,22 @@ def main(argv=None) -> int:
     sp.add_argument("-t", "--tslice-us", type=int, dest="tslice_us")
     sp.add_argument("--db", required=True)
     sp.set_defaults(fn=cmd_sched_credit)
+
+    sp = sub.add_parser("mon", help="live sched history (xenmon)")
+    sp.add_argument("meta", help="partition meta sidecar (<ledger>.meta.json)")
+    def _pos_int(v: str) -> int:
+        n = int(v)
+        if n < 1:
+            raise argparse.ArgumentTypeError("must be >= 1")
+        return n
+
+    sp.add_argument("--window", type=float, default=1.0, help="seconds")
+    sp.add_argument("--windows", type=_pos_int, default=1,
+                    help="windows to aggregate per row (>=1)")
+    sp.add_argument("--interval", type=float, default=1.0)
+    sp.add_argument("--iterations", type=int, default=0, help="0=forever")
+    sp.add_argument("--clear", action="store_true")
+    sp.set_defaults(fn=cmd_mon)
 
     sp = sub.add_parser("perf", help="software counter dump (xenperf)")
     sp.add_argument("file", help="obs dump JSON (obs.dumpfile)")

@@ -1,7 +1,10 @@
 from pbs_tpu.obs.lockprof import ProfiledLock
+from pbs_tpu.obs.mon import Monitor, SchedHistory
+from pbs_tpu.obs.oprofile import ProfileSession, ProfilerBusy
 from pbs_tpu.obs.perfc import Perfc, perfc
 from pbs_tpu.obs.trace import Ev, TraceBuffer, format_records
 
 __all__ = [
-    "Ev", "Perfc", "ProfiledLock", "TraceBuffer", "format_records", "perfc",
+    "Ev", "Monitor", "Perfc", "ProfileSession", "ProfilerBusy",
+    "ProfiledLock", "SchedHistory", "TraceBuffer", "format_records", "perfc",
 ]

@@ -1,0 +1,267 @@
+"""System-wide sampling profiler backend (xenoprof analog).
+
+Reference: xenoprof (``xen/common/xenoprof.c``, 921 LoC +
+``arch/x86/oprofile/``) lets one privileged domain drive system-wide
+PMU sampling: it *reserves* the PMU (mutually exclusive with perfctr
+and the NMI watchdog — ``perfctr_glue.h:38``), walks a state machine
+(init → ready → start → stop), collects samples into per-domain shared
+buffers with a lost-sample counter, and supports **passive domains** —
+guests profiled without their cooperation.
+
+TPU re-expression: one :class:`ProfileSession` per process may hold the
+profiler reservation. It samples at a fixed period on the partition's
+timer wheel (so sim/virtual-clock runs are deterministic), folding
+per-context counter deltas into bounded per-job sample buffers. Passive
+partitions — other processes' partitions that know nothing about the
+profiler — are sampled through read-only attachment to their
+file-backed telemetry ledgers, the same privileged-observer pattern as
+xenoprof's passive-domain buffers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+from typing import TYPE_CHECKING
+
+from pbs_tpu.telemetry.counters import Counter
+from pbs_tpu.telemetry.ledger import Ledger
+from pbs_tpu.utils.clock import MS
+
+if TYPE_CHECKING:
+    from pbs_tpu.runtime.partition import Partition
+
+
+class ProfilerBusy(RuntimeError):
+    """The PMU-reservation analog: only one profiler at a time
+    (``perfctr_cpu_reserve`` arbitration)."""
+
+
+_res_lock = threading.Lock()
+_owner: str | None = None
+
+
+def reserve(owner: str) -> None:
+    global _owner
+    with _res_lock:
+        if _owner is not None and _owner != owner:
+            raise ProfilerBusy(f"profiler reserved by {_owner!r}")
+        _owner = owner
+
+
+def release(owner: str) -> None:
+    global _owner
+    with _res_lock:
+        if _owner == owner:
+            _owner = None
+
+
+def current_owner() -> str | None:
+    return _owner
+
+
+class SessionState(enum.Enum):
+    # xenoprof's lifecycle (xenoprof.c state machine)
+    INIT = "init"
+    READY = "ready"
+    RUNNING = "running"
+    STOPPED = "stopped"
+    CLOSED = "closed"
+
+
+@dataclasses.dataclass
+class Sample:
+    """One periodic observation of one context — the PC-sample analog:
+    *where* a TPU job is, is its step index; *what it is doing* is the
+    counter mix since the last sample."""
+
+    ts_ns: int
+    ctx: str
+    step: int  # steps retired at sample time (the "program counter")
+    device_dns: int  # device time delta since previous sample
+    stall_dns: int  # HBM-stall delta
+    coll_wait_dns: int  # collective-wait delta
+
+
+class ProfileSession:
+    """One system-wide sampling session over a partition.
+
+    ``max_samples_per_job`` bounds memory like xenoprof's shared sample
+    buffers; overflow increments ``lost`` instead of blocking (same
+    contract as the trace rings).
+    """
+
+    def __init__(
+        self,
+        partition: "Partition",
+        period_ns: int = 1 * MS,  # CSCHED_METRIC_TICK_PERIOD-class cadence
+        max_samples_per_job: int = 4096,
+    ):
+        self.partition = partition
+        self.period_ns = period_ns
+        self.max_samples = max_samples_per_job
+        self.samples: dict[str, list[Sample]] = {}
+        self.lost: dict[str, int] = {}
+        self._last: dict[str, tuple[int, int, int]] = {}  # ctx -> prev ctrs
+        self._last_cw: dict[str, int] = {}  # ctx -> prev collective-wait
+        self._passive: list[tuple[str, Ledger, dict]] = []
+        self._passive_last: dict[str, dict[int, tuple[int, int, int]]] = {}
+        self._timer = None
+        # Unique per session: two sessions over the same partition must
+        # still exclude each other.
+        self._token = f"oprofile:{partition.name}:{id(self)}"
+        reserve(self._token)
+        self.state = SessionState.INIT
+
+    # -- passive domains (profiled without their cooperation) ------------
+
+    def add_passive(self, name: str, ledger_path: str) -> None:
+        """Attach another process's partition read-only through its
+        file-backed ledger (the xenoprof passive-domain buffer)."""
+        if self.state not in (SessionState.INIT, SessionState.READY):
+            raise RuntimeError("passive domains attach before start")
+        led = Ledger.file_backed(ledger_path, readonly=True)
+        import json
+
+        try:
+            with open(ledger_path + ".meta.json") as f:
+                meta = json.load(f)
+        except FileNotFoundError:
+            meta = {"slots": {}}
+        self._passive.append((name, led, meta))
+        self._passive_last[name] = {}
+        self.state = SessionState.READY
+
+    # -- lifecycle (xenoprof.c init/start/stop/close) --------------------
+
+    def start(self) -> "ProfileSession":
+        if self.state is SessionState.CLOSED:
+            raise RuntimeError("session closed")
+        self._prime()
+        now = self.partition.clock.now_ns()
+        self._timer = self.partition.timers.arm(
+            now + self.period_ns, self._tick, period_ns=self.period_ns,
+            name="oprofile")
+        self.state = SessionState.RUNNING
+        return self
+
+    def _prime(self) -> None:
+        """Capture counter baselines at start so the first sample covers
+        only session time — never the job's whole pre-session history."""
+        for job in self.partition.jobs:
+            for ctx in job.contexts:
+                self._last[ctx.name] = (
+                    int(ctx.counters[Counter.STEPS_RETIRED]),
+                    int(ctx.counters[Counter.DEVICE_TIME_NS]),
+                    int(ctx.counters[Counter.HBM_STALL_NS]),
+                )
+                self._last_cw[ctx.name] = int(
+                    ctx.counters[Counter.COLLECTIVE_WAIT_NS])
+        for name, led, meta in self._passive:
+            last = self._passive_last[name]
+            for slot_s in meta.get("slots", {}):
+                slot = int(slot_s)
+                snap = led.snapshot(slot)
+                last[slot] = (
+                    int(snap[Counter.STEPS_RETIRED]),
+                    int(snap[Counter.DEVICE_TIME_NS]),
+                    int(snap[Counter.HBM_STALL_NS]),
+                )
+
+    def stop(self) -> None:
+        if self._timer is not None:
+            self._timer.stop()
+            self._timer = None
+        if self.state is SessionState.RUNNING:
+            self.state = SessionState.STOPPED
+
+    def close(self) -> None:
+        self.stop()
+        release(self._token)
+        self.state = SessionState.CLOSED
+
+    def __enter__(self) -> "ProfileSession":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- sampling --------------------------------------------------------
+
+    def _record(self, job: str, s: Sample) -> None:
+        buf = self.samples.setdefault(job, [])
+        if len(buf) >= self.max_samples:
+            self.lost[job] = self.lost.get(job, 0) + 1
+            return
+        buf.append(s)
+
+    def _tick(self, now_ns: int) -> None:
+        # Active domains: the hosting partition's own jobs.
+        for job in self.partition.jobs:
+            for ctx in job.contexts:
+                cur = (
+                    int(ctx.counters[Counter.STEPS_RETIRED]),
+                    int(ctx.counters[Counter.DEVICE_TIME_NS]),
+                    int(ctx.counters[Counter.HBM_STALL_NS]),
+                )
+                cw = int(ctx.counters[Counter.COLLECTIVE_WAIT_NS])
+                prev = self._last.get(ctx.name, (0, 0, 0))
+                prev_cw = self._last_cw.get(ctx.name, 0)
+                if cur == prev and cw == prev_cw:
+                    # idle since last tick: no sample (unhalted cycles
+                    # only, like PMU sampling). Baselines stay put so
+                    # activity accrued across idle ticks lands on the
+                    # next recorded sample rather than vanishing.
+                    continue
+                self._last[ctx.name] = cur
+                self._last_cw[ctx.name] = cw
+                self._record(job.name, Sample(
+                    ts_ns=now_ns, ctx=ctx.name, step=cur[0],
+                    device_dns=cur[1] - prev[1],
+                    stall_dns=cur[2] - prev[2],
+                    coll_wait_dns=cw - prev_cw,
+                ))
+        # Passive domains: lock-free ledger snapshots of foreign
+        # partitions.
+        for name, led, meta in self._passive:
+            last = self._passive_last[name]
+            for slot_s, info in meta.get("slots", {}).items():
+                slot = int(slot_s)
+                snap = led.snapshot(slot)
+                cur = (
+                    int(snap[Counter.STEPS_RETIRED]),
+                    int(snap[Counter.DEVICE_TIME_NS]),
+                    int(snap[Counter.HBM_STALL_NS]),
+                )
+                prev = last.get(slot, (0, 0, 0))
+                if cur == prev:
+                    continue
+                last[slot] = cur
+                self._record(f"{name}/{info.get('job', slot)}", Sample(
+                    ts_ns=now_ns, ctx=info.get("ctx", str(slot)),
+                    step=cur[0],
+                    device_dns=cur[1] - prev[1],
+                    stall_dns=cur[2] - prev[2],
+                    coll_wait_dns=0,
+                ))
+
+    # -- report ----------------------------------------------------------
+
+    def report(self) -> dict:
+        """Flat profile per job: sample counts and where device time
+        went (the opreport analog)."""
+        out = {}
+        for job, samples in self.samples.items():
+            dev = sum(s.device_dns for s in samples)
+            stall = sum(s.stall_dns for s in samples)
+            coll = sum(s.coll_wait_dns for s in samples)
+            out[job] = {
+                "samples": len(samples),
+                "lost": self.lost.get(job, 0),
+                "device_ms": round(dev / 1e6, 3),
+                "stall_pct": round(100.0 * stall / dev, 2) if dev else 0.0,
+                "collective_wait_ms": round(coll / 1e6, 3),
+                "last_step": samples[-1].step if samples else 0,
+            }
+        return out

@@ -63,7 +63,7 @@ class TraceBuffer:
     """One SPSC ring. Producer: an executor. Consumer: a monitor."""
 
     def __init__(self, capacity: int | None = None, buf=None,
-                 native: bool | None = None):
+                 native: bool | None = None, _attach: bool = False):
         self.capacity = capacity = (
             capacity if capacity is not None else _tbuf_size.value)
         nwords = TRACE_HEADER_WORDS + capacity * TRACE_REC_WORDS
@@ -81,6 +81,8 @@ class TraceBuffer:
                 self._ptr = native_mod.as_u64p(self._arr)
             elif native is True:
                 raise RuntimeError("native runtime requested but unavailable")
+        if _attach:
+            return  # consumer attach: the producer owns the header
         if self._nat is not None:
             self._nat.pbst_trace_init(self._ptr, capacity)
         else:
@@ -88,6 +90,41 @@ class TraceBuffer:
             self._arr[1] = 0
             self._arr[2] = capacity
             self._arr[3] = 0
+
+    @classmethod
+    def file_backed(cls, path: str, capacity: int | None = None,
+                    native: bool | None = None,
+                    attach: bool = False) -> "TraceBuffer":
+        """Ring over a shared mmap — xenbaked's view of the hypervisor
+        trace pages (``tools/xenmon/xenbaked.c`` maps the per-CPU rings
+        dom0-side). ``attach=True`` joins an existing producer's ring as
+        the (single) consumer: the header is left alone and capacity
+        comes from the file. The mapping is read-write either way — the
+        consumer must advance the shared tail word."""
+        import mmap
+        import os
+
+        if attach:
+            fd = os.open(path, os.O_RDWR)
+            try:
+                mm = mmap.mmap(fd, os.fstat(fd).st_size)
+            finally:
+                os.close(fd)
+            cap = int(np.frombuffer(mm, dtype="<u8", count=3)[2])
+            tb = cls(cap, buf=mm, native=native, _attach=True)
+        else:
+            capacity = capacity if capacity is not None else _tbuf_size.value
+            nbytes = (TRACE_HEADER_WORDS + capacity * TRACE_REC_WORDS) * 8
+            fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+            try:
+                if os.fstat(fd).st_size < nbytes:
+                    os.ftruncate(fd, nbytes)
+                mm = mmap.mmap(fd, nbytes)
+            finally:
+                os.close(fd)
+            tb = cls(capacity, buf=mm, native=native)
+        tb._mmap = mm
+        return tb
 
     # -- producer --------------------------------------------------------
 
@@ -151,6 +188,17 @@ class TraceBuffer:
         if self._nat is not None:
             return int(self._nat.pbst_trace_lost(self._ptr))
         return int(self._arr[3])
+
+
+def merge_records(chunks: list[np.ndarray]) -> np.ndarray:
+    """Merge per-ring record batches into one time-sorted stream (the
+    xentrace multi-CPU merge). Stable sort keeps same-timestamp records
+    in ring order."""
+    chunks = [c for c in chunks if len(c)]
+    if not chunks:
+        return np.empty((0, TRACE_REC_WORDS), dtype="<u8")
+    allr = np.concatenate(chunks, axis=0)
+    return allr[np.argsort(allr[:, 0], kind="stable")]
 
 
 def format_records(recs: np.ndarray) -> list[str]:

@@ -19,7 +19,9 @@ from typing import Any, Callable
 
 import numpy as np
 
-from pbs_tpu.obs.trace import Ev, TraceBuffer
+import os
+
+from pbs_tpu.obs.trace import Ev, TraceBuffer, merge_records
 from pbs_tpu.runtime.events import EventBus, Virq
 from pbs_tpu.runtime.executor import Executor
 from pbs_tpu.runtime.job import ContextState, Job, SchedParams
@@ -48,6 +50,7 @@ class Partition:
         clock: Clock | None = None,
         ledger_slots: int = DEFAULT_LEDGER_SLOTS,
         ledger_path: str | None = None,
+        trace_dir: str | None = None,
         sched_params: dict[str, Any] | None = None,
     ):
         self.name = name
@@ -77,11 +80,23 @@ class Partition:
             scheduler if scheduler is not None else _sched_param.value,
             self, **(sched_params or {})
         )
+        # File-backed rings let an external xenbaked-style monitor attach
+        # live (obs.mon); otherwise rings live in process memory.
+        # Absolute path: the meta sidecar publishes it for monitors that
+        # run with a different working directory.
+        self._trace_dir = (
+            os.path.abspath(trace_dir) if trace_dir is not None else None)
+        if self._trace_dir is not None:
+            os.makedirs(self._trace_dir, exist_ok=True)
         devices = devices or [None] * n_executors
         for i, dev in enumerate(devices):
             ex = Executor(self, i, device=dev)
             self.executors.append(ex)
-            self.traces.append(TraceBuffer())
+            if self._trace_dir is not None:
+                self.traces.append(TraceBuffer.file_backed(
+                    os.path.join(self._trace_dir, f"trace{i}.ring")))
+            else:
+                self.traces.append(TraceBuffer())
             self.scheduler.executor_added(ex)
 
     # -- admission (domain_create analog, xen/common/domain.c) -----------
@@ -231,6 +246,8 @@ class Partition:
         meta = {
             "partition": self.name,
             "scheduler": self.scheduler.name,
+            "trace_dir": self._trace_dir,
+            "n_rings": len(self.traces),
             "slots": {
                 str(ctx.ledger_slot): {
                     "ctx": ctx.name,
@@ -247,8 +264,6 @@ class Partition:
         tmp = self._ledger_path + ".meta.json.tmp"
         with open(tmp, "w") as f:
             json.dump(meta, f, indent=1)
-        import os
-
         os.replace(tmp, self._ledger_path + ".meta.json")
 
     def trace_emit(self, exi: int, event: int, *args: int) -> None:
@@ -258,21 +273,11 @@ class Partition:
     def peek_traces(self, max_records: int = 4096):
         """Non-destructive tail of all rings, merged and time-sorted —
         for postmortems/snapshots that must not race a live consumer."""
-        chunks = [t.peek(max_records) for t in self.traces]
-        chunks = [c for c in chunks if len(c)]
-        if not chunks:
-            return np.empty((0, 8), dtype="<u8")
-        allr = np.concatenate(chunks, axis=0)
-        return allr[np.argsort(allr[:, 0], kind="stable")]
+        return merge_records([t.peek(max_records) for t in self.traces])
 
     def drain_traces(self, max_records: int = 4096):
         """xentrace analog: drain all rings, merged and time-sorted."""
-        chunks = [t.consume(max_records) for t in self.traces]
-        chunks = [c for c in chunks if len(c)]
-        if not chunks:
-            return np.empty((0, 8), dtype="<u8")
-        allr = np.concatenate(chunks, axis=0)
-        return allr[np.argsort(allr[:, 0], kind="stable")]
+        return merge_records([t.consume(max_records) for t in self.traces])
 
     def dump(self) -> dict[str, Any]:
         """The 'r'/'z' console-key dump surface
