@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import Any, Callable
 
 from pbs_tpu.dist.rpc import RpcServer
+from pbs_tpu.runtime.xsm import XsmDenied, xsm_check
 from pbs_tpu.runtime.job import Job, SchedParams
 from pbs_tpu.runtime.partition import Partition
 from pbs_tpu.telemetry.counters import counters_dict
@@ -45,6 +46,7 @@ def sim_workload(partition: Partition, job_name: str, spec: dict) -> Job:
         n_contexts=int(spec.get("n_contexts", 1)),
         gang=bool(spec.get("gang", False)),
         max_steps=spec.get("max_steps"),
+        label=str(spec.get("label", "user")),
     )
     return partition.add_job(job)
 
@@ -96,23 +98,40 @@ class Agent:
         }
 
     def op_create_job(self, job: str, workload: str = "sim",
-                      spec: dict | None = None) -> dict:
+                      spec: dict | None = None,
+                      subject: str = "remote") -> dict:
+        # XSM hook at the dispatch surface (do_domctl placement): the
+        # subject is the caller's declared label — same trust model as
+        # Xen believing dom0's identity via the privileged interface.
+        xsm_check(subject, "job.create", (spec or {}).get("label", "user"))
         factory = self.workloads.get(workload)
         if factory is None:
             raise LookupError(f"unknown workload {workload!r}")
         if any(j.name == job for j in self.partition.jobs):
             raise ValueError(f"job {job!r} already exists")
         j = factory(self.partition, job, spec or {})
+        # Re-check against the label the factory ACTUALLY assigned — a
+        # custom factory may ignore spec['label'], and the pre-check
+        # must not be the last word. Denial rolls the job back.
+        try:
+            xsm_check(subject, "job.create", j.label)
+        except XsmDenied:
+            self.partition.remove_job(j)
+            raise
         return {"job": j.name, "n_contexts": len(j.contexts)}
 
-    def op_remove_job(self, job: str) -> bool:
-        self.partition.remove_job(self.partition.job(job))
+    def op_remove_job(self, job: str, subject: str = "remote") -> bool:
+        j = self.partition.job(job)
+        xsm_check(subject, "job.destroy", j.label)
+        self.partition.remove_job(j)
         return True
 
     def op_sched_setparams(self, job: str, weight: int | None = None,
                            cap: int | None = None,
-                           tslice_us: int | None = None) -> dict:
+                           tslice_us: int | None = None,
+                           subject: str = "remote") -> dict:
         j = self.partition.job(job)
+        xsm_check(subject, "job.sched_cntl", j.label)
         changes = {k: int(v) for k, v in
                    (("weight", weight), ("cap", cap), ("tslice_us", tslice_us))
                    if v is not None}
@@ -122,12 +141,16 @@ class Agent:
         p = j.params
         return {"weight": p.weight, "cap": p.cap, "tslice_us": p.tslice_us}
 
-    def op_pause_job(self, job: str) -> bool:
-        self.partition.sleep_job(self.partition.job(job))
+    def op_pause_job(self, job: str, subject: str = "remote") -> bool:
+        j = self.partition.job(job)
+        xsm_check(subject, "job.pause", j.label)
+        self.partition.sleep_job(j)
         return True
 
-    def op_unpause_job(self, job: str) -> bool:
-        self.partition.wake_job(self.partition.job(job))
+    def op_unpause_job(self, job: str, subject: str = "remote") -> bool:
+        j = self.partition.job(job)
+        xsm_check(subject, "job.unpause", j.label)
+        self.partition.wake_job(j)
         return True
 
     def op_run(self, max_rounds: int | None = None,

@@ -65,11 +65,15 @@ class JobRecord:
 
 
 class Controller:
-    def __init__(self, dead_after_missed: int = 2):
+    def __init__(self, dead_after_missed: int = 2,
+                 subject: str = "controller"):
         self.agents: dict[str, AgentHandle] = {}
         self.jobs: dict[str, JobRecord] = {}
         self.dead_after_missed = dead_after_missed
         self.last_round_errors: dict[str, Exception] = {}
+        # XSM identity presented on every job-mutating agent op; under
+        # an enforcing agent policy, grant this label (or pass your own).
+        self.subject = subject
 
     # -- membership ------------------------------------------------------
 
@@ -122,7 +126,8 @@ class Controller:
             stale = present - expected
             if stale:
                 results = h.client.multicall(
-                    [("remove_job", {"job": j}) for j in sorted(stale)])
+                    [("remove_job", {"job": j, "subject": self.subject})
+                     for j in sorted(stale)])
                 if not all(r.get("ok") for r in results):
                     return False
         except Exception:  # noqa: BLE001 — it may have died again
@@ -204,14 +209,16 @@ class Controller:
             for i, h in enumerate(targets):
                 member_name = name if n_members == 1 else f"{name}.{i}"
                 h.client.call("create_job", job=member_name,
-                              workload=workload, spec=spec)
+                              workload=workload, spec=spec,
+                              subject=self.subject)
                 members.append(MemberRef(h.name, member_name))
         except Exception:
             # Roll back already-placed members so a failed fan-out
             # leaves no orphans and the name stays retryable.
             for m in members:
                 try:
-                    self.agents[m.agent].client.call("remove_job", job=m.job)
+                    self.agents[m.agent].client.call(
+                        "remove_job", job=m.job, subject=self.subject)
                 except Exception:  # noqa: BLE001 — host may be dead too
                     pass
             raise
@@ -226,7 +233,7 @@ class Controller:
             if h is None or not h.alive:
                 continue
             try:
-                h.client.call("remove_job", job=m.job)
+                h.client.call("remove_job", job=m.job, subject=self.subject)
             except Exception:  # noqa: BLE001 — host may have just died
                 pass
 
@@ -236,7 +243,8 @@ class Controller:
         by_agent: dict[str, list] = {}
         for m in rec.members:
             by_agent.setdefault(m.agent, []).append(
-                ("sched_setparams", {"job": m.job, **params}))
+                ("sched_setparams",
+                 {"job": m.job, "subject": self.subject, **params}))
         for agent, calls in by_agent.items():
             for call, r in zip(
                     calls, self.agents[agent].client.multicall(calls)):
@@ -315,7 +323,8 @@ class Controller:
                     raise RuntimeError(f"no live host for {rec.name}/{m.job}")
                 target = ranked[0]
                 target.client.call("create_job", job=m.job,
-                                   workload=rec.workload, spec=rec.spec)
+                                   workload=rec.workload, spec=rec.spec,
+                                   subject=self.subject)
                 m.agent = target.name
                 moved.append(m.job)
         return moved

@@ -1,5 +1,21 @@
 from pbs_tpu.runtime.events import EventBus, EventChannel, Virq
 from pbs_tpu.runtime.executor import Executor, quantum_to_steps
+from pbs_tpu.runtime.grants import (
+    GrantBusy,
+    GrantDenied,
+    GrantError,
+    GrantMapping,
+    GrantTable,
+    SharedRegion,
+    map_grant,
+)
+from pbs_tpu.runtime.xsm import (
+    DummyPolicy,
+    LabelPolicy,
+    XsmDenied,
+    set_policy,
+    xsm_check,
+)
 from pbs_tpu.runtime.job import ContextState, ExecutionContext, Job, SchedParams
 from pbs_tpu.runtime.partition import Partition
 from pbs_tpu.runtime.timer import Timer, TimerWheel
@@ -12,10 +28,18 @@ from pbs_tpu.runtime.watchdog import (
 
 __all__ = [
     "ContextState",
+    "DummyPolicy",
     "EventBus",
     "EventChannel",
     "ExecutionContext",
     "Executor",
+    "GrantBusy",
+    "GrantDenied",
+    "GrantError",
+    "GrantMapping",
+    "GrantTable",
+    "LabelPolicy",
+    "SharedRegion",
     "Virq",
     "Job",
     "Partition",
@@ -24,7 +48,11 @@ __all__ = [
     "TimerWheel",
     "WallWatchdog",
     "Watchdog",
+    "XsmDenied",
     "install_crash_handler",
+    "map_grant",
     "quantum_to_steps",
+    "set_policy",
     "write_crash_dump",
+    "xsm_check",
 ]

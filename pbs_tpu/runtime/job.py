@@ -83,8 +83,11 @@ class Job:
         max_steps: int | None = None,
         n_contexts: int = 1,
         gang: bool = False,
+        label: str = "user",
     ):
         self.name = name
+        # Security label for XSM checks (the FLASK domain label).
+        self.label = label
         self.step_fn = step_fn
         self.state = state
         self.params = params or SchedParams()

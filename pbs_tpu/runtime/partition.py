@@ -22,6 +22,7 @@ import numpy as np
 import os
 
 from pbs_tpu.obs.trace import Ev, TraceBuffer, merge_records
+from pbs_tpu.runtime import xsm
 from pbs_tpu.runtime.events import EventBus, Virq
 from pbs_tpu.runtime.executor import Executor
 from pbs_tpu.runtime.job import ContextState, Job, SchedParams
@@ -101,7 +102,8 @@ class Partition:
 
     # -- admission (domain_create analog, xen/common/domain.c) -----------
 
-    def add_job(self, job: Job) -> Job:
+    def add_job(self, job: Job, subject: str = xsm.SYSTEM) -> Job:
+        xsm.xsm_check(subject, "job.create", job.label)
         for ctx in job.contexts:
             if not self._free_slots:
                 raise RuntimeError("ledger slots exhausted")
@@ -126,7 +128,8 @@ class Partition:
         job = Job(name, step_fn=step_fn, state=state, params=params, **kw)
         return self.add_job(job)
 
-    def remove_job(self, job: Job) -> None:
+    def remove_job(self, job: Job, subject: str = xsm.SYSTEM) -> None:
+        xsm.xsm_check(subject, "job.destroy", job.label)
         self.scheduler.job_removed(job)
         self.jobs.remove(job)
         for ctx in job.contexts:

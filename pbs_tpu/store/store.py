@@ -20,6 +20,7 @@ import os
 from typing import Any, Callable
 
 from pbs_tpu.obs.lockprof import ProfiledLock
+from pbs_tpu.runtime.xsm import xsm_check
 
 
 def _norm(path: str) -> str:
@@ -49,8 +50,9 @@ class Store:
 
     # -- basic ops -------------------------------------------------------
 
-    def write(self, path: str, value: Any) -> None:
+    def write(self, path: str, value: Any, subject: str = "system") -> None:
         path = _norm(path)
+        xsm_check(subject, "store.write", path)
         with self._lock:
             self._data[path] = value
             self._version[path] = self._version.get(path, 0) + 1
@@ -65,10 +67,11 @@ class Store:
     def exists(self, path: str) -> bool:
         return _norm(path) in self._data
 
-    def rm(self, path: str) -> int:
+    def rm(self, path: str, subject: str = "system") -> int:
         """Remove path and its whole subtree (xenstore rm). Returns the
         number of removed keys."""
         path = _norm(path)
+        xsm_check(subject, "store.rm", path)
         with self._lock:
             doomed = [k for k in self._data
                       if k == path or k.startswith(path + "/")]
@@ -109,8 +112,8 @@ class Store:
 
     # -- transactions ----------------------------------------------------
 
-    def transaction(self) -> "Transaction":
-        return Transaction(self)
+    def transaction(self, subject: str = "system") -> "Transaction":
+        return Transaction(self, subject=subject)
 
     def _save(self) -> None:
         if not self._persist:
@@ -125,8 +128,9 @@ class Transaction:
     """Optimistic all-or-nothing batch: reads record versions; commit
     fails if any read key changed (xenstore transaction semantics)."""
 
-    def __init__(self, store: Store):
+    def __init__(self, store: Store, subject: str = "system"):
         self.store = store
+        self.subject = subject
         self._reads: dict[str, int] = {}
         self._writes: dict[str, Any] = {}
         self._rms: list[str] = []
@@ -146,6 +150,13 @@ class Transaction:
 
     def commit(self) -> None:
         s = self.store
+        # XSM before any mutation: a transaction must not bypass the
+        # checks its individual ops would face (and a denial must leave
+        # the batch unapplied — all-or-nothing includes policy).
+        for path in self._rms:
+            xsm_check(self.subject, "store.rm", path)
+        for path in self._writes:
+            xsm_check(self.subject, "store.write", path)
         with s._lock:
             for path, ver in self._reads.items():
                 if s.version(path) != ver:

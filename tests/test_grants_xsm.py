@@ -1,0 +1,277 @@
+"""Grant tables (zero-copy shared memory) + XSM access control."""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+
+import numpy as np
+import pytest
+
+from pbs_tpu.runtime import (
+    GrantBusy,
+    GrantDenied,
+    GrantError,
+    GrantTable,
+    Job,
+    Partition,
+    SharedRegion,
+    XsmDenied,
+    map_grant,
+    set_policy,
+)
+from pbs_tpu.runtime.xsm import DummyPolicy, LabelPolicy
+from pbs_tpu.telemetry import SimBackend, SimProfile
+from pbs_tpu.utils.clock import MS
+
+
+@pytest.fixture(autouse=True)
+def _dummy_policy():
+    set_policy(DummyPolicy())
+    yield
+    set_policy(DummyPolicy())
+
+
+# -- grant tables -----------------------------------------------------------
+
+
+@pytest.fixture
+def region():
+    r = SharedRegion(size=4096, create=True)
+    yield r
+    r.close()
+    r.unlink()
+
+
+def test_grant_map_unmap_refcount(region):
+    gt = GrantTable("domA")
+    ref = gt.grant_access("domB", region, offset=100, length=256)
+    with gt.map_ref(ref, "domB", write=True) as m:
+        m.data[:4] = [1, 2, 3, 4]
+        assert gt.entry(ref).use_count == 1
+        with pytest.raises(GrantBusy):
+            gt.end_access(ref)
+    assert gt.entry(ref).use_count == 0
+    # data landed in the granter's region at the offset
+    assert list(region.view(100, 4)) == [1, 2, 3, 4]
+    gt.end_access(ref)
+    with pytest.raises(GrantError, match="revoked"):
+        gt.map_ref(ref, "domB")
+
+
+def test_grant_enforces_grantee_and_mode(region):
+    gt = GrantTable("domA")
+    ref = gt.grant_access("domB", region, readonly=True)
+    with pytest.raises(GrantDenied, match="not"):
+        gt.map_ref(ref, "domC")
+    with pytest.raises(GrantDenied, match="read-only"):
+        gt.map_ref(ref, "domB", write=True)
+    m = gt.map_ref(ref, "domB")
+    assert not m.data.flags.writeable
+    m.unmap()
+
+
+def test_grant_range_validation(region):
+    gt = GrantTable("domA")
+    with pytest.raises(GrantError, match="outside"):
+        gt.grant_access("domB", region, offset=4000, length=200)
+
+
+def test_grant_transfer_moves_ownership(region):
+    gt = GrantTable("domA")
+    ref = gt.grant_access("domB", region)
+    e = gt.transfer(ref, "domB")
+    assert e.transferred_to == "domB"
+    with pytest.raises(GrantError, match="bad grant ref"):
+        gt.entry(ref)
+
+
+def test_force_end_access_while_mapped(region):
+    gt = GrantTable("domA")
+    ref = gt.grant_access("domB", region)
+    m = gt.map_ref(ref, "domB", write=True)
+    gt.end_access(ref, force=True)  # orphan the mapping
+    m.data[0] = 7  # mapping stays valid (page-orphaning semantics)
+    m.unmap()
+    with pytest.raises(GrantError, match="revoked"):
+        gt.map_ref(ref, "domB")
+
+
+def _child_fill(desc: dict, q: mp.Queue) -> None:
+    region, view = map_grant(desc, write=True)
+    try:
+        view[:] = np.arange(len(view), dtype=np.uint8)
+        q.put("done")
+    finally:
+        del view
+        region.close()
+
+
+def test_grant_cross_process_zero_copy(region):
+    """The blkfront/blkback pattern: peer process maps the granted range
+    by wire description and writes; granter sees the bytes."""
+    gt = GrantTable("domA")
+    ref = gt.grant_access("peer", region, offset=64, length=128)
+    desc = gt.entry(ref).describe()
+    ctx = mp.get_context("spawn")  # no fork: this process is threaded
+    q = ctx.Queue()
+    p = ctx.Process(target=_child_fill, args=(desc, q))
+    p.start()
+    assert q.get(timeout=30) == "done"
+    p.join(timeout=10)
+    assert list(region.view(64, 8)) == [0, 1, 2, 3, 4, 5, 6, 7]
+    assert list(region.view(64 + 127, 1)) == [127]
+
+
+# -- XSM --------------------------------------------------------------------
+
+
+def test_label_policy_rules_first_match_wins():
+    pol = (LabelPolicy()
+           .deny("tenant-*", "job.destroy", "prod")
+           .allow("tenant-*", "job.*")
+           .allow("ops", "*"))
+    assert pol.check("tenant-a", "job.create", "dev")
+    assert not pol.check("tenant-a", "job.destroy", "prod")
+    assert pol.check("ops", "store.write", "/x")
+    assert not pol.check("nobody", "job.create", "dev")  # default deny
+    assert ("nobody", "job.create", "dev") in pol.denials
+    assert pol.check("system", "anything", None)  # system always passes
+
+
+def test_partition_admission_enforces_policy():
+    set_policy(LabelPolicy().allow("scheduler", "job.create", "user"))
+    be = SimBackend()
+    part = Partition("p", source=be, scheduler="credit")
+    be.register("ok", SimProfile.steady(step_time_ns=1 * MS))
+    be.register("secret", SimProfile.steady(step_time_ns=1 * MS))
+    part.add_job(Job("ok"), subject="scheduler")  # label=user: allowed
+    with pytest.raises(XsmDenied):
+        part.add_job(Job("secret", label="classified"), subject="scheduler")
+    # default subject is system: always allowed (dom0 path)
+    part.add_job(Job("secret2", label="classified"))
+    with pytest.raises(XsmDenied):
+        part.remove_job(part.job("secret2"), subject="scheduler")
+
+
+def test_agent_ops_enforce_policy():
+    from pbs_tpu.dist import Agent
+    from pbs_tpu.dist.rpc import RpcClient, RpcError
+
+    set_policy(LabelPolicy()
+               .allow("ctl", "job.create", "user")
+               .allow("ctl", "job.sched_cntl", "user"))
+    agent = Agent("a1").start()
+    try:
+        cli = RpcClient(agent.address)
+        cli.call("create_job", job="j1", subject="ctl",
+                 spec={"max_steps": 5})
+        cli.call("sched_setparams", job="j1", weight=512, subject="ctl")
+        with pytest.raises(RpcError, match="XsmDenied"):
+            cli.call("remove_job", job="j1", subject="ctl")
+        with pytest.raises(RpcError, match="XsmDenied"):
+            cli.call("create_job", job="evil", subject="intruder",
+                     spec={"max_steps": 5})
+        cli.close()
+    finally:
+        agent.stop()
+        set_policy(DummyPolicy())
+
+
+def test_store_write_enforces_policy(tmp_path):
+    from pbs_tpu.store import Store
+
+    set_policy(LabelPolicy().allow("app", "store.write", "/jobs/*"))
+    s = Store()
+    s.write("/jobs/a/weight", 256, subject="app")
+    with pytest.raises(XsmDenied):
+        s.write("/secrets/key", "x", subject="app")
+    s.write("/secrets/key", "x")  # system default
+    assert s.read("/jobs/a/weight") == 256
+
+
+def test_store_rm_and_transactions_cannot_bypass_policy():
+    """rm and transaction commits face the same checks as write —
+    mutation paths must not route around the policy."""
+    from pbs_tpu.store import Store
+
+    set_policy(LabelPolicy().allow("app", "store.write", "/jobs/*"))
+    s = Store()
+    s.write("/secrets/key", "x")
+    with pytest.raises(XsmDenied):
+        s.rm("/secrets", subject="app")
+    t = s.transaction(subject="app")
+    t.write("/jobs/a", 1)
+    t.write("/secrets/key", "y")
+    with pytest.raises(XsmDenied):
+        t.commit()
+    # denial left the batch unapplied (all-or-nothing includes policy)
+    assert not s.exists("/jobs/a")
+    assert s.read("/secrets/key") == "x"
+
+
+def test_pause_unpause_gated_and_factory_label_rechecked():
+    from pbs_tpu.dist import Agent
+    from pbs_tpu.dist.rpc import RpcClient, RpcError
+    from pbs_tpu.runtime import Job as RJob
+    from pbs_tpu.telemetry import SimProfile as SP
+
+    def sneaky_workload(partition, job_name, spec):
+        # ignores spec['label'] and self-assigns a privileged label
+        partition.source.register(job_name, SP.steady(step_time_ns=1_000_000))
+        return partition.add_job(RJob(job_name, label="classified",
+                                      max_steps=5))
+
+    set_policy(LabelPolicy()
+               .allow("ctl", "job.create", "user")
+               .allow("ctl", "job.pause", "user"))
+    agent = Agent("a2", workloads={"sneaky": sneaky_workload}).start()
+    try:
+        cli = RpcClient(agent.address)
+        # factory-assigned label is re-checked: creation denied + rolled back
+        with pytest.raises(RpcError, match="XsmDenied"):
+            cli.call("create_job", job="s1", workload="sneaky",
+                     subject="ctl", spec={"label": "user"})
+        assert cli.call("list_jobs") == []
+        # pause/unpause are gated ops
+        cli.call("create_job", job="ok", subject="ctl",
+                 spec={"max_steps": 5})
+        cli.call("pause_job", job="ok", subject="ctl")
+        with pytest.raises(RpcError, match="XsmDenied"):
+            cli.call("unpause_job", job="ok", subject="ctl")
+        with pytest.raises(RpcError, match="XsmDenied"):
+            cli.call("pause_job", job="ok", subject="intruder")
+        cli.close()
+    finally:
+        agent.stop()
+        set_policy(DummyPolicy())
+
+
+def test_controller_presents_subject_under_enforcing_policy():
+    from pbs_tpu.dist import Agent, Controller
+
+    set_policy(LabelPolicy().allow("controller", "job.*"))
+    agent = Agent("a3").start()
+    ctl = Controller()
+    ctl.add_agent("a3", agent.address)
+    try:
+        ctl.create_job("cj", spec={"max_steps": 5})
+        ctl.sched_setparams("cj", weight=512)
+        ctl.remove_job("cj")
+        assert ctl.jobs == {}
+    finally:
+        ctl.close()
+        agent.stop()
+        set_policy(DummyPolicy())
+
+
+def test_grant_map_failure_does_not_wedge_refcount(region):
+    gt = GrantTable("domA")
+    ref = gt.grant_access("domB", region)
+    e = gt.entry(ref)
+    real_segment = e.segment
+    e.segment = "pbst-definitely-missing-segment"
+    with pytest.raises(FileNotFoundError):
+        gt.map_ref(ref, "domB")
+    e.segment = real_segment
+    assert gt.entry(ref).use_count == 0
+    gt.end_access(ref)  # must not raise GrantBusy
