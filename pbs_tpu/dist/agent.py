@@ -76,7 +76,7 @@ class Agent:
         self.server = RpcServer(host=host, port=port)
         for op in ("create_job", "remove_job", "sched_setparams",
                    "pause_job", "unpause_job", "run", "dump", "telemetry",
-                   "list_jobs"):
+                   "list_jobs", "save_job", "restore_job"):
             self.server.register(op, getattr(self, "op_" + op))
         # info answers without the dispatch lock: it only reads counts
         # (torn reads are fine for a placement heuristic) and the
@@ -152,6 +152,88 @@ class Agent:
         xsm_check(subject, "job.unpause", j.label)
         self.partition.wake_job(j)
         return True
+
+    # -- save/restore (xc_domain_save/restore over DCN) ------------------
+
+    def op_save_job(self, job: str, subject: str = "remote") -> dict:
+        """Quiesce and serialize one job for migration (``xl save``:
+        pause, then extract state). Unlike the reference — where perfctr
+        shared-page PMU state is NOT in the save records and counters
+        silently reset on migration (SURVEY.md §5) — the telemetry
+        counters travel with the job."""
+        j = self.partition.job(job)
+        xsm_check(subject, "job.save", j.label)
+        self.partition.sleep_job(j)  # stop-and-copy quiesce
+        p = j.params
+        saved: dict = {
+            "job": j.name,
+            "label": j.label,
+            "max_steps": j.max_steps,
+            "gang": j.gang,
+            "sched": {"weight": p.weight, "cap": p.cap,
+                      "tslice_us": p.tslice_us,
+                      "boost_on_wake": p.boost_on_wake},
+            "contexts": [
+                {"sched_count": c.sched_count,
+                 "counters": [int(x) for x in c.counters]}
+                for c in j.contexts
+            ],
+            "contention": [j.contention_wait_ns, j.contention_events],
+            "backend": {},
+        }
+        if isinstance(self.partition.source, SimBackend):
+            saved["backend"]["sim_steps_done"] = (
+                self.partition.source.position(j.name))
+        return saved
+
+    def op_restore_job(self, job: str, workload: str = "sim",
+                       spec: dict | None = None, saved: dict | None = None,
+                       subject: str = "remote") -> dict:
+        """Recreate a saved job and overlay its runtime state
+        (``xc_domain_restore``): scheduler params, per-context telemetry
+        counters (into fresh ledger slots), contention accumulators, and
+        the backend cursor."""
+        import numpy as np
+
+        if saved is None:
+            raise ValueError("restore requires a 'saved' record")
+        xsm_check(subject, "job.restore", saved.get("label", "user"))
+        factory = self.workloads.get(workload)
+        if factory is None:
+            raise LookupError(f"unknown workload {workload!r}")
+        if any(j.name == job for j in self.partition.jobs):
+            raise ValueError(f"job {job!r} already exists")
+        j = factory(self.partition, job, spec or {})
+        # Overlay + label re-check under rollback: a malformed wire
+        # record or a denial must not leave a half-restored orphan
+        # running (the migration retry would then always collide).
+        try:
+            j.label = saved.get("label", j.label)
+            # Re-check the label the job ACTUALLY carries — wire dicts
+            # are arbitrary and spec['label'] must not launder a target
+            # the policy never authorized.
+            xsm_check(subject, "job.restore", j.label)
+            j.max_steps = saved.get("max_steps", j.max_steps)
+            for k, v in saved.get("sched", {}).items():
+                setattr(j.params, k, v)
+            j.contention_wait_ns, j.contention_events = saved.get(
+                "contention", (0, 0))
+            for ctx, cstate in zip(j.contexts, saved.get("contexts", ())):
+                ctx.sched_count = int(cstate.get("sched_count", 0))
+                ctrs = np.array(cstate.get("counters", []), dtype=np.uint64)
+                if len(ctrs) == len(ctx.counters):
+                    ctx.counters = ctrs
+                    if ctx.ledger_slot >= 0:
+                        # fresh slot is zeroed: adding restores the sums
+                        self.partition.ledger.add_many(ctx.ledger_slot, ctrs)
+            be = saved.get("backend", {})
+            if ("sim_steps_done" in be
+                    and isinstance(self.partition.source, SimBackend)):
+                self.partition.source.seek(job, be["sim_steps_done"])
+        except BaseException:
+            self.partition.remove_job(j)
+            raise
+        return {"job": j.name, "steps": j.steps_retired()}
 
     def op_run(self, max_rounds: int | None = None,
                for_us: int | None = None) -> int:

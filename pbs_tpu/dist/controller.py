@@ -252,6 +252,58 @@ class Controller:
                     raise RpcError(f"{agent}:{call[0]}",
                                    r.get("error", "?"), r.get("message", ""))
 
+    def migrate_job(self, name: str, to: str | None = None) -> dict[str, str]:
+        """Live-migrate a job's members off their current hosts (``xl
+        migrate``: save on source, ship over DCN, restore on target,
+        tear down source). Telemetry counters travel with each member —
+        fixing the reference's silent PMU-state reset (SURVEY.md §5).
+
+        ``to`` pins every member to one named agent; otherwise each
+        member goes to the least-loaded *other* live host (gang members
+        keep anti-stacking). On restore failure the source copy is
+        unpaused and keeps running — migration never destroys the only
+        good copy."""
+        rec = self.jobs[name]
+        moved: dict[str, str] = {}
+        for m in rec.members:
+            src = self.agents[m.agent]
+            if to is not None:
+                dst = self.agents[to]
+                if not dst.alive:
+                    raise RuntimeError(f"target agent {to!r} is dead")
+            else:
+                exclude = {m.agent}
+                if rec.gang:
+                    exclude |= {mm.agent for mm in rec.members}
+                ranked = self._ranked_live(
+                    [h for h in self.live_agents() if h.name not in exclude])
+                if not ranked:
+                    raise RuntimeError(f"no live migration target for "
+                                       f"{rec.name}/{m.job}")
+                dst = ranked[0]
+            if dst.name == m.agent:
+                continue
+            saved = src.client.call("save_job", job=m.job,
+                                    subject=self.subject)
+            try:
+                dst.client.call("restore_job", job=m.job,
+                                workload=rec.workload, spec=rec.spec,
+                                saved=saved, subject=self.subject)
+            except Exception:
+                # Abort: resume the source copy (xl migrate's abort path
+                # leaves the domain running at the source).
+                src.client.call("unpause_job", job=m.job,
+                                subject=self.subject)
+                raise
+            try:
+                src.client.call("remove_job", job=m.job,
+                                subject=self.subject)
+            except Exception:  # noqa: BLE001 — source may have died; the
+                pass  # reconcile fence removes the stale copy later
+            m.agent = dst.name
+            moved[m.job] = dst.name
+        return moved
+
     # -- gang rounds (barrier-coordinated lockstep) ----------------------
 
     def run_round(self, max_rounds: int = 64,

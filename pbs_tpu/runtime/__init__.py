@@ -1,5 +1,12 @@
 from pbs_tpu.runtime.events import EventBus, EventChannel, Virq
 from pbs_tpu.runtime.executor import Executor, quantum_to_steps
+from pbs_tpu.runtime.memory import (
+    MemoryAccount,
+    MemoryManager,
+    OutOfDeviceMemory,
+    device_memory_stats,
+    nbytes_of,
+)
 from pbs_tpu.runtime.grants import (
     GrantBusy,
     GrantDenied,
@@ -39,6 +46,9 @@ __all__ = [
     "GrantMapping",
     "GrantTable",
     "LabelPolicy",
+    "MemoryAccount",
+    "MemoryManager",
+    "OutOfDeviceMemory",
     "SharedRegion",
     "Virq",
     "Job",
@@ -49,8 +59,10 @@ __all__ = [
     "WallWatchdog",
     "Watchdog",
     "XsmDenied",
+    "device_memory_stats",
     "install_crash_handler",
     "map_grant",
+    "nbytes_of",
     "quantum_to_steps",
     "set_policy",
     "write_crash_dump",

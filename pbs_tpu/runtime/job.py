@@ -84,10 +84,14 @@ class Job:
         n_contexts: int = 1,
         gang: bool = False,
         label: str = "user",
+        mem_bytes: int | None = None,
     ):
         self.name = name
         # Security label for XSM checks (the FLASK domain label).
         self.label = label
+        # Declared HBM working set; None = estimate from state at
+        # admission (runtime.memory.nbytes_of).
+        self.mem_bytes = mem_bytes
         self.step_fn = step_fn
         self.state = state
         self.params = params or SchedParams()

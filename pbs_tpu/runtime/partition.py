@@ -53,6 +53,7 @@ class Partition:
         ledger_path: str | None = None,
         trace_dir: str | None = None,
         sched_params: dict[str, Any] | None = None,
+        memory: "MemoryManager | None" = None,
     ):
         self.name = name
         self.source = source
@@ -70,6 +71,8 @@ class Partition:
         # Async signaling fabric (event_channel.c analog); delivered by
         # the run loop between quanta.
         self.events = EventBus()
+        # Optional HBM accounting/admission (runtime.memory).
+        self.memory = memory
         self._free_slots = list(range(ledger_slots - 1, -1, -1))
         self.jobs: list[Job] = []
         # Monotone quantum counter; WallWatchdog reads it out-of-band.
@@ -104,11 +107,37 @@ class Partition:
 
     def add_job(self, job: Job, subject: str = xsm.SYSTEM) -> Job:
         xsm.xsm_check(subject, "job.create", job.label)
-        for ctx in job.contexts:
-            if not self._free_slots:
-                raise RuntimeError("ledger slots exhausted")
-            ctx.ledger_slot = self._free_slots.pop()
-            self.ledger.reset(ctx.ledger_slot)
+        if self.memory is not None:
+            # Fail-fast HBM admission (XENMEM_claim_pages): account +
+            # claim the working set before touching scheduler state, so
+            # a denied job leaves nothing behind.
+            from pbs_tpu.runtime.memory import nbytes_of
+
+            need = (job.mem_bytes if job.mem_bytes is not None
+                    else nbytes_of(job.state))
+            self.memory.open_account(job.name)
+            try:
+                self.memory.claim_or_balloon(job.name, need)
+            except Exception:
+                self.memory.close_account(job.name)
+                raise
+        try:
+            for ctx in job.contexts:
+                if not self._free_slots:
+                    raise RuntimeError("ledger slots exhausted")
+                ctx.ledger_slot = self._free_slots.pop()
+                self.ledger.reset(ctx.ledger_slot)
+        except Exception:
+            # Unwind fully — slots back on the freelist, account closed —
+            # so a failed admission leaves nothing behind and the name
+            # stays retryable.
+            for ctx in job.contexts:
+                if ctx.ledger_slot >= 0:
+                    self._free_slots.append(ctx.ledger_slot)
+                    ctx.ledger_slot = -1
+            if self.memory is not None:
+                self.memory.close_account(job.name)
+            raise
         self.jobs.append(job)
         self.scheduler.job_added(job)
         for ctx in job.contexts:
@@ -130,6 +159,8 @@ class Partition:
 
     def remove_job(self, job: Job, subject: str = xsm.SYSTEM) -> None:
         xsm.xsm_check(subject, "job.destroy", job.label)
+        if self.memory is not None:
+            self.memory.close_account(job.name)
         self.scheduler.job_removed(job)
         self.jobs.remove(job)
         for ctx in job.contexts:

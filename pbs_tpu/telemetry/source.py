@@ -102,6 +102,16 @@ class SimBackend:
         self._profiles[job_name] = profile
         self._steps_done[job_name] = 0  # fresh phase schedule per register
 
+    def seek(self, job_name: str, steps_done: int) -> None:
+        """Reposition the phase schedule — migration restore lands a job
+        mid-profile instead of replaying it from phase zero."""
+        self._steps_done[job_name] = int(steps_done)
+
+    def position(self, job_name: str) -> int:
+        """Current phase-schedule cursor (the save-side peer of
+        :meth:`seek`)."""
+        return self._steps_done.get(job_name, 0)
+
     def execute(self, ctx: Any, n_steps: int) -> np.ndarray:
         name = ctx.job.name
         prof = self._profiles[name]

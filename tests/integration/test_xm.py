@@ -1,0 +1,184 @@
+"""End-to-end integration suite: real OS processes (xm-test analog).
+
+Reference: ``tools/xm-test`` (10.2k LoC) organizes per-command groups
+(``tests/create``, ``tests/destroy``, ``tests/pause``, ...) that launch
+*real* short-lived guests per test and drive them through the
+management plane. Same spirit here: each test spawns real agent
+processes over real TCP, drives them with a Controller, and — unlike
+the in-process tests — can kill -9 a host to exercise true process
+death (SURVEY.md §4: "multi-node without a cluster" = multiple workers
+on one box).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from pbs_tpu.dist import Controller
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+AGENT_MAIN = """
+import sys, time
+from pbs_tpu.dist import Agent
+# one executor lane per host: jobs contend, so weights matter
+a = Agent(sys.argv[1], n_executors=1).start()
+print(f"ADDR {a.address[0]} {a.address[1]}", flush=True)
+while True:
+    time.sleep(1)
+"""
+
+
+class HostProc:
+    def __init__(self, name: str):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        self.name = name
+        self.proc = subprocess.Popen(
+            [sys.executable, "-c", AGENT_MAIN, name],
+            stdout=subprocess.PIPE, text=True, env=env)
+        line = self.proc.stdout.readline().strip()
+        assert line.startswith("ADDR "), f"agent boot failed: {line!r}"
+        _, host, port = line.split()
+        self.address = (host, int(port))
+
+    def kill9(self) -> None:
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=10)
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        self.proc.stdout.close()
+
+
+@pytest.fixture()
+def hosts():
+    procs = [HostProc(f"xm{i}") for i in range(3)]
+    ctl = Controller()
+    for p in procs:
+        ctl.add_agent(p.name, p.address)
+    yield ctl, procs
+    ctl.close()
+    for p in procs:
+        p.stop()
+
+
+# -- group: create / destroy ------------------------------------------------
+
+
+def test_create_list_destroy(hosts):
+    ctl, procs = hosts
+    ctl.create_job("cjob", spec={"step_time_ns": 1_000_000, "max_steps": 100})
+    home = ctl.jobs["cjob"].members[0].agent
+    h = ctl.agents[home]
+    assert [j["job"] for j in h.client.call("list_jobs")] == ["cjob"]
+    ctl.remove_job("cjob")
+    assert h.client.call("list_jobs") == []
+
+
+def test_create_duplicate_rejected(hosts):
+    ctl, _ = hosts
+    ctl.create_job("dup", spec={"max_steps": 10})
+    with pytest.raises(ValueError, match="exists"):
+        ctl.create_job("dup", spec={"max_steps": 10})
+    ctl.remove_job("dup")
+
+
+# -- group: run / sched-credit ----------------------------------------------
+
+
+def test_rounds_progress_and_weights(hosts):
+    ctl, _ = hosts
+    ctl.create_job("w2", spec={"step_time_ns": 1_000_000,
+                               "sched": {"weight": 512}})
+    ctl.create_job("w1", spec={"step_time_ns": 1_000_000,
+                               "sched": {"weight": 256}})
+    # land both on one host for a fair share comparison
+    if (ctl.jobs["w2"].members[0].agent != ctl.jobs["w1"].members[0].agent):
+        ctl.migrate_job("w1", to=ctl.jobs["w2"].members[0].agent)
+    for _ in range(6):
+        ctl.run_round(max_rounds=50)
+    s2 = sum(ctl.job_steps("w2").values())
+    s1 = sum(ctl.job_steps("w1").values())
+    assert s2 > 0 and s1 > 0
+    assert 1.3 < s2 / s1 < 3.0  # ~2:1
+
+
+def test_sched_setparams_applies_cross_process(hosts):
+    ctl, _ = hosts
+    ctl.create_job("tune", spec={"step_time_ns": 1_000_000})
+    ctl.sched_setparams("tune", weight=1024, tslice_us=500)
+    m = ctl.jobs["tune"].members[0]
+    tele = ctl.agents[m.agent].client.call(
+        "sched_setparams", job=m.job, subject="controller")
+    assert tele["weight"] == 1024 and tele["tslice_us"] == 500
+
+
+# -- group: pause / unpause -------------------------------------------------
+
+
+def test_pause_freezes_progress(hosts):
+    ctl, _ = hosts
+    ctl.create_job("pz", spec={"step_time_ns": 1_000_000})
+    m = ctl.jobs["pz"].members[0]
+    h = ctl.agents[m.agent]
+    ctl.run_round(max_rounds=20)
+    before = sum(ctl.job_steps("pz").values())
+    assert before > 0
+    h.client.call("pause_job", job=m.job, subject="controller")
+    ctl.run_round(max_rounds=20)
+    assert sum(ctl.job_steps("pz").values()) == before
+    h.client.call("unpause_job", job=m.job, subject="controller")
+    ctl.run_round(max_rounds=20)
+    assert sum(ctl.job_steps("pz").values()) > before
+
+
+# -- group: migrate ---------------------------------------------------------
+
+
+def test_migrate_between_processes(hosts):
+    ctl, _ = hosts
+    ctl.create_job("roam", spec={"step_time_ns": 1_000_000})
+    src = ctl.jobs["roam"].members[0].agent
+    ctl.run_round(max_rounds=25)
+    steps = sum(ctl.job_steps("roam").values())
+    assert steps > 0
+    ctl.migrate_job("roam")
+    dst = ctl.jobs["roam"].members[0].agent
+    assert dst != src
+    # telemetry survived the process hop
+    assert sum(ctl.job_steps("roam").values()) == steps
+    ctl.run_round(max_rounds=25)
+    assert sum(ctl.job_steps("roam").values()) > steps
+
+
+# -- group: failure / recovery ----------------------------------------------
+
+
+def test_kill9_detected_and_recovered(hosts):
+    ctl, procs = hosts
+    ctl.create_job("fragile", spec={"step_time_ns": 1_000_000})
+    home = ctl.jobs["fragile"].members[0].agent
+    victim = next(p for p in procs if p.name == home)
+    victim.kill9()  # real SIGKILL: no goodbye, no TCP FIN flush
+    for _ in range(ctl.dead_after_missed + 1):
+        alive = ctl.heartbeat()
+    assert alive[home] is False
+    moved = ctl.recover()
+    assert moved == ["fragile"]
+    new_home = ctl.jobs["fragile"].members[0].agent
+    assert new_home != home
+    ctl.run_round(max_rounds=20)
+    assert sum(ctl.job_steps("fragile").values()) > 0

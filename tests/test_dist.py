@@ -186,3 +186,96 @@ def test_sched_setparams_fans_out_via_multicall(cluster):
         a = next(x for x in agents if x.name == m.agent)
         p = a.partition.job(m.job).params
         assert (p.weight, p.tslice_us) == (1024, 250)
+
+
+def _agent_of(agents, name):
+    return next(a for a in agents if a.name == name)
+
+
+def test_live_migration_preserves_state_and_telemetry(cluster):
+    """xl migrate analog: run, migrate, run — steps and telemetry
+    counters continue where they left off on the new host (the reference
+    silently resets PMU state on migration; we don't, SURVEY.md §5)."""
+    ctl, agents = cluster
+    rec = ctl.create_job("mig", spec={"step_time_ns": 1_000_000,
+                                      "max_steps": 2_000})
+    src_name = rec.members[0].agent
+    ctl.run_round(max_rounds=30)
+    before = ctl.job_steps("mig")
+    steps_before = sum(before.values())
+    assert steps_before > 0
+    src_agent = _agent_of(agents, src_name)
+    dev_before = int(src_agent.partition.job("mig").contexts[0].counters[1])
+
+    moved = ctl.migrate_job("mig")
+    dst_name = rec.members[0].agent
+    assert moved == {"mig": dst_name} and dst_name != src_name
+    # source torn down, destination carries the counters forward
+    assert src_agent.partition.jobs == []
+    dst_agent = _agent_of(agents, dst_name)
+    j = dst_agent.partition.job("mig")
+    assert j.steps_retired() == steps_before
+    assert int(j.contexts[0].counters[1]) == dev_before
+
+    ctl.run_round(max_rounds=30)
+    assert sum(ctl.job_steps("mig").values()) > steps_before
+
+
+def test_migration_to_named_target_and_sched_params(cluster):
+    ctl, agents = cluster
+    ctl.create_job("pin", spec={"step_time_ns": 1_000_000,
+                                "sched": {"weight": 777}})
+    src = ctl.jobs["pin"].members[0].agent
+    target = next(a.name for a in agents if a.name != src)
+    ctl.migrate_job("pin", to=target)
+    assert ctl.jobs["pin"].members[0].agent == target
+    j = _agent_of(agents, target).partition.job("pin")
+    assert j.params.weight == 777  # sched params travel
+
+
+def test_migration_abort_leaves_source_running(cluster):
+    """Restore failure must resume the source copy (never destroy the
+    only good copy)."""
+    ctl, agents = cluster
+    ctl.create_job("frag", spec={"step_time_ns": 1_000_000})
+    rec = ctl.jobs["frag"]
+    src = rec.members[0].agent
+    # Sabotage every possible destination: a name collision makes
+    # restore_job raise there.
+    for a in agents:
+        if a.name != src:
+            a.partition.create_job("frag", max_steps=1)
+    with pytest.raises(RpcError):
+        ctl.migrate_job("frag")
+    assert rec.members[0].agent == src
+    src_agent = _agent_of(agents, src)
+    from pbs_tpu.runtime import ContextState
+    states = [c.state for c in src_agent.partition.job("frag").contexts]
+    assert ContextState.RUNNABLE in states  # unpaused after abort
+
+
+def test_restore_rejects_label_laundering_and_rolls_back(cluster):
+    """A wire 'saved' record must not smuggle a label past the policy,
+    and a malformed record must not leave a half-restored orphan."""
+    from pbs_tpu.runtime.xsm import DummyPolicy, LabelPolicy, set_policy
+
+    ctl, agents = cluster
+    h = ctl.agents["host0"]
+    try:
+        set_policy(LabelPolicy()
+                   .allow("alice", "job.create", "user")
+                   .allow("alice", "job.restore", "user"))
+        # label laundering: saved carries a privileged label
+        with pytest.raises(RpcError, match="XsmDenied"):
+            h.client.call("restore_job", job="laundered", subject="alice",
+                          spec={"max_steps": 5},
+                          saved={"label": "secret"})
+        assert h.client.call("list_jobs") == []
+        # malformed record: overlay fails after creation -> rolled back
+        with pytest.raises(RpcError):
+            h.client.call("restore_job", job="broken", subject="alice",
+                          spec={"max_steps": 5},
+                          saved={"contention": [1, 2, 3]})
+        assert h.client.call("list_jobs") == []
+    finally:
+        set_policy(DummyPolicy())

@@ -1,0 +1,147 @@
+"""HBM accounting: claims, caps, ballooning, partition admission."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pbs_tpu.runtime import (
+    Job,
+    MemoryManager,
+    OutOfDeviceMemory,
+    Partition,
+    nbytes_of,
+)
+from pbs_tpu.telemetry import SimBackend, SimProfile
+from pbs_tpu.utils.clock import MS
+
+GB = 1 << 30
+MB = 1 << 20
+
+
+def test_claim_release_and_caps():
+    mm = MemoryManager(4 * GB)
+    mm.open_account("a", max_bytes=1 * GB)
+    mm.open_account("b")
+    mm.claim("a", 512 * MB)
+    with pytest.raises(OutOfDeviceMemory, match="cap"):
+        mm.claim("a", 600 * MB)  # would exceed per-account cap
+    mm.claim("b", 3 * GB)
+    with pytest.raises(OutOfDeviceMemory, match="free"):
+        mm.claim("b", 1 * GB)  # would exceed capacity
+    mm.release("b", 3 * GB)
+    mm.claim("b", 1 * GB)
+    assert mm.account("a").used_bytes == 512 * MB
+    assert mm.dump()["free"] == 4 * GB - 512 * MB - 1 * GB
+
+
+def test_reserve_counts_against_capacity():
+    mm = MemoryManager(4 * GB, reserve_bytes=1 * GB)
+    mm.open_account("a")
+    with pytest.raises(OutOfDeviceMemory):
+        mm.claim("a", 3 * GB + 1)
+    mm.claim("a", 3 * GB)
+
+
+def test_balloon_reclaims_biggest_consumer_first():
+    mm = MemoryManager(4 * GB)
+    mm.open_account("fat")
+    mm.open_account("thin")
+    mm.claim("fat", 3 * GB)
+    mm.claim("thin", 512 * MB)
+    released = []
+    mm.register_reclaim("fat", lambda need: released.append(need) or 2 * GB)
+    mm.register_reclaim("thin", lambda need: 0)
+    freed = mm.balloon(2 * GB)
+    assert freed == 2 * GB
+    assert released  # fat (biggest) was asked
+    assert mm.account("fat").used_bytes == 1 * GB
+    assert mm.free_bytes() >= 2 * GB
+
+
+def test_claim_or_balloon_retries_once():
+    mm = MemoryManager(2 * GB)
+    mm.open_account("old")
+    mm.open_account("new")
+    mm.claim("old", 2 * GB)
+    mm.register_reclaim("old", lambda need: 1 * GB)
+    mm.claim_or_balloon("new", 1 * GB)
+    assert mm.account("new").used_bytes == 1 * GB
+
+
+def test_uncooperative_balloon_terminates():
+    mm = MemoryManager(1 * GB)
+    mm.open_account("stubborn")
+    mm.claim("stubborn", 1 * GB)
+    mm.register_reclaim("stubborn", lambda need: 0)
+    assert mm.balloon(1 * GB) == 0  # no infinite loop
+
+
+def test_nbytes_of_pytree():
+    state = {"w": np.zeros((128, 128), np.float32),
+             "b": np.zeros(128, np.float32), "step": 3}
+    assert nbytes_of(state) == 128 * 128 * 4 + 128 * 4
+    assert nbytes_of(None) == 0
+
+
+def test_partition_admission_claims_and_releases():
+    be = SimBackend()
+    mm = MemoryManager(1 * GB)
+    part = Partition("p", source=be, scheduler="credit", memory=mm)
+    be.register("big", SimProfile.steady(step_time_ns=1 * MS))
+    be.register("huge", SimProfile.steady(step_time_ns=1 * MS))
+    big = part.add_job(Job("big", mem_bytes=800 * MB))
+    assert mm.account("big").used_bytes == 800 * MB
+    with pytest.raises(OutOfDeviceMemory):
+        part.add_job(Job("huge", mem_bytes=500 * MB))
+    # denied admission leaves no account/scheduler debris
+    assert "huge" not in mm.dump()["accounts"]
+    assert [j.name for j in part.jobs] == ["big"]
+    part.remove_job(big)
+    assert mm.free_bytes() == 1 * GB
+    # now it fits
+    part.add_job(Job("huge", mem_bytes=500 * MB))
+
+
+def test_admission_estimates_from_state_and_balloons():
+    be = SimBackend()
+    mm = MemoryManager(8 * MB)
+    part = Partition("p", source=be, scheduler="credit", memory=mm)
+    be.register("cached", SimProfile.steady(step_time_ns=1 * MS))
+    be.register("incoming", SimProfile.steady(step_time_ns=1 * MS))
+    cached = part.add_job(Job("cached", mem_bytes=6 * MB))
+    mm.register_reclaim("cached", lambda need: 4 * MB)
+    state = np.zeros(4 * MB, np.uint8)
+    part.add_job(Job("incoming", state=state))  # estimated 4 MB
+    assert mm.account("incoming").used_bytes == 4 * MB
+    assert mm.account("cached").used_bytes == 2 * MB  # ballooned down
+
+
+def test_cap_denial_does_not_balloon_others():
+    evictions = []
+    mm = MemoryManager(8 * GB)
+    mm.open_account("capped", max_bytes=1 * GB)
+    mm.open_account("other")
+    mm.claim("other", 2 * GB)
+    mm.register_reclaim("other", lambda need: evictions.append(need) or GB)
+    with pytest.raises(OutOfDeviceMemory, match="cap"):
+        mm.claim_or_balloon("capped", 2 * GB)
+    assert evictions == []  # nobody paid for a hopeless claim
+    assert mm.account("other").used_bytes == 2 * GB
+
+
+def test_admission_failure_after_claim_unwinds_account():
+    be = SimBackend()
+    mm = MemoryManager(1 * GB)
+    part = Partition("p", source=be, scheduler="credit", memory=mm,
+                     ledger_slots=1)
+    be.register("a", SimProfile.steady(step_time_ns=1 * MS))
+    be.register("b", SimProfile.steady(step_time_ns=1 * MS))
+    part.add_job(Job("a", mem_bytes=MB))
+    with pytest.raises(RuntimeError, match="slots exhausted"):
+        part.add_job(Job("b", mem_bytes=MB))
+    # claim unwound: account closed, capacity restored, name retryable
+    assert "b" not in mm.dump()["accounts"]
+    assert mm.free_bytes() == 1 * GB - MB
+    part.remove_job(part.job("a"))
+    part.add_job(Job("b", mem_bytes=MB))
