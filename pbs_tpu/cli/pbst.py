@@ -263,6 +263,99 @@ def cmd_params(args) -> int:
     return 0
 
 
+def _parse_addr(s: str) -> tuple[str, int]:
+    host, _, port = s.rpartition(":")
+    return (host or "127.0.0.1", int(port))
+
+
+def _agent_client(args):
+    from pbs_tpu.dist.rpc import RpcClient
+
+    return RpcClient(_parse_addr(args.connect))
+
+
+def cmd_create(args) -> int:
+    """xl create analog: create a job on a live agent."""
+    cli = _agent_client(args)
+    spec = json.loads(args.spec) if args.spec else {}
+    if args.weight is not None:
+        spec.setdefault("sched", {})["weight"] = args.weight
+    if args.max_steps is not None:
+        spec["max_steps"] = args.max_steps
+    r = cli.call("create_job", job=args.job, workload=args.workload,
+                 spec=spec, subject=args.subject)
+    print(json.dumps(r))
+    cli.close()
+    return 0
+
+
+def cmd_destroy(args) -> int:
+    cli = _agent_client(args)
+    cli.call("remove_job", job=args.job, subject=args.subject)
+    cli.close()
+    return 0
+
+
+def cmd_pause(args) -> int:
+    cli = _agent_client(args)
+    op = "unpause_job" if args.unpause else "pause_job"
+    cli.call(op, job=args.job, subject=args.subject)
+    cli.close()
+    return 0
+
+
+def cmd_list(args) -> int:
+    """xl list analog."""
+    cli = _agent_client(args)
+    info = cli.call("info")
+    rows = cli.call("list_jobs")
+    print(f"agent={info['agent']} partition={info['partition']} "
+          f"scheduler={info['scheduler']}")
+    print(f"{'job':<16} {'state':<10} {'steps':>10} {'weight':>7} "
+          f"{'tslice':>7}")
+    for r in rows:
+        print(f"{r['job']:<16} {r.get('state', '?'):<10} "
+              f"{r.get('steps', 0):>10} {r.get('weight', ''):>7} "
+              f"{r.get('tslice_us', ''):>7}")
+    cli.close()
+    return 0
+
+
+def cmd_run(args) -> int:
+    """Drive scheduler rounds on a live agent."""
+    cli = _agent_client(args)
+    quanta = cli.call("run", _timeout=600.0, max_rounds=args.rounds)
+    print(json.dumps({"quanta": quanta}))
+    cli.close()
+    return 0
+
+
+def cmd_migrate(args) -> int:
+    """xl migrate analog: save on source, restore on dest, teardown.
+    Workload/spec default to the save record's provenance; --spec only
+    overrides deliberately."""
+    from pbs_tpu.dist.rpc import RpcClient
+
+    src = RpcClient(_parse_addr(args.connect))
+    dst = RpcClient(_parse_addr(args.to))
+    try:
+        saved = src.call("save_job", job=args.job, subject=args.subject)
+        try:
+            r = dst.call("restore_job", job=args.job,
+                         workload=args.workload,
+                         spec=json.loads(args.spec) if args.spec else None,
+                         saved=saved, subject=args.subject)
+        except Exception:
+            src.call("unpause_job", job=args.job, subject=args.subject)
+            raise
+        src.call("remove_job", job=args.job, subject=args.subject)
+        print(json.dumps(r))
+    finally:
+        src.close()
+        dst.close()
+    return 0
+
+
 def cmd_demo(args) -> int:
     from pbs_tpu.runtime import Job, Partition, SchedParams
     from pbs_tpu.sched import FeedbackPolicy
@@ -355,6 +448,51 @@ def main(argv=None) -> int:
     g.add_argument("--file", help="obs dump JSON; default: this process")
     g.add_argument("--cmdline", help="apply a 'k=v k2 no-k3' string first")
     sp.set_defaults(fn=cmd_params)
+
+    def agent_args(sp):
+        sp.add_argument("--connect", required=True,
+                        help="agent address host:port")
+        sp.add_argument("--subject", default="operator",
+                        help="XSM subject label")
+
+    sp = sub.add_parser("create", help="create a job on an agent (xl create)")
+    sp.add_argument("job")
+    agent_args(sp)
+    sp.add_argument("--workload", default="sim")
+    sp.add_argument("--spec", help="workload spec JSON")
+    sp.add_argument("-w", "--weight", type=int)
+    sp.add_argument("--max-steps", type=int, dest="max_steps")
+    sp.set_defaults(fn=cmd_create)
+
+    sp = sub.add_parser("destroy", help="destroy a job (xl destroy)")
+    sp.add_argument("job")
+    agent_args(sp)
+    sp.set_defaults(fn=cmd_destroy)
+
+    sp = sub.add_parser("pause", help="pause/unpause a job (xl pause)")
+    sp.add_argument("job")
+    agent_args(sp)
+    sp.add_argument("--unpause", action="store_true")
+    sp.set_defaults(fn=cmd_pause)
+
+    sp = sub.add_parser("list", help="list jobs on an agent (xl list)")
+    agent_args(sp)
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("run", help="drive scheduler rounds on an agent")
+    agent_args(sp)
+    sp.add_argument("--rounds", type=int, default=100)
+    sp.set_defaults(fn=cmd_run)
+
+    sp = sub.add_parser("migrate", help="migrate a job (xl migrate)")
+    sp.add_argument("job")
+    agent_args(sp)
+    sp.add_argument("--to", required=True, help="destination host:port")
+    sp.add_argument("--workload", default=None,
+                    help="override workload (default: from save record)")
+    sp.add_argument("--spec", default=None,
+                    help="override spec JSON (default: from save record)")
+    sp.set_defaults(fn=cmd_migrate)
 
     sp = sub.add_parser("demo", help="run the two-tenant sim demo")
     sp.add_argument("--scheduler", default="credit")

@@ -16,7 +16,7 @@ from typing import Any, Callable
 
 from pbs_tpu.dist.rpc import RpcServer
 from pbs_tpu.runtime.xsm import XsmDenied, xsm_check
-from pbs_tpu.runtime.job import Job, SchedParams
+from pbs_tpu.runtime.job import ContextState, Job, SchedParams
 from pbs_tpu.runtime.partition import Partition
 from pbs_tpu.telemetry.counters import counters_dict
 from pbs_tpu.telemetry.source import SimBackend, SimPhase, SimProfile
@@ -118,6 +118,10 @@ class Agent:
         except XsmDenied:
             self.partition.remove_job(j)
             raise
+        # Remember provenance so save records are self-contained and a
+        # restore can't silently rebuild a different workload.
+        j.workload_name = workload
+        j.spec = dict(spec or {})
         return {"job": j.name, "n_contexts": len(j.contexts)}
 
     def op_remove_job(self, job: str, subject: str = "remote") -> bool:
@@ -168,6 +172,10 @@ class Agent:
         saved: dict = {
             "job": j.name,
             "label": j.label,
+            # provenance (set by op_create_job/op_restore_job; None for
+            # jobs added out-of-band) — restore defaults to these
+            "workload": getattr(j, "workload_name", None),
+            "spec": getattr(j, "spec", None),
             "max_steps": j.max_steps,
             "gang": j.gang,
             "sched": {"weight": p.weight, "cap": p.cap,
@@ -186,17 +194,23 @@ class Agent:
                 self.partition.source.position(j.name))
         return saved
 
-    def op_restore_job(self, job: str, workload: str = "sim",
+    def op_restore_job(self, job: str, workload: str | None = None,
                        spec: dict | None = None, saved: dict | None = None,
                        subject: str = "remote") -> dict:
         """Recreate a saved job and overlay its runtime state
         (``xc_domain_restore``): scheduler params, per-context telemetry
         counters (into fresh ledger slots), contention accumulators, and
-        the backend cursor."""
+        the backend cursor. Workload/spec default to the save record's
+        provenance so the restored job rebuilds the workload that was
+        saved, not a default one."""
         import numpy as np
 
         if saved is None:
             raise ValueError("restore requires a 'saved' record")
+        if workload is None:
+            workload = saved.get("workload") or "sim"
+        if spec is None:
+            spec = saved.get("spec")
         xsm_check(subject, "job.restore", saved.get("label", "user"))
         factory = self.workloads.get(workload)
         if factory is None:
@@ -233,6 +247,8 @@ class Agent:
         except BaseException:
             self.partition.remove_job(j)
             raise
+        j.workload_name = workload  # provenance survives re-migration
+        j.spec = dict(spec or {})
         return {"job": j.name, "steps": j.steps_retired()}
 
     def op_run(self, max_rounds: int | None = None,
@@ -245,10 +261,22 @@ class Agent:
     def op_dump(self) -> dict:
         return self.partition.dump()
 
+    @staticmethod
+    def _job_state(j: Job) -> str:
+        if j.error is not None:
+            return "failed"
+        if j.finished():
+            return "finished"
+        live = {c.state for c in j.contexts}
+        if live and live <= {ContextState.BLOCKED, ContextState.DONE}:
+            return "paused"
+        return "running"
+
     def op_list_jobs(self) -> list[dict]:
         return [
             {
                 "job": j.name,
+                "state": self._job_state(j),
                 "weight": j.params.weight,
                 "cap": j.params.cap,
                 "tslice_us": j.params.tslice_us,

@@ -264,6 +264,13 @@ class Controller:
         unpaused and keeps running — migration never destroys the only
         good copy."""
         rec = self.jobs[name]
+        if to is not None and rec.gang and len(rec.members) > 1:
+            # create_job enforces distinct hosts per gang member
+            # (place(distinct=True)); a pin-everything migrate would
+            # silently co-locate the gang and break the barrier model.
+            raise ValueError(
+                f"gang job {name!r} has {len(rec.members)} members; "
+                "cannot pin them all to one host — migrate without 'to'")
         moved: dict[str, str] = {}
         for m in rec.members:
             src = self.agents[m.agent]

@@ -1,3 +1,10 @@
+from pbs_tpu.models.generate import (
+    forward_with_cache,
+    init_cache,
+    make_generate,
+    make_serve_step,
+    prefill,
+)
 from pbs_tpu.models.moe import (
     MoEConfig,
     init_moe_params,
@@ -18,12 +25,17 @@ __all__ = [
     "MoEConfig",
     "TransformerConfig",
     "forward",
+    "forward_with_cache",
+    "init_cache",
     "init_moe_params",
     "init_params",
     "make_eval_step",
+    "make_generate",
     "make_moe_train_step",
+    "make_serve_step",
     "make_train_step",
     "moe_forward",
     "moe_loss",
     "next_token_loss",
+    "prefill",
 ]

@@ -72,3 +72,43 @@ def test_ckpt_info_cli(tmp_path, capsys):
     assert main(["ckpt-info", path]) == 0
     info = json.loads(capsys.readouterr().out)
     assert info["n_leaves"] == 1 and info["metadata"]["job"] == "j"
+
+
+def test_cli_live_agent_lifecycle(capsys):
+    """xl-style live control: create/list/pause/run/migrate/destroy
+    against real agents over RPC."""
+    from pbs_tpu.cli.pbst import main
+    from pbs_tpu.dist import Agent
+
+    a1 = Agent("cli1", n_executors=1).start()
+    a2 = Agent("cli2", n_executors=1).start()
+    addr1 = f"{a1.address[0]}:{a1.address[1]}"
+    addr2 = f"{a2.address[0]}:{a2.address[1]}"
+    try:
+        assert main(["create", "j", "--connect", addr1,
+                     "--spec", '{"step_time_ns": 1000000}',
+                     "-w", "512"]) == 0
+        capsys.readouterr()
+        assert main(["run", "--connect", addr1, "--rounds", "20"]) == 0
+        capsys.readouterr()
+        assert main(["list", "--connect", addr1]) == 0
+        out = capsys.readouterr().out
+        assert "j " in out and "running" in out and "512" in out
+        assert main(["pause", "j", "--connect", addr1]) == 0
+        assert main(["list", "--connect", addr1]) == 0
+        assert "paused" in capsys.readouterr().out
+        assert main(["pause", "j", "--connect", addr1, "--unpause"]) == 0
+        # no --spec: the save record's provenance rebuilds the workload
+        assert main(["migrate", "j", "--connect", addr1,
+                     "--to", addr2]) == 0
+        capsys.readouterr()
+        assert main(["list", "--connect", addr1]) == 0
+        assert "j " not in capsys.readouterr().out
+        assert main(["list", "--connect", addr2]) == 0
+        assert "j " in capsys.readouterr().out
+        assert main(["destroy", "j", "--connect", addr2]) == 0
+        assert main(["list", "--connect", addr2]) == 0
+        assert "j " not in capsys.readouterr().out
+    finally:
+        a1.stop()
+        a2.stop()
