@@ -190,3 +190,32 @@ uint64_t pbst_trace_lost(const uint64_t* buf) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Data-loader gather.
+//
+// The reference's I/O data plane moves bytes with zero-copy grant
+// mappings (blkfront/blkback); PBS-T's input pipeline moves token rows
+// from a memory-mapped corpus into a staging buffer the host then
+// device_puts. The gather is the per-batch hot loop: one memcpy per
+// sequence, no Python per-row overhead.
+
+extern "C" {
+
+// Copy n rows of row_bytes each from base+offsets[i] into out
+// (contiguous). Returns n, or -1 if any row would exceed base_len.
+int pbst_gather_rows(const uint8_t* base, uint64_t base_len,
+                     const uint64_t* offsets, int n, uint64_t row_bytes,
+                     uint8_t* out) {
+  // Overflow-safe bound: offsets[i] + row_bytes could wrap in u64.
+  if (row_bytes > base_len) return -1;
+  for (int i = 0; i < n; ++i) {
+    if (offsets[i] > base_len - row_bytes) return -1;
+  }
+  for (int i = 0; i < n; ++i) {
+    std::memcpy(out + (uint64_t)i * row_bytes, base + offsets[i], row_bytes);
+  }
+  return n;
+}
+
+}  // extern "C"

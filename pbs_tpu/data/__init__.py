@@ -1,0 +1,9 @@
+from pbs_tpu.data.tokens import TokenDataset, write_token_file
+from pbs_tpu.data.loader import Prefetcher, make_batch_source
+
+__all__ = [
+    "Prefetcher",
+    "TokenDataset",
+    "make_batch_source",
+    "write_token_file",
+]

@@ -1,0 +1,103 @@
+"""Prefetching batch loader: overlap host I/O with device compute.
+
+TPU-first shape: the accelerator must never wait on the host, so
+batches are built (mmap gather) and transferred (``jax.device_put``)
+from a background thread into a small bounded queue while the current
+step runs — classic double buffering. On CPU/sim the device_put is a
+no-op copy; the pipeline logic is identical.
+
+The loader is a plain iterator so it plugs into a Job as
+``step_fn=lambda s: train_step(s, next(batches))`` or feeds a scanned
+multi-step chunk.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator
+
+import numpy as np
+
+from pbs_tpu.data.tokens import TokenDataset
+
+
+def make_batch_source(ds: TokenDataset, batch: int, seq_len: int,
+                      seed: int = 0) -> Callable[[], np.ndarray]:
+    """Stateful sampler closure: each call returns one (B, S) batch."""
+    rng = np.random.default_rng(seed)
+
+    def source() -> np.ndarray:
+        return ds.sample(batch, seq_len, rng)
+
+    return source
+
+
+class Prefetcher:
+    """Background batch pipeline with a bounded queue.
+
+    ``depth`` is the number of in-flight batches (2 = double buffer).
+    ``place`` maps a host array to its device/sharded form (default
+    ``jax.device_put``); failures in the worker propagate to the
+    consumer on the next ``__next__``.
+    """
+
+    def __init__(self, source: Callable[[], np.ndarray], depth: int = 2,
+                 place: Callable | None = None):
+        if depth < 1:
+            raise ValueError("depth >= 1")
+        if place is None:
+            import jax
+
+            place = jax.device_put
+        self._source = source
+        self._place = place
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._err: BaseException | None = None
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="pbst-prefetch")
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                item = self._place(self._source())
+                # Bounded put that stays responsive to stop().
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # noqa: BLE001 — re-raised to consumer
+            self._err = e
+            self._stop.set()
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        while True:
+            if self._err is not None and self._q.empty():
+                raise self._err
+            try:
+                return self._q.get(timeout=0.1)
+            except queue.Empty:
+                if self._stop.is_set() and self._q.empty():
+                    if self._err is not None:
+                        raise self._err
+                    raise StopIteration
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        # drain so producer threads blocked on put can exit
+        while not self._q.empty():
+            self._q.get_nowait()
+
+    def __enter__(self) -> "Prefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

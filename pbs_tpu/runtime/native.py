@@ -60,6 +60,10 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pbst_trace_consume.restype = ctypes.c_int
     lib.pbst_trace_lost.argtypes = [_U64P]
     lib.pbst_trace_lost.restype = ctypes.c_uint64
+    _U8P = ctypes.POINTER(ctypes.c_uint8)
+    lib.pbst_gather_rows.argtypes = [
+        _U8P, ctypes.c_uint64, _U64P, ctypes.c_int, ctypes.c_uint64, _U8P]
+    lib.pbst_gather_rows.restype = ctypes.c_int
 
 
 def load() -> ctypes.CDLL | None:
@@ -71,12 +75,18 @@ def load() -> ctypes.CDLL | None:
         _tried = True
         if not os.path.exists(_LIB_PATH) and not _build():
             return None
-        try:
-            lib = ctypes.CDLL(_LIB_PATH)
-            _declare(lib)
-            _lib = lib
-        except OSError:
-            _lib = None
+        for attempt in (0, 1):
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+                _declare(lib)
+                _lib = lib
+                break
+            except (OSError, AttributeError):
+                # AttributeError = stale .so missing a newer symbol;
+                # rebuild once, then degrade to the Python paths.
+                _lib = None
+                if attempt == 0 and not _build():
+                    break
         return _lib
 
 
