@@ -1,0 +1,133 @@
+"""Multi-host SPMD: jax.distributed bring-up + ICI/DCN-aware meshes.
+
+The reference's multi-node story is migration over TCP plus event
+channels on one box (SURVEY.md §2e, §4 "multi-node without a
+cluster"); the TPU build's is first-class: XLA collectives ride ICI
+within a slice and DCN across slices/hosts, and the *mesh layout*
+decides which (scaling-book recipe: put the bandwidth-hungry axes —
+tp/sp/ep — inside the slice; put dp, and only dp if possible, across
+DCN).
+
+Two layers here:
+
+- :func:`initialize` — idempotent ``jax.distributed`` bring-up from
+  explicit args or the standard env (the controller/agent control
+  plane hands each host its coordinator + process id; the JAX runtime
+  then owns the data plane).
+- :func:`hybrid_mesh` — build a Mesh whose axis order encodes the
+  ICI/DCN split: DCN-crossing axes outermost over slice granules,
+  ICI axes innermost within a slice. Uses
+  ``mesh_utils.create_hybrid_device_mesh`` on real multi-slice
+  topologies and degrades to a deterministic reshape on hosts whose
+  devices carry no slice metadata (CPU meshes in CI).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+_initialized = False
+
+
+def initialize(coordinator: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> bool:
+    """Bring up the cross-host runtime once per process. Returns True
+    if a multi-process runtime is active after the call.
+
+    Args default from the standard environment
+    (``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/
+    ``JAX_PROCESS_ID`` or their ``PBST_*`` equivalents) so agents can
+    be launched by any cluster manager. Single-process (no coordinator
+    anywhere) is a no-op returning False — the same code path then
+    runs single-host.
+    """
+    global _initialized
+    if _initialized:
+        return jax.process_count() > 1
+    coordinator = coordinator or os.environ.get(
+        "PBST_COORDINATOR", os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if coordinator is None:
+        return False
+    if num_processes is None:
+        num_processes = int(os.environ.get(
+            "PBST_NUM_PROCESSES", os.environ.get("JAX_NUM_PROCESSES", "1")))
+    if process_id is None:
+        process_id = int(os.environ.get(
+            "PBST_PROCESS_ID", os.environ.get("JAX_PROCESS_ID", "0")))
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return jax.process_count() > 1
+
+
+def _slice_index(dev) -> int | None:
+    for attr in ("slice_index", "process_index"):
+        v = getattr(dev, attr, None)
+        if v is not None:
+            return int(v)
+    return None
+
+
+def hybrid_mesh(ici_axes: dict[str, int], dcn_axes: dict[str, int],
+                devices: Sequence | None = None) -> Mesh:
+    """Mesh with ``dcn_axes`` crossing slice/host granules (outermost)
+    and ``ici_axes`` inside a granule (innermost).
+
+    E.g. 2 hosts × 8 chips: ``hybrid_mesh({"tp": 8}, {"dp": 2})`` —
+    gradient psum over ``dp`` is the only DCN traffic; every ``tp``
+    collective stays on ICI. Axis name order in the Mesh is
+    dcn_axes then ici_axes, so `PartitionSpec` code is layout-agnostic.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    ici_n = math.prod(ici_axes.values()) if ici_axes else 1
+    dcn_n = math.prod(dcn_axes.values()) if dcn_axes else 1
+    if ici_n * dcn_n != n:
+        raise ValueError(
+            f"mesh {dcn_axes}x{ici_axes} needs {ici_n * dcn_n} devices, "
+            f"have {n}")
+    names = tuple(dcn_axes) + tuple(ici_axes)
+    shape = tuple(dcn_axes.values()) + tuple(ici_axes.values())
+
+    slice_ids = [_slice_index(d) for d in devices]
+    n_slices = len(set(slice_ids)) if None not in slice_ids else 0
+    if n_slices > 1 and dcn_n == n_slices:
+        try:
+            from jax.experimental import mesh_utils
+
+            arr = mesh_utils.create_hybrid_device_mesh(
+                tuple(ici_axes.values()) or (1,),
+                tuple(dcn_axes.values()) or (1,),
+                devices=devices)
+            # create_hybrid_device_mesh returns (dcn..., ici...) shape
+            return Mesh(arr.reshape(shape), names)
+        except Exception:
+            pass  # topology helper unavailable: deterministic fallback
+    # Fallback: group devices by slice id (stable), slices become the
+    # outer (DCN) dims — on metadata-less CPU meshes this is simply
+    # row-major, which is exactly what tests need to be deterministic.
+    order = sorted(range(n), key=lambda i: ((slice_ids[i] is None, slice_ids[i]
+                                             if slice_ids[i] is not None
+                                             else 0), i))
+    arr = np.array([devices[i] for i in order]).reshape(shape)
+    return Mesh(arr, names)
+
+
+def dp_over_dcn(tp: int = 1, devices: Sequence | None = None) -> Mesh:
+    """The standard recipe: tp inside the slice, dp across everything
+    else (DCN when multi-slice)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n % tp:
+        raise ValueError(f"{n} devices not divisible by tp={tp}")
+    return hybrid_mesh({"tp": tp}, {"dp": n // tp}, devices)
