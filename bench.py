@@ -11,15 +11,22 @@ roofline-derived bar so improvements are visible across rounds:
 
 Prints exactly ONE JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+Failure containment (round-1 lesson: the TPU plugin can *hang*, not
+just raise, when the chip is absent or held — rc=124, parsed:null):
+the benchmark runs in a child process; the supervising parent never
+imports JAX, so it cannot hang, and always prints the JSON line —
+measured numbers from the child on success, an ``"error"`` payload on
+crash or timeout. One retry covers transient chip-holds.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import jax
-import jax.numpy as jnp
 
 PEAK_FLOPS = 197e12  # bf16 peak, TPU v5e
 TARGET_MFU = 0.40
@@ -30,15 +37,30 @@ STEPS_PER_CHUNK = 10  # on-device lax.scan: one dispatch per chunk
 BATCH = 6
 SEQ = 1024
 
+# Per-attempt wall budget for the child (first TPU compile ~20-40 s plus
+# tunnel init; generous but finite).  Overridable for slow days.
+ATTEMPT_TIMEOUT_S = float(os.environ.get("PBST_BENCH_TIMEOUT_S", "480"))
+
 
 def main() -> None:
+    import jax
+    import jax.numpy as jnp
     from jax import lax
 
     from pbs_tpu.models import init_params, make_train_step
 
     from __graft_entry__ import _flagship_cfg
 
-    cfg = _flagship_cfg()
+    tiny = os.environ.get("PBST_BENCH_TINY", "").lower() in (
+        "1", "true", "yes")
+    cfg = _flagship_cfg(tiny=tiny)
+    global BATCH, SEQ, WARMUP_CHUNKS, BENCH_CHUNKS, STEPS_PER_CHUNK
+    if tiny:  # smoke mode: exercises the full path on CPU in seconds
+        BATCH, SEQ = 2, 128
+        WARMUP_CHUNKS, BENCH_CHUNKS, STEPS_PER_CHUNK = 1, 1, 2
+        # Pin before the first backend touch: an ambient TPU plugin
+        # ignores JAX_PLATFORMS=cpu and can hang init (VERDICT round 1).
+        jax.config.update("jax_platforms", "cpu")
     n_params = cfg.num_params()
     key = jax.random.PRNGKey(0)
     params = init_params(cfg, key)
@@ -97,7 +119,61 @@ def main() -> None:
             }
         )
     )
+    sys.stdout.flush()
+
+
+def _supervise() -> None:
+    """Run the benchmark in a child with a hard timeout; the parent has
+    no JAX state so it can neither hang nor crash, and always emits the
+    one JSON line (the child's on success, an error payload otherwise)."""
+    last_err = "unknown"
+    for attempt in range(2):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--worker"],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                timeout=ATTEMPT_TIMEOUT_S,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+        except subprocess.TimeoutExpired:
+            last_err = (
+                f"timeout: no result within {ATTEMPT_TIMEOUT_S:.0f}s "
+                "(TPU backend hang — chip absent or held by another "
+                "process?)"
+            )
+            # No retry after a full-budget hang: a second 480 s attempt
+            # would overrun any plausible external kill budget and lose
+            # the JSON line entirely (the round-1 rc=124 outcome).
+            break
+        sys.stderr.write(proc.stderr.decode(errors="replace"))
+        out = proc.stdout.decode(errors="replace")
+        lines = [ln for ln in out.splitlines() if ln.startswith("{")]
+        if proc.returncode == 0 and lines:
+            print(lines[-1])
+            sys.stdout.flush()
+            return
+        tail = (proc.stderr.decode(errors="replace").strip()
+                .splitlines() or ["<no stderr>"])[-1]
+        last_err = f"worker rc={proc.returncode}: {tail}"
+        if attempt == 0:
+            time.sleep(10.0)
+    print(
+        json.dumps(
+            {
+                "metric": "flagship_train_throughput",
+                "value": 0.0,
+                "unit": "tokens/s",
+                "vs_baseline": 0.0,
+                "error": last_err,
+            }
+        )
+    )
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv:
+        main()
+    else:
+        _supervise()

@@ -140,14 +140,36 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
 
 
 def causal_attention(
-    q: jax.Array, k: jax.Array, v: jax.Array, cfg: TransformerConfig
+    q: jax.Array, k: jax.Array, v: jax.Array, cfg: TransformerConfig,
+    mesh=None,
 ) -> jax.Array:
     """(B, S, H, hd) GQA attention with causal iota mask — left to XLA
-    to fuse; swap for the Pallas kernel via cfg.attn_impl."""
+    to fuse; swap for the Pallas kernel ("pallas") or sequence-parallel
+    ring attention ("ring", needs a mesh with an 'sp' axis) via
+    cfg.attn_impl. Unknown impls are rejected loudly — never a silent
+    dense fallback."""
     if cfg.attn_impl == "pallas":
         from pbs_tpu.ops.attention import flash_attention
 
         return flash_attention(q, k, v, causal=True)
+    if cfg.attn_impl == "ring":
+        if mesh is None or "sp" not in mesh.axis_names:
+            raise ValueError(
+                "attn_impl='ring' needs a mesh with an 'sp' axis threaded "
+                "through forward(..., mesh=...); use "
+                "pbs_tpu.parallel.make_sharded_train with an sp mesh"
+            )
+        from pbs_tpu.parallel.ring_attention import ring_attention
+
+        return ring_attention(
+            q, k, v, mesh, axis="sp", causal=True,
+            batch_axis="dp", head_axis="tp",
+        )
+    if cfg.attn_impl != "xla":
+        raise ValueError(
+            f"unknown attn_impl {cfg.attn_impl!r}; "
+            "expected 'xla', 'pallas', or 'ring'"
+        )
     B, S, H, hd = q.shape
     nkv = k.shape[2]
     group = H // nkv
@@ -165,7 +187,8 @@ def causal_attention(
 
 
 def layer_body(cfg: TransformerConfig, x: jax.Array, lp: dict,
-               cos: jax.Array, sin: jax.Array, constrain) -> jax.Array:
+               cos: jax.Array, sin: jax.Array, constrain,
+               mesh=None) -> jax.Array:
     """One transformer block. ``constrain`` re-applies the activation
     sharding between ops (sequence-parallel residual stream)."""
     B, S, _ = x.shape
@@ -177,7 +200,7 @@ def layer_body(cfg: TransformerConfig, x: jax.Array, lp: dict,
     k = (h @ lp["wk"].astype(dt)).reshape(B, S, nkv, hd)
     v = (h @ lp["wv"].astype(dt)).reshape(B, S, nkv, hd)
     q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
-    attn = causal_attention(q, k, v, cfg).reshape(B, S, nh * hd)
+    attn = causal_attention(q, k, v, cfg, mesh).reshape(B, S, nh * hd)
     x = constrain(x + attn @ lp["wo"].astype(dt))
 
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
@@ -191,7 +214,7 @@ def layer_body(cfg: TransformerConfig, x: jax.Array, lp: dict,
 
 
 def forward(cfg: TransformerConfig, params: dict, tokens: jax.Array,
-            constrain=lambda x: x) -> jax.Array:
+            constrain=lambda x: x, mesh=None) -> jax.Array:
     """tokens (B, S) int32 -> logits (B, S, vocab) fp32."""
     B, S = tokens.shape
     dt = cfg.dtype
@@ -199,7 +222,7 @@ def forward(cfg: TransformerConfig, params: dict, tokens: jax.Array,
     cos, sin = rope_tables(cfg, S)
 
     def body(x, lp, cos, sin):
-        return layer_body(cfg, x, lp, cos, sin, constrain)
+        return layer_body(cfg, x, lp, cos, sin, constrain, mesh)
 
     if cfg.remat:
         if cfg.remat_policy == "dots":
@@ -238,9 +261,19 @@ def default_optimizer(learning_rate: float):
 
 
 def next_token_loss(cfg: TransformerConfig, params: dict,
-                    tokens: jax.Array, constrain=lambda x: x) -> jax.Array:
-    """Causal LM loss: predict tokens[:, 1:] from tokens[:, :-1]."""
-    logits = forward(cfg, params, tokens[:, :-1], constrain)
+                    tokens: jax.Array, constrain=lambda x: x,
+                    mesh=None, full_seq: bool = False) -> jax.Array:
+    """Causal LM loss: predict tokens[:, 1:] from tokens[:, :-1].
+
+    ``full_seq=True`` runs forward over all S tokens and drops the last
+    logit instead of slicing the input — mathematically identical for a
+    causal model, but keeps the in-graph sequence length divisible by
+    the sp axis for ring attention (S-1 rarely divides the ring size).
+    """
+    if full_seq:
+        logits = forward(cfg, params, tokens, constrain, mesh)
+        return token_xent(logits[:, :-1], tokens[:, 1:])
+    logits = forward(cfg, params, tokens[:, :-1], constrain, mesh)
     return token_xent(logits, tokens[:, 1:])
 
 
@@ -248,7 +281,8 @@ def next_token_loss(cfg: TransformerConfig, params: dict,
 
 
 def make_train_step(cfg: TransformerConfig, learning_rate: float = 3e-4,
-                    constrain=lambda x: x):
+                    constrain=lambda x: x, mesh=None,
+                    full_seq: bool = False):
     """Returns (init_opt_state, train_step). AdamW via optax; donate-safe.
 
     ``train_step(state, tokens) -> (state, metrics)`` where state is
@@ -265,7 +299,8 @@ def make_train_step(cfg: TransformerConfig, learning_rate: float = 3e-4,
     def train_step(state, tokens):
         params, opt_state, step = state
         loss, grads = jax.value_and_grad(
-            lambda p: next_token_loss(cfg, p, tokens, constrain)
+            lambda p: next_token_loss(cfg, p, tokens, constrain, mesh,
+                                      full_seq)
         )(params)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
@@ -276,8 +311,10 @@ def make_train_step(cfg: TransformerConfig, learning_rate: float = 3e-4,
     return init_opt_state, train_step
 
 
-def make_eval_step(cfg: TransformerConfig, constrain=lambda x: x):
+def make_eval_step(cfg: TransformerConfig, constrain=lambda x: x,
+                   mesh=None, full_seq: bool = False):
     def eval_step(params, tokens):
-        return next_token_loss(cfg, params, tokens, constrain)
+        return next_token_loss(cfg, params, tokens, constrain, mesh,
+                               full_seq)
 
     return eval_step

@@ -104,15 +104,25 @@ def ring_attention(
     mesh: Mesh,
     axis: str = "sp",
     causal: bool = True,
+    batch_axis: str | None = None,
+    head_axis: str | None = None,
 ) -> jax.Array:
     """Exact attention with the sequence sharded over ``mesh[axis]``.
 
     Matches dense causal attention bit-for-near (fp32 accumulation);
     memory per device is O(S/n · S/n) per block instead of O(S·S).
+
+    ``batch_axis``/``head_axis`` name additional mesh axes the batch and
+    head dimensions are sharded over (dp / tp composition) — those axes
+    are purely data-parallel inside the ring body; only ``axis`` carries
+    the k/v rotation. Axes absent from the mesh are ignored so callers
+    can pass their full layout unconditionally.
     """
     hd = q.shape[-1]
     sm_scale = 1.0 / np.sqrt(hd)
-    spec = P(None, axis, None, None)
+    ba = batch_axis if batch_axis in mesh.axis_names else None
+    ha = head_axis if head_axis in mesh.axis_names else None
+    spec = P(ba, axis, ha, None)
     fn = functools.partial(
         _ring_attention_local, axis_name=axis, causal=causal,
         sm_scale=sm_scale)

@@ -51,9 +51,21 @@ def param_specs(cfg: TransformerConfig) -> dict:
     }
 
 
+def _mesh_spec(mesh: Mesh, spec: P) -> P:
+    """Drop axis names the mesh doesn't have (e.g. 'tp' specs on a
+    dp x sp mesh) so one canonical spec table serves every mesh shape."""
+    def keep(entry):
+        if isinstance(entry, tuple):
+            kept = tuple(a for a in entry if a in mesh.axis_names)
+            return kept or None
+        return entry if entry in mesh.axis_names else None
+
+    return P(*(keep(a) for a in spec))
+
+
 def _named(mesh: Mesh, tree):
     return jax.tree.map(
-        lambda spec: NamedSharding(mesh, spec), tree,
+        lambda spec: NamedSharding(mesh, _mesh_spec(mesh, spec)), tree,
         is_leaf=lambda x: isinstance(x, P),
     )
 
@@ -64,15 +76,31 @@ def shard_params(params: dict, mesh: Mesh, cfg: TransformerConfig) -> dict:
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P("dp", None))
+    """Token batches: batch over dp; sequence over sp when the mesh has
+    a ring-attention axis (long-context inputs arrive pre-sharded)."""
+    seq = "sp" if "sp" in mesh.axis_names else None
+    return NamedSharding(mesh, P("dp", seq))
 
 
 def activation_constrainer(mesh: Mesh | None):
     """Returns the ``constrain`` fn threaded through the model: pins the
-    residual stream to P('dp','tp',None) — the sequence-parallel layout."""
-    if mesh is None or "tp" not in mesh.axis_names:
+    residual stream (B, S, d).
+
+    - tp-only mesh: P('dp','tp',None) — sequence parallelism rides the
+      tp axis between blocks (Megatron sp), gathered where attention
+      needs the full sequence.
+    - sp mesh (ring attention): P('dp','sp',None) — the sequence stays
+      sharded *through* attention; the ring rotates k/v instead of
+      gathering.
+    """
+    if mesh is None:
         return lambda x: x
-    spec = NamedSharding(mesh, P("dp", "tp", None))
+    if "sp" in mesh.axis_names:
+        spec = NamedSharding(mesh, P("dp", "sp", None))
+    elif "tp" in mesh.axis_names:
+        spec = NamedSharding(mesh, P("dp", "tp", None))
+    else:
+        return lambda x: x
 
     def constrain(x):
         if x.ndim == 3:
@@ -98,7 +126,18 @@ def make_sharded_train(
 
     key = key if key is not None else jax.random.PRNGKey(0)
     constrain = activation_constrainer(mesh)
-    init_opt, train_step = make_train_step(cfg, learning_rate, constrain)
+    # Ring attention needs the mesh in-graph (shard_map) and a sequence
+    # length divisible by the sp axis — full_seq keeps S intact in-graph.
+    ring = cfg.attn_impl == "ring"
+    if ring and "sp" not in mesh.axis_names:
+        raise ValueError(
+            "attn_impl='ring' requires an 'sp' axis in the mesh; got "
+            f"axes {mesh.axis_names}"
+        )
+    init_opt, train_step = make_train_step(
+        cfg, learning_rate, constrain, mesh=mesh if ring else None,
+        full_seq=ring,
+    )
 
     # NamedSharding carries its mesh: no ambient mesh context needed.
     params = shard_params(init_params(cfg, key), mesh, cfg)

@@ -1,0 +1,88 @@
+"""End-to-end sequence-parallel (ring attention) training.
+
+VERDICT round-1 item 4: ``attn_impl="ring"`` must be reachable from
+``TransformerConfig`` and produce training-loss parity with dense
+attention on the 8-device CPU mesh — not just a standalone op test.
+Long-context design rationale: SURVEY.md §5 (sequence parallelism is a
+new design area, not a port).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pbs_tpu.models import init_params, make_train_step
+from pbs_tpu.models.transformer import TransformerConfig, causal_attention
+from pbs_tpu.parallel import batch_sharding, make_mesh, make_sharded_train
+
+TINY = dict(
+    vocab=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=128, max_seq=64, dtype=jnp.float32,
+)
+
+
+def _tokens(batch=4, seq=64):
+    key = jax.random.PRNGKey(7)
+    return jax.random.randint(key, (batch, seq), 0, 128, jnp.int32)
+
+
+def test_ring_training_matches_dense():
+    """3 optimizer steps: dp2 x sp4 ring == single-device dense."""
+    dense_cfg = TransformerConfig(**TINY, attn_impl="xla")
+    ring_cfg = TransformerConfig(**TINY, attn_impl="ring")
+    tokens = _tokens()
+
+    # Dense reference on one device, same full_seq loss formula.
+    init_opt, dense_step = make_train_step(
+        dense_cfg, learning_rate=1e-2, full_seq=True
+    )
+    params = init_params(dense_cfg, jax.random.PRNGKey(0))
+    dense_state = (params, init_opt(params), 0)
+    dense_step = jax.jit(dense_step)
+    dense_losses = []
+    for _ in range(3):
+        dense_state, m = dense_step(dense_state, tokens)
+        dense_losses.append(float(m["loss"]))
+
+    # Ring path on the dp2 x sp4 mesh, same init key.
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    state, step = make_sharded_train(ring_cfg, mesh, learning_rate=1e-2)
+    toks = jax.device_put(tokens, batch_sharding(mesh))
+    ring_losses = []
+    for _ in range(3):
+        state, m = step(state, toks)
+        ring_losses.append(float(m["loss"]))
+
+    assert ring_losses == pytest.approx(dense_losses, rel=2e-4)
+    assert dense_losses[-1] < dense_losses[0]  # actually training
+
+
+def test_ring_with_tp_axis():
+    """Ring composes with tensor parallelism: dp2 x sp2 x tp2."""
+    ring_cfg = TransformerConfig(**TINY, attn_impl="ring")
+    mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2})
+    state, step = make_sharded_train(ring_cfg, mesh, learning_rate=1e-2)
+    toks = jax.device_put(_tokens(), batch_sharding(mesh))
+    _, m = step(state, toks)
+    assert jnp.isfinite(m["loss"])
+
+
+def test_ring_without_sp_axis_rejected():
+    ring_cfg = TransformerConfig(**TINY, attn_impl="ring")
+    mesh = make_mesh({"dp": 8})
+    with pytest.raises(ValueError, match="sp"):
+        make_sharded_train(ring_cfg, mesh)
+
+
+def test_unknown_attn_impl_rejected():
+    cfg = TransformerConfig(**TINY, attn_impl="flash3")
+    q = jnp.zeros((1, 8, 4, 16), jnp.float32)
+    with pytest.raises(ValueError, match="attn_impl"):
+        causal_attention(q, q[:, :, :2], q[:, :, :2], cfg)
+
+
+def test_ring_without_mesh_rejected():
+    cfg = TransformerConfig(**TINY, attn_impl="ring")
+    q = jnp.zeros((1, 8, 4, 16), jnp.float32)
+    with pytest.raises(ValueError, match="mesh"):
+        causal_attention(q, q[:, :, :2], q[:, :, :2], cfg)
