@@ -123,12 +123,13 @@ def cmd_store(args) -> int:
     from pbs_tpu.store import Store
 
     s = Store(persist_path=args.db)
+    subj = args.subject
     if args.op == "ls":
-        for name in s.ls(args.path):
+        for name in s.ls(args.path, subject=subj):
             print(name)
     elif args.op == "read":
-        v = s.read(args.path)
-        if v is None and not s.exists(args.path):
+        v = s.read(args.path, subject=subj)
+        if v is None and not s.exists(args.path, subject=subj):
             print(f"pbst: no entry {args.path}", file=sys.stderr)
             return 1
         print(json.dumps(v))
@@ -136,9 +137,9 @@ def cmd_store(args) -> int:
         if args.value is None:
             print("pbst: store write requires a JSON value", file=sys.stderr)
             return 2
-        s.write(args.path, json.loads(args.value))
+        s.write(args.path, json.loads(args.value), subject=subj)
     elif args.op == "rm":
-        print(s.rm(args.path))
+        print(s.rm(args.path, subject=subj))
     return 0
 
 
@@ -405,6 +406,8 @@ def main(argv=None) -> int:
     sp.add_argument("path")
     sp.add_argument("value", nargs="?")
     sp.add_argument("--db", required=True)
+    sp.add_argument("--subject", default="operator",
+                    help="XSM label presented to the store policy")
     sp.set_defaults(fn=cmd_store)
 
     sp = sub.add_parser("ckpt-info", help="inspect a checkpoint")

@@ -63,6 +63,7 @@ class Agent:
         port: int = 0,
         n_executors: int = 2,
         scheduler: str = "credit",
+        auth_token: str | None = None,
     ):
         self.name = name
         if partition is None:
@@ -73,7 +74,7 @@ class Agent:
         self.partition = partition
         self.workloads: dict[str, WorkloadFactory] = {"sim": sim_workload}
         self.workloads.update(workloads or {})
-        self.server = RpcServer(host=host, port=port)
+        self.server = RpcServer(host=host, port=port, auth_token=auth_token)
         for op in ("create_job", "remove_job", "sched_setparams",
                    "pause_job", "unpause_job", "run", "dump", "telemetry",
                    "list_jobs", "save_job", "restore_job"):
@@ -101,8 +102,12 @@ class Agent:
                       spec: dict | None = None,
                       subject: str = "remote") -> dict:
         # XSM hook at the dispatch surface (do_domctl placement): the
-        # subject is the caller's declared label — same trust model as
-        # Xen believing dom0's identity via the privileged interface.
+        # subject is the caller's declared label, checked against the
+        # policy like any other — but privileged subjects ("system",
+        # which bypasses all policy rules) are stripped at the RPC
+        # layer unless the connection authenticated with the agent's
+        # token (RpcServer trust model; Xen derives dom0 identity from
+        # the calling domain, never from hypercall payload).
         xsm_check(subject, "job.create", (spec or {}).get("label", "user"))
         factory = self.workloads.get(workload)
         if factory is None:

@@ -66,20 +66,25 @@ class JobRecord:
 
 class Controller:
     def __init__(self, dead_after_missed: int = 2,
-                 subject: str = "controller"):
+                 subject: str = "controller",
+                 auth_token: str | None = None):
         self.agents: dict[str, AgentHandle] = {}
         self.jobs: dict[str, JobRecord] = {}
         self.dead_after_missed = dead_after_missed
         self.last_round_errors: dict[str, Exception] = {}
         # XSM identity presented on every job-mutating agent op; under
         # an enforcing agent policy, grant this label (or pass your own).
+        # Privileged subjects additionally require ``auth_token`` to
+        # match the agents' token (connection-level trust, rpc.py).
         self.subject = subject
+        self.auth_token = auth_token
 
     # -- membership ------------------------------------------------------
 
     def add_agent(self, name: str, address: tuple[str, int]) -> AgentHandle:
-        h = AgentHandle(name, RpcClient(address),
-                        probe=RpcClient(address, timeout_s=2.0))
+        h = AgentHandle(name, RpcClient(address, auth_token=self.auth_token),
+                        probe=RpcClient(address, timeout_s=2.0,
+                                        auth_token=self.auth_token))
         h.info = h.client.call("info")
         self.agents[name] = h
         return h

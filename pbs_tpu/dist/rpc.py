@@ -19,6 +19,7 @@ metadata.
 
 from __future__ import annotations
 
+import hmac
 import json
 import socket
 import socketserver
@@ -71,10 +72,24 @@ class RpcServer:
     Dispatch is serialized by a single lock — the moral equivalent of
     entering the hypervisor: op handlers may freely mutate the hosted
     partition without their own locking.
+
+    Subject trust model: XSM subjects in request args are *advisory
+    labels* checked against the policy — except privileged subjects
+    (``system`` by default, the label that bypasses every policy rule).
+    Those are only honored on connections that authenticated with the
+    server's ``auth_token`` (built-in ``auth`` op), so a remote caller
+    cannot claim hypervisor identity through a request field the way a
+    Xen domain cannot forge being dom0 (the subject there derives from
+    the calling domain, not from hypercall payload). With no token
+    configured, no connection can ever be privileged.
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 auth_token: str | None = None,
+                 privileged_subjects: frozenset[str] = frozenset({"system"})):
         self.ops: dict[str, Callable[..., Any]] = {}
+        self.auth_token = auth_token
+        self.privileged_subjects = privileged_subjects
         self._lock = threading.Lock()
         # Connection bookkeeping must never wait on the dispatch lock,
         # or a fresh ping connection blocks behind a long-running op.
@@ -94,12 +109,13 @@ class RpcServer:
             def handle(self) -> None:  # one connection = many requests
                 sock = self.request
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                conn = {"trusted": False}  # connection-level identity
                 with outer._conns_lock:
                     outer._conns.add(sock)
                 try:
                     while True:
                         req = recv_msg(sock)
-                        send_msg(sock, outer._handle(req))
+                        send_msg(sock, outer._handle(req, conn))
                 except (ConnectionError, OSError, ValueError):
                     return
                 finally:
@@ -125,14 +141,24 @@ class RpcServer:
         if lockfree:
             self._lockfree_ops.add(name)
 
-    def _handle(self, req: Any) -> dict:
+    def _handle(self, req: Any, conn: dict | None = None) -> dict:
         # A malformed request must produce an error reply, never kill
         # the connection (the client would block until timeout).
+        conn = conn if conn is not None else {"trusted": False}
         try:
             if not isinstance(req, dict) or "op" not in req:
                 raise ValueError("bad request")
             op = req["op"]
             kwargs = req.get("args") or {}
+            if op == "auth":
+                # Connection-level identity: the only way a connection
+                # may later present a privileged subject.
+                token = (kwargs or {}).get("token")
+                if (self.auth_token is not None and isinstance(token, str)
+                        and hmac.compare_digest(token, self.auth_token)):
+                    conn["trusted"] = True
+                    return {"ok": True, "result": True}
+                raise PermissionError("bad or missing auth token")
             if op == "multicall":
                 # xen/common/multicall.c: execute each entry in order; a
                 # failing entry doesn't abort the batch — per-entry status.
@@ -141,21 +167,33 @@ class RpcServer:
                         isinstance(c, dict) for c in calls):
                     raise ValueError("multicall 'calls' must be a list of "
                                      "{op, args} objects")
-                results = [self._call_one(c.get("op"), c.get("args") or {})
+                results = [self._call_one(c.get("op"), c.get("args") or {},
+                                          conn)
                            for c in calls]
                 return {"ok": True, "result": results}
             if not isinstance(kwargs, dict):
                 raise ValueError("'args' must be an object")
-            return self._call_one(op, kwargs)
+            return self._call_one(op, kwargs, conn)
         except Exception as e:  # noqa: BLE001 — marshalled to caller
             return {"ok": False, "error": type(e).__name__, "message": str(e)}
 
-    def _call_one(self, op: str, kwargs: dict) -> dict:
+    def _call_one(self, op: str, kwargs: dict,
+                  conn: dict | None = None) -> dict:
         fn = self.ops.get(op)
         if fn is None:
             return {"ok": False, "error": "LookupError",
                     "message": f"unknown op {op!r}"}
         try:
+            # Inside the try: a malformed entry (non-dict args) must
+            # yield a per-entry error status, never abort a multicall.
+            if not isinstance(kwargs, dict):
+                raise ValueError("'args' must be an object")
+            subj = kwargs.get("subject")
+            if (isinstance(subj, str) and subj in self.privileged_subjects
+                    and not (conn or {}).get("trusted")):
+                raise PermissionError(
+                    f"subject {subj!r} requires an authenticated "
+                    "connection")
             if op in self._lockfree_ops:
                 return {"ok": True, "result": fn(**kwargs)}
             with self._lock:
@@ -191,11 +229,16 @@ class RpcServer:
 
 
 class RpcClient:
-    """Persistent connection to one RpcServer."""
+    """Persistent connection to one RpcServer.
 
-    def __init__(self, address: tuple[str, int], timeout_s: float = 5.0):
+    ``auth_token`` (if given) is presented on every (re)connect, so the
+    connection-level trust survives transparent reconnects."""
+
+    def __init__(self, address: tuple[str, int], timeout_s: float = 5.0,
+                 auth_token: str | None = None):
         self.address = (address[0], int(address[1]))
         self.timeout_s = timeout_s
+        self.auth_token = auth_token
         self._sock: socket.socket | None = None
         self._lock = threading.Lock()
 
@@ -204,6 +247,15 @@ class RpcClient:
             s = socket.create_connection(self.address, timeout=self.timeout_s)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._sock = s
+            if self.auth_token is not None:
+                send_msg(s, {"op": "auth",
+                             "args": {"token": self.auth_token}})
+                resp = recv_msg(s)
+                if not resp.get("ok"):
+                    self._sock = None
+                    s.close()
+                    raise RpcError("auth", resp.get("error", "?"),
+                                   resp.get("message", ""))
         return self._sock
 
     def _roundtrip(self, req: dict, timeout_s: float | None = None) -> Any:

@@ -89,15 +89,20 @@ class SchedHistory:
     def slots(self) -> list[int]:
         return sorted(set(self._hist) | set(self._cur))
 
-    def summary(self, slot: int, windows: int | None = None) -> Window:
-        """Aggregate over the last ``windows`` closed windows plus the
-        open one (None = everything held)."""
+    def summary(self, slot: int, windows: int | None = None,
+                include_open: bool = True) -> Window:
+        """Aggregate over the last ``windows`` closed windows, plus the
+        open one unless ``include_open=False`` (None = everything held)."""
         agg = Window()
         hist = list(self._hist.get(slot, ()))
         if windows is not None:
-            # NB: hist[-0:] would be the whole list, not none of it.
-            hist = hist[len(hist) - windows:] if windows > 0 else []
-        for w in hist + [self._cur.get(slot, Window())]:
+            # NB: hist[-0:] would be the whole list, not none of it; and
+            # the start must clamp at 0 or windows > len(hist) wraps
+            # negative and silently drops the oldest closed windows.
+            hist = hist[max(0, len(hist) - windows):] if windows > 0 else []
+        if include_open:
+            hist = hist + [self._cur.get(slot, Window())]
+        for w in hist:
             agg.gotten_ns += w.gotten_ns
             agg.allocated_ns += w.allocated_ns
             agg.execs += w.execs
@@ -107,11 +112,15 @@ class SchedHistory:
     def cpu_pct(self, slot: int, windows: int = 1) -> float:
         """Share of trace time the slot burned over the last windows —
         xenmon's headline per-domain CPU% column. Requires ≥1 window
-        (the open window alone has no fixed denominator)."""
+        (the open window alone has no fixed denominator). Only closed
+        windows count: the open window's partial gotten_ns over a
+        full-window denominator would understate early and let the
+        column drift above 100% late."""
         if windows < 1:
             raise ValueError("cpu_pct needs windows >= 1")
         span = windows * self.window_ns
-        return 100.0 * self.summary(slot, windows).gotten_ns / span
+        got = self.summary(slot, windows, include_open=False).gotten_ns
+        return 100.0 * got / span
 
 
 class Monitor:

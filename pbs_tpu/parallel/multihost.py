@@ -78,6 +78,21 @@ def _slice_index(dev) -> int | None:
     return None
 
 
+def _reorder_hybrid(arr: np.ndarray, dcn_p: tuple[int, ...],
+                    ici_p: tuple[int, ...]) -> np.ndarray:
+    """(d1*i1, …, dk*ik) with DCN major per axis → (d1, …, dk, i1, …, ik).
+
+    Splitting each product axis into its (dcn, ici) pair and moving all
+    dcn dims to the front is the correct reindexing for any rank; a
+    plain reshape is only correct when at most one axis on each side is
+    nontrivial."""
+    rank = len(dcn_p)
+    interleaved = arr.reshape(
+        tuple(x for pair in zip(dcn_p, ici_p) for x in pair))
+    perm = tuple(range(0, 2 * rank, 2)) + tuple(range(1, 2 * rank, 2))
+    return interleaved.transpose(perm).reshape(dcn_p + ici_p)
+
+
 def hybrid_mesh(ici_axes: dict[str, int], dcn_axes: dict[str, int],
                 devices: Sequence | None = None) -> Mesh:
     """Mesh with ``dcn_axes`` crossing slice/host granules (outermost)
@@ -105,12 +120,25 @@ def hybrid_mesh(ici_axes: dict[str, int], dcn_axes: dict[str, int],
         try:
             from jax.experimental import mesh_utils
 
+            # create_hybrid_device_mesh takes same-rank shapes and
+            # returns the *elementwise product* shape (d1*i1, d2*i2, …)
+            # with the DCN index major within each axis — NOT the
+            # concatenated (dcn…, ici…) layout we want.  Pad both to a
+            # common rank, split each axis into its (dcn, ici) pair,
+            # then transpose all dcn dims ahead of all ici dims; a
+            # plain reshape would scramble the mesh whenever both sides
+            # have more than one nontrivial axis (named DCN axes would
+            # stop aligning with slice boundaries and inner-axis
+            # collectives would cross DCN).
+            ici_shape = tuple(ici_axes.values()) or (1,)
+            dcn_shape = tuple(dcn_axes.values()) or (1,)
+            rank = max(len(ici_shape), len(dcn_shape))
+            ici_p = (1,) * (rank - len(ici_shape)) + ici_shape
+            dcn_p = (1,) * (rank - len(dcn_shape)) + dcn_shape
             arr = mesh_utils.create_hybrid_device_mesh(
-                tuple(ici_axes.values()) or (1,),
-                tuple(dcn_axes.values()) or (1,),
-                devices=devices)
-            # create_hybrid_device_mesh returns (dcn..., ici...) shape
-            return Mesh(arr.reshape(shape), names)
+                ici_p, dcn_p, devices=devices)
+            return Mesh(_reorder_hybrid(arr, dcn_p, ici_p).reshape(shape),
+                        names)
         except Exception:
             pass  # topology helper unavailable: deterministic fallback
     # Fallback: group devices by slice id (stable), slices become the

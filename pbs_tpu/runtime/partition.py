@@ -138,12 +138,34 @@ class Partition:
             if self.memory is not None:
                 self.memory.close_account(job.name)
             raise
-        self.jobs.append(job)
-        self.scheduler.job_added(job)
-        for ctx in job.contexts:
-            if ctx.state is ContextState.RUNNABLE:
-                self.scheduler.wake(ctx)
-        self._publish_meta()
+        # Scheduler enrollment is part of the same atomic admission: a
+        # job_added/wake failure must unwind jobs-list membership, the
+        # ledger slots, and the memory account, or the name stops being
+        # retryable and the slots leak.
+        enrolled = False
+        try:
+            self.jobs.append(job)
+            self.scheduler.job_added(job)
+            enrolled = True
+            for ctx in job.contexts:
+                if ctx.state is ContextState.RUNNABLE:
+                    self.scheduler.wake(ctx)
+            self._publish_meta()
+        except Exception:
+            if enrolled:
+                try:
+                    self.scheduler.job_removed(job)
+                except Exception:  # noqa: BLE001 — best-effort unwind
+                    pass
+            if job in self.jobs:
+                self.jobs.remove(job)
+            for ctx in job.contexts:
+                if ctx.ledger_slot >= 0:
+                    self._free_slots.append(ctx.ledger_slot)
+                    ctx.ledger_slot = -1
+            if self.memory is not None:
+                self.memory.close_account(job.name)
+            raise
         return job
 
     def create_job(

@@ -59,13 +59,22 @@ class Store:
             self._fire(path, value)
             self._save()
 
-    def read(self, path: str, default: Any = None) -> Any:
+    def read(self, path: str, default: Any = None,
+             subject: str = "system") -> Any:
+        """In-process callers default to the system subject; RPC/CLI
+        surfaces pass the caller's label so an enforcing policy governs
+        information flow too (FLASK checks reads, not only writes)."""
         path = _norm(path)
+        xsm_check(subject, "store.read", path)
         with self._lock:
             return self._data.get(path, default)
 
-    def exists(self, path: str) -> bool:
-        return _norm(path) in self._data
+    def exists(self, path: str, subject: str = "system") -> bool:
+        # Existence is information too: a read-denied label must not be
+        # able to probe the key space.
+        path = _norm(path)
+        xsm_check(subject, "store.read", path)
+        return path in self._data
 
     def rm(self, path: str, subject: str = "system") -> int:
         """Remove path and its whole subtree (xenstore rm). Returns the
@@ -82,9 +91,10 @@ class Store:
             self._save()
             return len(doomed)
 
-    def ls(self, path: str) -> list[str]:
+    def ls(self, path: str, subject: str = "system") -> list[str]:
         """Immediate children names (xenstore-ls one level)."""
         path = _norm(path)
+        xsm_check(subject, "store.read", path)
         prefix = "" if path == "/" else path
         out = set()
         with self._lock:
@@ -94,12 +104,17 @@ class Store:
                     out.add(rest.split("/", 1)[0])
         return sorted(out)
 
-    def version(self, path: str) -> int:
-        return self._version.get(_norm(path), 0)
+    def version(self, path: str, subject: str = "system") -> int:
+        path = _norm(path)
+        xsm_check(subject, "store.read", path)
+        return self._version.get(path, 0)
 
     # -- watches (fire for the key or any ancestor watch prefix) ---------
 
-    def watch(self, prefix: str, fn: Callable[[str, Any], None]) -> None:
+    def watch(self, prefix: str, fn: Callable[[str, Any], None],
+              subject: str = "system") -> None:
+        """A watch is a standing read of the subtree — same check."""
+        xsm_check(subject, "store.read", _norm(prefix))
         self._watches.append((_norm(prefix), fn))
 
     def unwatch(self, prefix: str, fn) -> None:
@@ -139,8 +154,8 @@ class Transaction:
         path = _norm(path)
         if path in self._writes:
             return self._writes[path]
-        self._reads[path] = self.store.version(path)
-        return self.store.read(path, default)
+        self._reads[path] = self.store.version(path, subject=self.subject)
+        return self.store.read(path, default, subject=self.subject)
 
     def write(self, path: str, value: Any) -> None:
         self._writes[_norm(path)] = value

@@ -42,8 +42,11 @@ def test_history_folds_sched_events_into_windows():
     assert h.summary(9).wakes == 1
     # only the open window: slot 7 gotten=100ms
     assert h.summary(7, windows=0).gotten_ns == 100 * MS
+    # cpu_pct counts closed windows only — the open window's partial
+    # gotten over a full-window denominator would skew the column
+    # (ADVICE round 1); its 100ms is excluded.
     assert h.cpu_pct(7, windows=1) == pytest.approx(
-        100.0 * (300 * MS) / SEC + 100.0 * (100 * MS) / SEC)
+        100.0 * (300 * MS) / SEC)
 
 
 def test_history_window_eviction_bounds_memory():
