@@ -44,6 +44,7 @@ def sim_workload(partition: Partition, job_name: str, spec: dict) -> Job:
         job_name,
         params=SchedParams(**spec.get("sched", {})),
         n_contexts=int(spec.get("n_contexts", 1)),
+        micro_per_step=int(spec.get("micro_per_step", 1)),
         gang=bool(spec.get("gang", False)),
         max_steps=spec.get("max_steps"),
         label=str(spec.get("label", "user")),
@@ -188,6 +189,10 @@ class Agent:
                       "boost_on_wake": p.boost_on_wake},
             "contexts": [
                 {"sched_count": c.sched_count,
+                 # Mid-accumulation position: must travel with the job
+                 # or step retirement desyncs from the model's own
+                 # micro cursor after a mid-step migration.
+                 "micro_progress": c.micro_progress,
                  "counters": [int(x) for x in c.counters]}
                 for c in j.contexts
             ],
@@ -239,6 +244,7 @@ class Agent:
                 "contention", (0, 0))
             for ctx, cstate in zip(j.contexts, saved.get("contexts", ())):
                 ctx.sched_count = int(cstate.get("sched_count", 0))
+                ctx.micro_progress = int(cstate.get("micro_progress", 0))
                 ctrs = np.array(cstate.get("counters", []), dtype=np.uint64)
                 if len(ctrs) == len(ctx.counters):
                     ctx.counters = ctrs

@@ -5,6 +5,7 @@ from pbs_tpu.models.generate import (
     make_serve_step,
     prefill,
 )
+from pbs_tpu.models.microstep import make_micro_train_step
 from pbs_tpu.models.moe import (
     MoEConfig,
     init_moe_params,
@@ -31,6 +32,7 @@ __all__ = [
     "init_params",
     "make_eval_step",
     "make_generate",
+    "make_micro_train_step",
     "make_moe_train_step",
     "make_serve_step",
     "make_train_step",

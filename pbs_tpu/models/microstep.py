@@ -1,0 +1,104 @@
+"""Gradient-accumulation micro-steps: chunked long steps for latency.
+
+Why this exists (SURVEY.md §7 "hard parts"): a TPU job cannot be
+preempted mid-step, so a tenant whose compiled step takes 1 s makes a
+100 µs time slice meaningless — the quantum floor is one step. The
+reference never has this problem because its hardware preempts by timer
+(``xen-4.2.1/xen/common/sched_credit.c:52,1796-1805``: any guest is cut
+at the per-domain slice). The TPU answer is *cooperative decomposition*:
+split the optimizer step into K compiled micro-batches (each an inner
+``lax.scan`` over its own tokens), return to the host between chunks,
+and let the executor deschedule at any chunk boundary
+(``runtime/executor.py`` micro dispatch + ``Job.micro_per_step``). The
+host check between chunks is the "donation/early-exit hook" SURVEY.md
+§7 names.
+
+Math contract: K micro-steps over micro-batches b_1..b_K with averaged
+accumulated gradients are *exactly* one full-batch step over
+concat(b_1..b_K) (equal micro-batch sizes: mean-of-means = global mean,
+so averaged grads = full-batch grads; AdamW sees identical inputs).
+``tests/test_microstep.py`` asserts parameter-level parity.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from pbs_tpu.models.transformer import (
+    TransformerConfig,
+    default_optimizer,
+    next_token_loss,
+)
+
+
+def make_micro_train_step(
+    cfg: TransformerConfig,
+    n_micro: int,
+    learning_rate: float = 3e-4,
+    constrain: Callable = lambda x: x,
+    next_batch: Callable[[int], Any] | None = None,
+):
+    """Returns ``(init_state, micro_step)``.
+
+    - ``init_state(params, next_batch=None) -> state``
+    - ``micro_step(state) -> (state, metrics)`` — processes ONE
+      micro-batch; every ``n_micro``-th call applies the AdamW update
+      and retires the optimizer step.
+
+    ``next_batch(micro_index) -> tokens`` supplies each micro-batch (a
+    data-loader hook; tests close over fixed arrays). It lives in the
+    *closure*, never in the state pytree: the state carries only arrays
+    (params, opt_state, grad accumulator, micro cursor, step) so it
+    checkpoints cleanly (np.save leaves); on restore, rebuild
+    ``micro_step`` with the same loader and hand it the restored state.
+
+    Pair with ``Job(micro_step_fn=micro_step, micro_per_step=n_micro)``
+    so the executor dispatches in chunk units.
+    """
+    import optax
+
+    if n_micro < 1:
+        raise ValueError("n_micro must be >= 1")
+    if next_batch is None:
+        raise ValueError("next_batch is required (micro-batch supplier)")
+    tx = default_optimizer(learning_rate)
+
+    @jax.jit
+    def _accum(params, acc, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: next_token_loss(cfg, p, tokens, constrain)
+        )(params)
+        return loss, jax.tree.map(jnp.add, acc, grads)
+
+    @jax.jit
+    def _apply(params, opt_state, acc):
+        mean = jax.tree.map(lambda g: g / n_micro, acc)
+        updates, opt_state = tx.update(mean, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        zero = jax.tree.map(jnp.zeros_like, acc)
+        return params, opt_state, zero
+
+    def init_state(params):
+        return {
+            "params": params,
+            "opt": tx.init(params),
+            "acc": jax.tree.map(jnp.zeros_like, params),
+            "micro": 0,
+            "step": 0,
+        }
+
+    def micro_step(state):
+        tokens = next_batch(state["micro"])
+        loss, acc = _accum(state["params"], state["acc"], tokens)
+        state = dict(state, acc=acc, micro=state["micro"] + 1)
+        if state["micro"] >= n_micro:
+            params, opt, zero = _apply(state["params"], state["opt"], acc)
+            state.update(params=params, opt=opt, acc=zero, micro=0,
+                         step=state["step"] + 1)
+        ntok = tokens.shape[0] * (tokens.shape[1] - 1)
+        return state, {"loss": loss, "tokens": ntok}
+
+    return init_state, micro_step

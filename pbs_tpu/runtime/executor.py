@@ -89,13 +89,32 @@ class Executor:
             part.ledger.resume(ctx.ledger_slot, now)
         part.trace_emit(self.index, Ev.SCHED_PICK, ctx.ledger_slot, quantum_ns)
 
-        n_steps = quantum_to_steps(quantum_ns, ctx.avg_step_ns)
-        if ctx.job.max_steps is not None:
-            remaining = ctx.job.max_steps - ctx.job.steps_retired()
-            n_steps = max(1, min(n_steps, remaining))
+        # Sub-step latency bounding: a job with micro_per_step > 1 is
+        # dispatched in micro units (its step decomposed into compiled
+        # chunks with host-checked exits between them), so a long step
+        # no longer floors the quantum — the 100 µs slice analog
+        # (sched_credit.c:52; SURVEY.md §7 "hard parts").
+        K = ctx.job.micro_per_step
+        micro = K > 1 and hasattr(part.source, "execute_micro")
+        if micro:
+            n_units = quantum_to_steps(quantum_ns, ctx.avg_step_ns / K)
+            if ctx.job.max_steps is not None:
+                rem = ((ctx.job.max_steps - ctx.job.steps_retired()) * K
+                       - ctx.micro_progress)
+                n_units = max(1, min(n_units, rem))
+            n_steps_equiv = n_units / K
+        else:
+            n_units = quantum_to_steps(quantum_ns, ctx.avg_step_ns)
+            if ctx.job.max_steps is not None:
+                remaining = ctx.job.max_steps - ctx.job.steps_retired()
+                n_units = max(1, min(n_units, remaining))
+            n_steps_equiv = n_units
 
         try:
-            deltas = part.source.execute(ctx, n_steps)
+            if micro:
+                deltas = part.source.execute_micro(ctx, n_units)
+            else:
+                deltas = part.source.execute(ctx, n_units)
         except Exception as exc:  # noqa: BLE001 — contained below
             # Fault containment (the MCE model, tools/tests/mce-test):
             # a device/step fault poisons only the faulting job; the
@@ -112,7 +131,7 @@ class Executor:
         ran_ns = int(deltas[Counter.DEVICE_TIME_NS])
         deltas[Counter.SCHED_COUNT] = 1
         ctx.counters += deltas
-        ctx.observe_step_time(ran_ns, n_steps)
+        ctx.observe_step_time(ran_ns, n_steps_equiv)
         if ctx.ledger_slot >= 0:
             part.ledger.suspend(ctx.ledger_slot, deltas)
         self.current = None
@@ -122,6 +141,10 @@ class Executor:
         part.trace_emit(self.index, Ev.SCHED_DESCHED, ctx.ledger_slot, ran_ns)
         part.timers.fire_due(end)
         part.scheduler.descheduled(self, ctx, ran_ns, end)
+        # Overflow check at the quantum boundary (pmu_ihandler analog):
+        # counters only advance here, so this is where i-mode thresholds
+        # can cross; the virq is delivered by the run loop between quanta.
+        part.sampler.check(ctx)
 
         if ctx.job.finished():
             for c in ctx.job.contexts:

@@ -85,6 +85,8 @@ class Job:
         gang: bool = False,
         label: str = "user",
         mem_bytes: int | None = None,
+        micro_per_step: int = 1,
+        micro_step_fn: Callable[[Any], Any] | None = None,
     ):
         self.name = name
         # Security label for XSM checks (the FLASK domain label).
@@ -93,6 +95,20 @@ class Job:
         # admission (runtime.memory.nbytes_of).
         self.mem_bytes = mem_bytes
         self.step_fn = step_fn
+        # Sub-step latency bounding (SURVEY.md §7 "hard parts"; the real
+        # analog of the reference's 100 µs slice, sched_credit.c:52):
+        # a job whose compiled step is long may decompose it into
+        # ``micro_per_step`` micro-steps (e.g. gradient-accumulation
+        # chunks, each an inner lax.scan), advanced by ``micro_step_fn``
+        # (required when K > 1 on a real backend — step_fn advances a
+        # FULL step). The executor then converts quanta to micro units
+        # and can deschedule the job mid-step at a chunk boundary — a
+        # host-checked early exit between compiled chunks. The mid-step
+        # position lives in ctx.micro_progress and travels in
+        # save/restore records (dist/agent.py) so migration can't
+        # desync step retirement from the model's accumulation cursor.
+        self.micro_per_step = max(1, int(micro_per_step))
+        self.micro_step_fn = micro_step_fn
         self.state = state
         self.params = params or SchedParams()
         self.compiled = compiled
@@ -164,6 +180,9 @@ class ExecutionContext:
         self.sched_count = 0
         # EWMA of step wall time, for quantum(ns) -> steps conversion.
         self.avg_step_ns: float = 1_000_000.0
+        # Position within the current step in micro units
+        # (0..job.micro_per_step-1); advanced by the telemetry source.
+        self.micro_progress: int = 0
         # Assigned executor id (affinity pin; None = any).
         self.executor_hint: int | None = None
         # Ledger slot id, assigned by the partition at admission.

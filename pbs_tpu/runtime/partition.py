@@ -71,6 +71,11 @@ class Partition:
         # Async signaling fabric (event_channel.c analog); delivered by
         # the run loop between quanta.
         self.events = EventBus()
+        # i-mode counter sampling: thresholds -> Virq.TELEMETRY -> rearm
+        # (the VIRQ_PERFCTR overflow path, telemetry/sampler.py).
+        from pbs_tpu.telemetry.sampler import OverflowSampler
+
+        self.sampler = OverflowSampler(self.events)
         # Optional HBM accounting/admission (runtime.memory).
         self.memory = memory
         self._free_slots = list(range(ledger_slots - 1, -1, -1))
@@ -183,6 +188,9 @@ class Partition:
         xsm.xsm_check(subject, "job.destroy", job.label)
         if self.memory is not None:
             self.memory.close_account(job.name)
+        # Dead jobs must not pin their contexts via armed samples (or
+        # keep getting scanned by every overflow check).
+        self.sampler.disarm_job(job)
         self.scheduler.job_removed(job)
         self.jobs.remove(job)
         for ctx in job.contexts:
@@ -220,6 +228,7 @@ class Partition:
         ``ctx``/``lane`` identify the faulting context and executor so
         the postmortem trace names the right victim."""
         job.error = f"{type(exc).__name__}: {exc}"
+        self.sampler.disarm_job(job)
         for c in job.contexts:
             if c.state is not ContextState.FAILED:
                 c.state = ContextState.FAILED

@@ -1,5 +1,6 @@
 from pbs_tpu.telemetry.counters import NUM_COUNTERS, Counter, DUMP_EVENTS
 from pbs_tpu.telemetry.ledger import Ledger, SLOT_BYTES, SLOT_WORDS
+from pbs_tpu.telemetry.sampler import OverflowEvent, OverflowSampler
 from pbs_tpu.telemetry.source import (
     SimBackend,
     SimPhase,
@@ -15,6 +16,8 @@ __all__ = [
     "Ledger",
     "SLOT_BYTES",
     "SLOT_WORDS",
+    "OverflowEvent",
+    "OverflowSampler",
     "SimBackend",
     "SimPhase",
     "SimProfile",

@@ -45,6 +45,13 @@ class TelemetrySource(Protocol):
         (length NUM_COUNTERS)."""
         ...
 
+    def execute_micro(self, ctx: Any, n_micro: int) -> np.ndarray:
+        """Run ``n_micro`` micro-steps (1/``job.micro_per_step`` of a
+        step each), advancing ``ctx.micro_progress`` and retiring a full
+        step on each wrap. Lets the executor deschedule a long-step job
+        mid-step at a chunk boundary (sub-step latency bounding)."""
+        ...
+
 
 # ---------------------------------------------------------------------------
 # Simulation backend
@@ -112,6 +119,19 @@ class SimBackend:
         :meth:`seek`)."""
         return self._steps_done.get(job_name, 0)
 
+    def _charge_phase(self, deltas: np.ndarray, ph: SimPhase,
+                      k: int) -> int:
+        """Advance the clock by 1/k of the phase's step and charge the
+        proportional traffic; returns the advanced nanoseconds."""
+        t = max(1, ph.step_time_ns // k)
+        self.clock.advance(t)
+        deltas[Counter.DEVICE_TIME_NS] += t
+        deltas[Counter.HBM_BYTES] += ph.hbm_bytes // k
+        deltas[Counter.HBM_STALL_NS] += int(t * ph.stall_frac)
+        deltas[Counter.COLLECTIVE_WAIT_NS] += ph.collective_wait_ns // k
+        deltas[Counter.DEVICE_FLOPS] += ph.flops // k
+        return t
+
     def execute(self, ctx: Any, n_steps: int) -> np.ndarray:
         name = ctx.job.name
         prof = self._profiles[name]
@@ -119,15 +139,33 @@ class SimBackend:
         for _ in range(n_steps):
             step = self._steps_done[name]
             ph = prof.phase_at(step)
-            self.clock.advance(ph.step_time_ns)
+            self._charge_phase(deltas, ph, 1)
             deltas[Counter.STEPS_RETIRED] += 1
-            deltas[Counter.DEVICE_TIME_NS] += ph.step_time_ns
-            deltas[Counter.HBM_BYTES] += ph.hbm_bytes
-            deltas[Counter.HBM_STALL_NS] += int(ph.step_time_ns * ph.stall_frac)
-            deltas[Counter.COLLECTIVE_WAIT_NS] += ph.collective_wait_ns
-            deltas[Counter.DEVICE_FLOPS] += ph.flops
             deltas[Counter.TOKENS] += ph.tokens
             self._steps_done[name] = step + 1
+        return deltas
+
+    def execute_micro(self, ctx: Any, n_micro: int) -> np.ndarray:
+        """Micro-step execution: each unit burns 1/K of the phase's step
+        time and traffic; a step retires (and its tokens land) when the
+        micro cursor wraps. Ending a quantum mid-step records a YIELD —
+        the voluntary early exit the latency bound relies on."""
+        name = ctx.job.name
+        K = ctx.job.micro_per_step
+        prof = self._profiles[name]
+        deltas = np.zeros(NUM_COUNTERS, dtype=np.uint64)
+        for _ in range(n_micro):
+            step = self._steps_done[name]
+            ph = prof.phase_at(step)
+            self._charge_phase(deltas, ph, K)
+            ctx.micro_progress += 1
+            if ctx.micro_progress >= K:
+                ctx.micro_progress = 0
+                deltas[Counter.STEPS_RETIRED] += 1
+                deltas[Counter.TOKENS] += ph.tokens
+                self._steps_done[name] = step + 1
+        if ctx.micro_progress:
+            deltas[Counter.YIELDS] += 1
         return deltas
 
 
@@ -187,37 +225,80 @@ class TpuBackend:
         except Exception:
             pass
 
+    _METRIC_KEYS = (
+        ("collective_wait_ns", Counter.COLLECTIVE_WAIT_NS),
+        ("gang_skew_ns", Counter.GANG_SKEW_NS),
+        ("tokens", Counter.TOKENS),
+    )
+
+    def _invoke(self, job, fn) -> tuple[int, dict]:
+        """Run one host-callable unit; returns (wall_ns, metrics)."""
+        t0 = time.monotonic_ns()
+        out = fn(job.state)
+        metrics: dict[str, float] = {}
+        if (isinstance(out, tuple) and len(out) == 2
+                and isinstance(out[1], dict)):
+            job.state, metrics = out
+        else:
+            job.state = out
+        self._block(job.state)
+        return time.monotonic_ns() - t0, metrics
+
+    def _charge(self, deltas: np.ndarray, dt: int, flops: int,
+                nbytes: int, metrics: dict) -> None:
+        deltas[Counter.DEVICE_TIME_NS] += dt
+        deltas[Counter.HBM_BYTES] += nbytes
+        deltas[Counter.DEVICE_FLOPS] += flops
+        # Roofline stall estimate: fraction of the step the program
+        # was memory-bound. Coarse, but behind the TelemetrySource
+        # seam so fidelity can improve without policy changes.
+        if flops or nbytes:
+            t_mem = nbytes / self.peak_hbm_bw
+            t_flop = flops / self.peak_flops
+            frac = t_mem / (t_mem + t_flop) if (t_mem + t_flop) > 0 else 0.0
+            deltas[Counter.HBM_STALL_NS] += int(dt * frac)
+        for key, ctr in self._METRIC_KEYS:
+            if key in metrics:
+                deltas[ctr] += np.uint64(max(0, int(metrics[key])))
+
     def execute(self, ctx: Any, n_steps: int) -> np.ndarray:
         job = ctx.job
         deltas = np.zeros(NUM_COUNTERS, dtype=np.uint64)
         flops, nbytes = self._job_cost(job)
         for _ in range(n_steps):
-            t0 = time.monotonic_ns()
-            out = job.step_fn(job.state)
-            metrics: dict[str, float] = {}
-            if isinstance(out, tuple) and len(out) == 2 and isinstance(out[1], dict):
-                job.state, metrics = out
-            else:
-                job.state = out
-            self._block(job.state)
-            dt = time.monotonic_ns() - t0
+            dt, metrics = self._invoke(job, job.step_fn)
+            self._charge(deltas, dt, flops, nbytes, metrics)
             deltas[Counter.STEPS_RETIRED] += 1
-            deltas[Counter.DEVICE_TIME_NS] += dt
-            deltas[Counter.HBM_BYTES] += nbytes
-            deltas[Counter.DEVICE_FLOPS] += flops
-            # Roofline stall estimate: fraction of the step the program
-            # was memory-bound. Coarse, but behind the TelemetrySource
-            # seam so fidelity can improve without policy changes.
-            if flops or nbytes:
-                t_mem = nbytes / self.peak_hbm_bw
-                t_flop = flops / self.peak_flops
-                frac = t_mem / (t_mem + t_flop) if (t_mem + t_flop) > 0 else 0.0
-                deltas[Counter.HBM_STALL_NS] += int(dt * frac)
-            for key, ctr in (
-                ("collective_wait_ns", Counter.COLLECTIVE_WAIT_NS),
-                ("gang_skew_ns", Counter.GANG_SKEW_NS),
-                ("tokens", Counter.TOKENS),
-            ):
-                if key in metrics:
-                    deltas[ctr] += np.uint64(max(0, int(metrics[key])))
+        return deltas
+
+    def execute_micro(self, ctx: Any, n_micro: int) -> np.ndarray:
+        """Chunked execution of a long-step job: each call to
+        ``micro_step_fn`` advances one compiled chunk (e.g. a
+        gradient-accumulation micro-batch running an inner ``lax.scan``);
+        the host checks between chunks whether the quantum is spent —
+        that host check IS the early-exit hook SURVEY.md §7 calls for.
+        A full step (and its cost-analysis FLOPs/bytes) retires when the
+        micro cursor wraps."""
+        job = ctx.job
+        K = job.micro_per_step
+        fn = job.micro_step_fn
+        if fn is None:
+            # step_fn advances a FULL step; silently substituting it
+            # would run K real steps per retired step and mischarge
+            # FLOPs/HBM by 1/K.
+            raise ValueError(
+                f"job {job.name!r} has micro_per_step={K} but no "
+                "micro_step_fn; provide a chunk-sized step "
+                "(e.g. models.make_micro_train_step)")
+        deltas = np.zeros(NUM_COUNTERS, dtype=np.uint64)
+        flops, nbytes = self._job_cost(job)
+        for _ in range(n_micro):
+            dt, metrics = self._invoke(job, fn)
+            self._charge(deltas, dt, flops // K, nbytes // K, metrics)
+            ctx.micro_progress += 1
+            if ctx.micro_progress >= K:
+                ctx.micro_progress = 0
+                deltas[Counter.STEPS_RETIRED] += 1
+        if ctx.micro_progress:
+            deltas[Counter.YIELDS] += 1
         return deltas
