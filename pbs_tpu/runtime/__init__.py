@@ -1,3 +1,8 @@
+from pbs_tpu.runtime.compile_gate import (
+    CompileAdmission,
+    CompileBudget,
+    CompileBudgetExceeded,
+)
 from pbs_tpu.runtime.events import EventBus, EventChannel, Virq
 from pbs_tpu.runtime.executor import Executor, quantum_to_steps
 from pbs_tpu.runtime.memory import (
@@ -34,6 +39,9 @@ from pbs_tpu.runtime.watchdog import (
 )
 
 __all__ = [
+    "CompileAdmission",
+    "CompileBudget",
+    "CompileBudgetExceeded",
     "ContextState",
     "DummyPolicy",
     "EventBus",

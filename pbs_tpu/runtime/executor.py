@@ -132,6 +132,12 @@ class Executor:
         deltas[Counter.SCHED_COUNT] = 1
         ctx.counters += deltas
         ctx.observe_step_time(ran_ns, n_steps_equiv)
+        if part.compile_admission is not None:
+            # Measured compile spend tightens the admission projections
+            # (runtime.compile_gate) — the accounting leg of the claim.
+            c_ns = int(deltas[Counter.COMPILE_TIME_NS])
+            if c_ns:
+                part.compile_admission.charge(ctx.job.name, c_ns)
         if ctx.ledger_slot >= 0:
             part.ledger.suspend(ctx.ledger_slot, deltas)
         self.current = None

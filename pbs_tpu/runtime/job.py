@@ -87,8 +87,17 @@ class Job:
         mem_bytes: int | None = None,
         micro_per_step: int = 1,
         micro_step_fn: Callable[[Any], Any] | None = None,
+        n_programs: int = 1,
+        est_compile_ns: int | None = None,
     ):
         self.name = name
+        # Compile-cache admission declaration (runtime.compile_gate):
+        # how many distinct XLA programs this job brings (cache entries
+        # it will occupy) and, optionally, the expected per-program
+        # compile cost; undeclared costs are projected from the
+        # observed fleet average.
+        self.n_programs = max(1, int(n_programs))
+        self.est_compile_ns = est_compile_ns
         # Security label for XSM checks (the FLASK domain label).
         self.label = label
         # Declared HBM working set; None = estimate from state at

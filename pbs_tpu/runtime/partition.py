@@ -54,6 +54,7 @@ class Partition:
         trace_dir: str | None = None,
         sched_params: dict[str, Any] | None = None,
         memory: "MemoryManager | None" = None,
+        compile_admission: "CompileAdmission | None" = None,
     ):
         self.name = name
         self.source = source
@@ -78,6 +79,10 @@ class Partition:
         self.sampler = OverflowSampler(self.events)
         # Optional HBM accounting/admission (runtime.memory).
         self.memory = memory
+        # Optional compile-cache admission (runtime.compile_gate): the
+        # TPU-new scarce resource SURVEY.md §7 flags — distinct programs
+        # per partition and cumulative compile time.
+        self.compile_admission = compile_admission
         self._free_slots = list(range(ledger_slots - 1, -1, -1))
         self.jobs: list[Job] = []
         # Monotone quantum counter; WallWatchdog reads it out-of-band.
@@ -112,6 +117,11 @@ class Partition:
 
     def add_job(self, job: Job, subject: str = xsm.SYSTEM) -> Job:
         xsm.xsm_check(subject, "job.create", job.label)
+        if self.compile_admission is not None:
+            # Fail-fast compile-cache claim FIRST: it touches no shared
+            # state beyond its own table, so rejection leaves nothing
+            # to unwind (the XENMEM_claim_pages ordering).
+            self.compile_admission.admit(job)
         if self.memory is not None:
             # Fail-fast HBM admission (XENMEM_claim_pages): account +
             # claim the working set before touching scheduler state, so
@@ -125,6 +135,8 @@ class Partition:
                 self.memory.claim_or_balloon(job.name, need)
             except Exception:
                 self.memory.close_account(job.name)
+                if self.compile_admission is not None:
+                    self.compile_admission.release(job.name)
                 raise
         try:
             for ctx in job.contexts:
@@ -142,6 +154,8 @@ class Partition:
                     ctx.ledger_slot = -1
             if self.memory is not None:
                 self.memory.close_account(job.name)
+            if self.compile_admission is not None:
+                self.compile_admission.release(job.name)
             raise
         # Scheduler enrollment is part of the same atomic admission: a
         # job_added/wake failure must unwind jobs-list membership, the
@@ -170,6 +184,8 @@ class Partition:
                     ctx.ledger_slot = -1
             if self.memory is not None:
                 self.memory.close_account(job.name)
+            if self.compile_admission is not None:
+                self.compile_admission.release(job.name)
             raise
         return job
 
@@ -188,6 +204,8 @@ class Partition:
         xsm.xsm_check(subject, "job.destroy", job.label)
         if self.memory is not None:
             self.memory.close_account(job.name)
+        if self.compile_admission is not None:
+            self.compile_admission.release(job.name)
         # Dead jobs must not pin their contexts via armed samples (or
         # keep getting scanned by every overflow check).
         self.sampler.disarm_job(job)

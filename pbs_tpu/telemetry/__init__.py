@@ -1,5 +1,7 @@
 from pbs_tpu.telemetry.counters import NUM_COUNTERS, Counter, DUMP_EVENTS
 from pbs_tpu.telemetry.ledger import Ledger, SLOT_BYTES, SLOT_WORDS
+from pbs_tpu.telemetry.compile import CompileMeter
+from pbs_tpu.telemetry.profiler import TraceStats, XlaQuantumProfiler
 from pbs_tpu.telemetry.sampler import OverflowEvent, OverflowSampler
 from pbs_tpu.telemetry.source import (
     SimBackend,
@@ -10,6 +12,7 @@ from pbs_tpu.telemetry.source import (
 )
 
 __all__ = [
+    "CompileMeter",
     "NUM_COUNTERS",
     "Counter",
     "DUMP_EVENTS",
@@ -23,4 +26,6 @@ __all__ = [
     "SimProfile",
     "TelemetrySource",
     "TpuBackend",
+    "TraceStats",
+    "XlaQuantumProfiler",
 ]

@@ -1,0 +1,129 @@
+"""Compile-time metering: per-job attribution of XLA compilation cost.
+
+SURVEY.md §7 lists compile-cache thrash as the #1 TPU-specific
+multiplexing hazard the reference never had: Xen guests don't JIT
+their own kernels, but every distinct program a tenant brings costs
+seconds of XLA compile time and a compile-cache slot, and a partition
+multiplexing many tenants can spend more time compiling than running.
+
+This module taps JAX's public monitoring stream
+(``jax.monitoring.register_event_duration_secs_listener``; the
+``/jax/core/compile/backend_compile_duration`` event fires once per
+actual XLA compilation) and attributes each event to the job whose
+dispatch triggered it — the scope is set by ``TpuBackend`` around every
+host-callable invocation. The drained per-job sums land in the
+``COMPILES`` / ``COMPILE_TIME_NS`` ledger slots, making compilation a
+first-class scheduled-resource like device time, and feed the
+admission gate in ``pbs_tpu.runtime.compile_gate``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterator
+
+#: The monitoring event that corresponds to one real XLA compilation.
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+#: Front-end work (tracing, MLIR emission) also attributed to the job,
+#: but not counted as a cache-filling "compile".
+FRONTEND_EVENTS = (
+    "/jax/core/compile/jaxpr_trace_duration",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration",
+)
+
+
+class CompileMeter:
+    """Singleton tap on the JAX compile-event stream.
+
+    ``attribute(name)`` scopes the current thread's compilations to a
+    job; unattributed events accumulate under ``"<ambient>"`` so system
+    compile load is visible too, never silently dropped.
+    """
+
+    _instance: "CompileMeter | None" = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        # name -> [compiles, compile_ns, frontend_ns] (pending drain)
+        self._pending: dict[str, list[int]] = {}
+        # lifetime totals (admission projections read these)
+        self.total_compiles = 0
+        self.total_compile_ns = 0
+        self._installed = False
+
+    @classmethod
+    def install(cls) -> "CompileMeter":
+        """Create-or-return the process-wide meter (the listener API has
+        no deregistration, so exactly one is ever installed)."""
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+                cls._instance._register()
+            return cls._instance
+
+    def _register(self) -> None:
+        if self._installed:
+            return
+        try:
+            import jax
+
+            jax.monitoring.register_event_duration_secs_listener(
+                self._on_event)
+            self._installed = True
+        except Exception:  # noqa: BLE001 — metering must never break jobs
+            self._installed = False
+
+    # -- listener ---------------------------------------------------------
+
+    def _on_event(self, event: str, duration_s: float, **kw) -> None:
+        is_backend = event == BACKEND_COMPILE_EVENT
+        if not is_backend and event not in FRONTEND_EVENTS:
+            return
+        scope = getattr(self._tls, "scope", None) or "<ambient>"
+        ns = int(duration_s * 1e9)
+        with self._lock:
+            ent = self._pending.setdefault(scope, [0, 0, 0])
+            if is_backend:
+                ent[0] += 1
+                ent[1] += ns
+                self.total_compiles += 1
+                self.total_compile_ns += ns
+            else:
+                ent[2] += ns
+
+    # -- attribution scope ------------------------------------------------
+
+    @contextlib.contextmanager
+    def attribute(self, name: str) -> Iterator[None]:
+        prev = getattr(self._tls, "scope", None)
+        self._tls.scope = name
+        try:
+            yield
+        finally:
+            self._tls.scope = prev
+
+    def take(self, name: str) -> tuple[int, int]:
+        """Drain (compiles, compile_ns) attributed to ``name`` since the
+        last take. Frontend time is folded into compile_ns — from the
+        tenant's perspective it is all time-to-first-step."""
+        with self._lock:
+            ent = self._pending.pop(name, None)
+        if ent is None:
+            return 0, 0
+        return ent[0], ent[1] + ent[2]
+
+    def peek_all(self) -> dict[str, tuple[int, int]]:
+        with self._lock:
+            return {k: (v[0], v[1] + v[2])
+                    for k, v in self._pending.items()}
+
+    @property
+    def mean_compile_ns(self) -> int:
+        """Observed average per-compilation cost — the projection basis
+        for admission when a job declares no estimate."""
+        if self.total_compiles == 0:
+            return 0
+        return self.total_compile_ns // self.total_compiles

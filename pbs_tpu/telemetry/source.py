@@ -11,10 +11,12 @@ the same seam as a ``TelemetrySource`` protocol with two backends:
   against mocked hardware and test it as a normal program). Every
   scheduler/policy test in ``tests/`` runs against this.
 - ``TpuBackend`` — real measurements: step wall time (device-synchronised),
-  XLA cost analysis per compiled executable (FLOPs, HBM bytes), a roofline
-  HBM-stall estimate, and in-graph metrics the job's step function
-  returns to the host (collective wait — the batched ``vcrd_op`` analog,
-  ``sched_credit.c:249-259``).
+  XLA cost analysis per compiled executable (FLOPs, HBM bytes), measured
+  per-op time from periodic XLA-profiler samples (``profiler.py`` — the
+  rdpmc-read analog, ``perfctr.c:1547-1573``) with a roofline HBM-stall
+  estimate as the cold-start fallback, and in-graph metrics the job's
+  step function returns to the host (collective wait — the batched
+  ``vcrd_op`` analog, ``sched_credit.c:249-259``).
 """
 
 from __future__ import annotations
@@ -202,12 +204,32 @@ class TpuBackend:
         clock: Clock | None = None,
         peak_flops: float = DEFAULT_PEAK_FLOPS,
         peak_hbm_bw: float = DEFAULT_PEAK_HBM_BW,
+        profile_every: int = 0,
+        profiler=None,
     ):
         self.clock = clock or MonotonicClock()
         self.peak_flops = peak_flops
         self.peak_hbm_bw = peak_hbm_bw
         # per-job (flops, bytes) from cost analysis, captured at first run
         self._costs: dict[str, tuple[int, int]] = {}
+        # Measured-telemetry sampling: every N-th invocation per job runs
+        # under the XLA profiler; the parsed per-op time fills the stall/
+        # collective counters and its fractions carry forward until the
+        # next sample. 0 = roofline-estimate only (round-1 behavior).
+        self.profile_every = int(profile_every)
+        if profiler is None and self.profile_every > 0:
+            from pbs_tpu.telemetry.profiler import XlaQuantumProfiler
+
+            profiler = XlaQuantumProfiler()
+        self.profiler = profiler
+        self._measured: dict[str, Any] = {}  # job name -> TraceStats
+        self._since_profile: dict[str, int] = {}
+        # Per-job compile attribution (telemetry.compile): every
+        # invocation runs in the job's attribution scope, so first-call
+        # jit compilation lands in ITS ledger slots, not nowhere.
+        from pbs_tpu.telemetry.compile import CompileMeter
+
+        self.compile_meter = CompileMeter.install()
 
     def _job_cost(self, job) -> tuple[int, int]:
         c = self._costs.get(job.name)
@@ -231,28 +253,66 @@ class TpuBackend:
         ("tokens", Counter.TOKENS),
     )
 
+    def measured(self, job_name: str):
+        """Latest measured TraceStats for a job (None before the first
+        profiler sample, or with profiling disabled)."""
+        return self._measured.get(job_name)
+
+    def _profile_due(self, job) -> bool:
+        if not self.profile_every or self.profiler is None:
+            return False
+        k = self._since_profile.get(job.name, self.profile_every)
+        due = k >= self.profile_every  # first invocation profiles
+        self._since_profile[job.name] = 1 if due else k + 1
+        return due
+
     def _invoke(self, job, fn) -> tuple[int, dict]:
         """Run one host-callable unit; returns (wall_ns, metrics)."""
+
+        def run():
+            out = fn(job.state)
+            metrics: dict[str, float] = {}
+            if (isinstance(out, tuple) and len(out) == 2
+                    and isinstance(out[1], dict)):
+                st, metrics = out
+            else:
+                st = out
+            self._block(st)
+            return st, metrics
+
         t0 = time.monotonic_ns()
-        out = fn(job.state)
-        metrics: dict[str, float] = {}
-        if (isinstance(out, tuple) and len(out) == 2
-                and isinstance(out[1], dict)):
-            job.state, metrics = out
-        else:
-            job.state = out
-        self._block(job.state)
+        with self.compile_meter.attribute(job.name):
+            if self._profile_due(job):
+                (job.state, metrics), stats = self.profiler.profile(run)
+                if stats is not None and stats.n_ops:
+                    self._measured[job.name] = stats
+            else:
+                job.state, metrics = run()
         return time.monotonic_ns() - t0, metrics
 
     def _charge(self, deltas: np.ndarray, dt: int, flops: int,
-                nbytes: int, metrics: dict) -> None:
+                nbytes: int, metrics: dict, measured=None) -> None:
+        # In-graph instrumented kernels (ops.matmul emits its own tile/
+        # byte counters, PMC-style) outrank the static cost-analysis
+        # estimate for the same quantity.
+        flops = int(metrics.get("device_flops", flops))
+        nbytes = int(metrics.get("hbm_bytes", nbytes))
         deltas[Counter.DEVICE_TIME_NS] += dt
         deltas[Counter.HBM_BYTES] += nbytes
         deltas[Counter.DEVICE_FLOPS] += flops
-        # Roofline stall estimate: fraction of the step the program
-        # was memory-bound. Coarse, but behind the TelemetrySource
-        # seam so fidelity can improve without policy changes.
-        if flops or nbytes:
+        if measured is not None and measured.n_ops:
+            # Measured path (the rdpmc analog): fractions from the latest
+            # profiler sample apply to this quantum's wall time — stall
+            # tracks what the ops actually did, so phase changes show up
+            # without waiting for the next sample's absolute numbers.
+            deltas[Counter.HBM_STALL_NS] += int(dt * measured.stall_frac)
+            if "collective_wait_ns" not in metrics and measured.collective_ns:
+                deltas[Counter.COLLECTIVE_WAIT_NS] += int(
+                    dt * measured.collective_frac)
+        elif flops or nbytes:
+            # Roofline stall estimate: fraction of the step the program
+            # was memory-bound. Coarse, but behind the TelemetrySource
+            # seam so fidelity can improve without policy changes.
             t_mem = nbytes / self.peak_hbm_bw
             t_flop = flops / self.peak_flops
             frac = t_mem / (t_mem + t_flop) if (t_mem + t_flop) > 0 else 0.0
@@ -267,9 +327,17 @@ class TpuBackend:
         flops, nbytes = self._job_cost(job)
         for _ in range(n_steps):
             dt, metrics = self._invoke(job, job.step_fn)
-            self._charge(deltas, dt, flops, nbytes, metrics)
+            self._charge(deltas, dt, flops, nbytes, metrics,
+                         measured=self._measured.get(job.name))
             deltas[Counter.STEPS_RETIRED] += 1
+        self._charge_compiles(deltas, job)
         return deltas
+
+    def _charge_compiles(self, deltas: np.ndarray, job) -> None:
+        n_c, c_ns = self.compile_meter.take(job.name)
+        if n_c or c_ns:
+            deltas[Counter.COMPILES] += n_c
+            deltas[Counter.COMPILE_TIME_NS] += c_ns
 
     def execute_micro(self, ctx: Any, n_micro: int) -> np.ndarray:
         """Chunked execution of a long-step job: each call to
@@ -294,11 +362,13 @@ class TpuBackend:
         flops, nbytes = self._job_cost(job)
         for _ in range(n_micro):
             dt, metrics = self._invoke(job, fn)
-            self._charge(deltas, dt, flops // K, nbytes // K, metrics)
+            self._charge(deltas, dt, flops // K, nbytes // K, metrics,
+                         measured=self._measured.get(job.name))
             ctx.micro_progress += 1
             if ctx.micro_progress >= K:
                 ctx.micro_progress = 0
                 deltas[Counter.STEPS_RETIRED] += 1
         if ctx.micro_progress:
             deltas[Counter.YIELDS] += 1
+        self._charge_compiles(deltas, job)
         return deltas
