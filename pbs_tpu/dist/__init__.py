@@ -16,6 +16,7 @@ from pbs_tpu.dist.controller import (
     JobRecord,
     MemberRef,
 )
+from pbs_tpu.dist.remus import RemusSession
 from pbs_tpu.dist.rpc import RpcClient, RpcError, RpcServer
 
 __all__ = [
@@ -24,6 +25,7 @@ __all__ = [
     "Controller",
     "JobRecord",
     "MemberRef",
+    "RemusSession",
     "RpcClient",
     "RpcError",
     "RpcServer",

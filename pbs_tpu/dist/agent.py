@@ -12,6 +12,7 @@ from a config).
 
 from __future__ import annotations
 
+import time as _time
 from typing import Any, Callable
 
 from pbs_tpu.dist.rpc import RpcServer
@@ -76,9 +77,17 @@ class Agent:
         self.workloads: dict[str, WorkloadFactory] = {"sim": sim_workload}
         self.workloads.update(workloads or {})
         self.server = RpcServer(host=host, port=port, auth_token=auth_token)
+        self._auth_token = auth_token
+        # Remus surfaces: replicas this host holds for OTHER hosts' jobs
+        # (job -> {"epoch", "saved", "source", "received_at"}) and the
+        # replication sessions pumping THIS host's jobs to peers.
+        self.replicas: dict[str, dict] = {}
+        self.remus: dict[str, Any] = {}
         for op in ("create_job", "remove_job", "sched_setparams",
                    "pause_job", "unpause_job", "run", "dump", "telemetry",
-                   "list_jobs", "save_job", "restore_job"):
+                   "list_jobs", "save_job", "restore_job", "push_replica",
+                   "get_replica", "list_replicas", "drop_replica",
+                   "replicate_start", "replicate_stop", "replicate_status"):
             self.server.register(op, getattr(self, "op_" + op))
         # info answers without the dispatch lock: it only reads counts
         # (torn reads are fine for a placement heuristic) and the
@@ -133,6 +142,9 @@ class Agent:
     def op_remove_job(self, job: str, subject: str = "remote") -> bool:
         j = self.partition.job(job)
         xsm_check(subject, "job.destroy", j.label)
+        sess = self.remus.pop(job, None)
+        if sess is not None:  # dead job needs no protection pump
+            sess.stop()
         self.partition.remove_job(j)
         return True
 
@@ -165,15 +177,9 @@ class Agent:
 
     # -- save/restore (xc_domain_save/restore over DCN) ------------------
 
-    def op_save_job(self, job: str, subject: str = "remote") -> dict:
-        """Quiesce and serialize one job for migration (``xl save``:
-        pause, then extract state). Unlike the reference — where perfctr
-        shared-page PMU state is NOT in the save records and counters
-        silently reset on migration (SURVEY.md §5) — the telemetry
-        counters travel with the job."""
-        j = self.partition.job(job)
-        xsm_check(subject, "job.save", j.label)
-        self.partition.sleep_job(j)  # stop-and-copy quiesce
+    def _save_record(self, j: Job) -> dict:
+        """Serialize one (already-quiesced) job: the xc_domain_save
+        record body, shared by migration save and Remus snapshots."""
         p = j.params
         saved: dict = {
             "job": j.name,
@@ -202,6 +208,32 @@ class Agent:
         if isinstance(self.partition.source, SimBackend):
             saved["backend"]["sim_steps_done"] = (
                 self.partition.source.position(j.name))
+        return saved
+
+    def op_save_job(self, job: str, subject: str = "remote") -> dict:
+        """Quiesce and serialize one job for migration (``xl save``:
+        pause, then extract state). Unlike the reference — where perfctr
+        shared-page PMU state is NOT in the save records and counters
+        silently reset on migration (SURVEY.md §5) — the telemetry
+        counters travel with the job."""
+        j = self.partition.job(job)
+        xsm_check(subject, "job.save", j.label)
+        self.partition.sleep_job(j)  # stop-and-copy quiesce
+        return self._save_record(j)
+
+    def snapshot_record(self, job: str) -> dict:
+        """Remus epoch capture: quiesce → record → resume. Unlike
+        ``op_save_job`` the job keeps running afterwards — suspension
+        lasts only the host-side record build (the reference's
+        sub-second suspend/resume cycle, tools/remus/README). A job the
+        user paused stays paused. Callers must hold ``dispatch_lock``
+        (RemusSession does); this is not itself an RPC op."""
+        j = self.partition.job(job)
+        was_paused = self._job_state(j) == "paused"
+        self.partition.sleep_job(j)
+        saved = self._save_record(j)
+        if not was_paused:
+            self.partition.wake_job(j)
         return saved
 
     def op_restore_job(self, job: str, workload: str | None = None,
@@ -262,6 +294,147 @@ class Agent:
         j.spec = dict(spec or {})
         return {"job": j.name, "steps": j.steps_retired()}
 
+    # -- Remus over the wire (tools/remus: continuous replication) -------
+
+    def op_push_replica(self, job: str, epoch: int, saved: dict,
+                        source: str = "?",
+                        subject: str = "remote") -> dict:
+        """Backup side of the Remus channel: store the newest epoch of a
+        peer host's job. The reply IS the commit ack — the source only
+        counts the epoch once this returns. Only newer epochs are
+        accepted so a delayed duplicate can't roll the replica back."""
+        xsm_check(subject, "job.replicate", saved.get("label", "user"))
+        cur = self.replicas.get(job)
+        if cur is not None:
+            # Overwriting an existing replica is an operation on THAT
+            # replica too: a subject allowed to replicate label "user"
+            # must not be able to replace a "tenantA" replica by
+            # shipping a crafted record with a label it controls.
+            xsm_check(subject, "job.replicate",
+                      cur["saved"].get("label", "user"))
+        if cur is not None and int(epoch) < cur["epoch"]:
+            return {"job": job, "epoch": cur["epoch"], "stale": True}
+        self.replicas[job] = {
+            "epoch": int(epoch),
+            "saved": saved,
+            "source": source,
+            "received_at": _time.time(),
+        }
+        return {"job": job, "epoch": int(epoch), "stale": False}
+
+    def op_get_replica(self, job: str,
+                       subject: str = "remote") -> dict | None:
+        r = self.replicas.get(job)
+        if r is not None:
+            # The record carries the job's full state (weights,
+            # counters, sched params) — guard the read like the save op
+            # guards the identical data.
+            xsm_check(subject, "job.replicate",
+                      r["saved"].get("label", "user"))
+        return r
+
+    def op_list_replicas(self, subject: str = "remote") -> list[dict]:
+        from pbs_tpu.runtime.xsm import get_policy
+
+        now = _time.time()
+        pol = get_policy()
+        return [
+            {"job": job, "epoch": r["epoch"], "source": r["source"],
+             "age_s": round(now - r["received_at"], 3)}
+            for job, r in sorted(self.replicas.items())
+            # metadata only, but existence still leaks: filter to what
+            # the subject could replicate
+            if pol.check(subject, "job.replicate",
+                         r["saved"].get("label", "user"))
+        ]
+
+    def op_drop_replica(self, job: str, subject: str = "remote") -> bool:
+        r = self.replicas.get(job)
+        if r is None:
+            return False
+        # Check BEFORE mutating: a denied request must not destroy what
+        # may be the only surviving copy of the job's state.
+        xsm_check(subject, "job.replicate",
+                  r["saved"].get("label", "user"))
+        del self.replicas[job]
+        return True
+
+    def op_replicate_start(self, job: str, peer_host: str, peer_port: int,
+                           period_s: float = 0.5,
+                           subject: str = "remote") -> dict:
+        """Start a replication session pumping ``job`` to a peer agent
+        (the remus daemon the reference runs in dom0 of the primary)."""
+        from pbs_tpu.dist.remus import RemusSession
+
+        j = self.partition.job(job)
+        xsm_check(subject, "job.replicate", j.label)
+        old = self.remus.pop(job, None)
+        if old is not None:
+            old.stop()
+        sess = RemusSession(
+            self, job, (peer_host, int(peer_port)),
+            period_s=float(period_s), subject=subject,
+            auth_token=self._auth_token,
+        )
+        # First epoch ships synchronously so "replication enabled"
+        # means "a committed replica exists", not "one is scheduled" —
+        # a crash in the first period would otherwise lose everything.
+        # NB: called under the dispatch lock, so ship directly (the
+        # session's locked tick path would deadlock here).
+        try:
+            # Resume numbering past any replica the peer already holds
+            # (a restarted session must not ship "epoch 0" into a
+            # backup at epoch N — the stale-reject would freeze the
+            # replica while the session reported healthy commits).
+            existing = sess.client.call("get_replica", job=job,
+                                        subject=subject)
+            if existing is not None:
+                sess.epochs_committed = int(existing["epoch"]) + 1
+            saved = self.snapshot_record(job)
+            sess.client.call("push_replica", job=job,
+                             epoch=sess.epochs_committed, saved=saved,
+                             source=self.name, subject=subject)
+        except BaseException:
+            sess.client.close()  # unreachable peer: no half-open session
+            raise
+        sess.epochs_committed += 1
+        self.remus[job] = sess.start()
+        return sess.status()
+
+    def op_replicate_stop(self, job: str, subject: str = "remote") -> bool:
+        sess = self.remus.get(job)
+        if sess is None:
+            return False
+        try:
+            label = self.partition.job(job).label
+        except Exception:  # job already gone; session is an orphan
+            label = "user"
+        xsm_check(subject, "job.replicate", label)
+        self.remus.pop(job).stop()
+        return True
+
+    def op_replicate_status(self, job: str | None = None,
+                            subject: str = "remote") -> list[dict]:
+        from pbs_tpu.runtime.xsm import get_policy
+
+        pol = get_policy()
+
+        def _visible(name: str) -> bool:
+            # Session status names jobs and peer topology — filter like
+            # op_list_replicas (same information, one op over).
+            try:
+                label = self.partition.job(name).label
+            except KeyError:
+                label = "user"
+            return pol.check(subject, "job.replicate", label)
+
+        if job is not None:
+            sess = self.remus.get(job)
+            return ([sess.status()] if sess is not None and _visible(job)
+                    else [])
+        return [s.status() for name, s in sorted(self.remus.items())
+                if _visible(name)]
+
     def op_run(self, max_rounds: int | None = None,
                for_us: int | None = None) -> int:
         until = None
@@ -318,9 +491,18 @@ class Agent:
     def address(self) -> tuple[str, int]:
         return self.server.address
 
+    @property
+    def dispatch_lock(self):
+        """The server's op-serializing lock; non-RPC entry points that
+        mutate the partition (RemusSession ticks) must hold it."""
+        return self.server._lock
+
     def start(self) -> "Agent":
         self.server.start()
         return self
 
     def stop(self) -> None:
+        for sess in list(self.remus.values()):
+            sess.stop()
+        self.remus.clear()
         self.server.stop()

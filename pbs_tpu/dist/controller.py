@@ -42,6 +42,7 @@ class AgentHandle:
     name: str
     client: RpcClient  # control ops (may be busy for a whole round)
     probe: RpcClient  # liveness pings only — never blocked behind ops
+    address: tuple[str, int] = ("", 0)
     alive: bool = True
     missed: int = 0
     info: dict = dataclasses.field(default_factory=dict)
@@ -62,6 +63,9 @@ class JobRecord:
     spec: dict
     members: list[MemberRef]
     gang: bool = False
+    # Remus: member job name -> backup agent name (replication enabled)
+    replica_peers: dict[str, str] = dataclasses.field(default_factory=dict)
+    replica_period_s: float = 0.5
 
 
 class Controller:
@@ -84,7 +88,8 @@ class Controller:
     def add_agent(self, name: str, address: tuple[str, int]) -> AgentHandle:
         h = AgentHandle(name, RpcClient(address, auth_token=self.auth_token),
                         probe=RpcClient(address, timeout_s=2.0,
-                                        auth_token=self.auth_token))
+                                        auth_token=self.auth_token),
+                        address=(address[0], int(address[1])))
         h.info = h.client.call("info")
         self.agents[name] = h
         return h
@@ -232,6 +237,8 @@ class Controller:
         return rec
 
     def remove_job(self, name: str) -> None:
+        if self.jobs.get(name) is not None and self.jobs[name].replica_peers:
+            self.disable_replication(name)  # stop pumps, drop replicas
         rec = self.jobs.pop(name)
         for m in rec.members:
             h = self.agents.get(m.agent)
@@ -314,7 +321,132 @@ class Controller:
                 pass  # reconcile fence removes the stale copy later
             m.agent = dst.name
             moved[m.job] = dst.name
+            # Replication does not survive the source teardown
+            # (remove_job stops the pump): drop the now-stale replica —
+            # a failover must never restore pre-migration state — and
+            # re-arm from the new home so protection continues.
+            self._drop_and_rearm(rec, m)
         return moved
+
+    def _drop_and_rearm(self, rec: JobRecord, m: MemberRef) -> None:
+        """After a member changed homes: retire the old (now stale)
+        replica and restart replication from the new home. Best-effort
+        on both legs; failure leaves the member VISIBLY unprotected
+        (absent from replica_peers, replicate_status == [])."""
+        old_peer = rec.replica_peers.pop(m.job, None)
+        if old_peer is None:
+            return
+        ph = self.agents.get(old_peer)
+        if ph is not None and ph.alive:
+            try:
+                ph.client.call("drop_replica", job=m.job,
+                               subject=self.subject)
+            except Exception:  # noqa: BLE001 — backup may be dead
+                pass
+        try:
+            self._replicate_member(rec, m, rec.replica_period_s)
+        except Exception:  # noqa: BLE001 — no eligible backup host
+            pass
+
+    # -- Remus replication (tools/remus: continuous backup) --------------
+
+    def enable_replication(self, name: str, period_s: float = 0.5,
+                           to: str | None = None) -> dict[str, str]:
+        """Continuously replicate each member of ``name`` to a backup
+        host (``to`` pins one; default: least-loaded live host that is
+        neither the member's home nor, for gangs, a sibling's home).
+        Returns {member job: backup agent}. The first epoch ships
+        synchronously, so on return every member has a committed
+        replica somewhere else."""
+        rec = self.jobs[name]
+        peers: dict[str, str] = {}
+        for m in rec.members:
+            peers[m.job] = self._replicate_member(rec, m, period_s, to)
+        rec.replica_period_s = period_s
+        return peers
+
+    def _replicate_member(self, rec: JobRecord, m: MemberRef,
+                          period_s: float, to: str | None = None) -> str:
+        src = self.agents[m.agent]
+        if to is not None:
+            dst = self.agents[to]
+            if dst.name == m.agent:
+                raise ValueError(
+                    f"backup host {to!r} is {m.job}'s own home")
+            if not dst.alive:
+                raise RuntimeError(f"backup agent {to!r} is dead")
+        else:
+            exclude = {m.agent}
+            if rec.gang:
+                # Anti-stacking extends to the backups: siblings' homes
+                # AND siblings' backup peers, else one double failure
+                # funnels two gang members onto the same host.
+                exclude |= {mm.agent for mm in rec.members}
+                exclude |= {p for j, p in rec.replica_peers.items()
+                            if j != m.job}
+            ranked = self._ranked_live(
+                [h for h in self.live_agents() if h.name not in exclude])
+            if not ranked:
+                raise RuntimeError(
+                    f"no live backup host for {rec.name}/{m.job}")
+            dst = ranked[0]
+        src.client.call(
+            "replicate_start", job=m.job, peer_host=dst.address[0],
+            peer_port=dst.address[1], period_s=period_s,
+            subject=self.subject)
+        rec.replica_peers[m.job] = dst.name
+        return dst.name
+
+    def disable_replication(self, name: str) -> None:
+        rec = self.jobs[name]
+        for m in rec.members:
+            h = self.agents.get(m.agent)
+            if h is not None and h.alive and m.job in rec.replica_peers:
+                try:
+                    h.client.call("replicate_stop", job=m.job,
+                                  subject=self.subject)
+                except Exception:  # noqa: BLE001 — source may be dead
+                    pass
+            peer = rec.replica_peers.pop(m.job, None)
+            ph = self.agents.get(peer) if peer else None
+            if ph is not None and ph.alive:
+                try:
+                    ph.client.call("drop_replica", job=m.job,
+                                   subject=self.subject)
+                except Exception:  # noqa: BLE001 — backup may be dead
+                    pass
+
+    def _find_replica(self, job: str, preferred: str | None
+                      ) -> tuple[AgentHandle, dict] | None:
+        """Newest committed replica of ``job`` on a live host. Queries
+        ride each handle's probe connection and fan out concurrently —
+        recovery must not queue behind one busy host's control
+        connection (the heartbeat/_load lesson: one wedged host adds
+        its timeout once, not serially). The recorded backup wins ties
+        so a split-brain pair of equal epochs restores predictably."""
+        candidates = self.live_agents()
+        found: dict[str, dict] = {}
+
+        def _ask(h: AgentHandle) -> None:
+            try:
+                r = h.probe.call("get_replica", job=job,
+                                 subject=self.subject)
+            except Exception:  # noqa: BLE001 — host may be dying
+                return
+            if r is not None:
+                found[h.name] = r
+
+        self._fanout(candidates, _ask)
+        best: tuple[AgentHandle, dict] | None = None
+        for h in candidates:
+            r = found.get(h.name)
+            if r is None:
+                continue
+            if (best is None or r["epoch"] > best[1]["epoch"]
+                    or (r["epoch"] == best[1]["epoch"]
+                        and h.name == preferred)):
+                best = (h, r)
+        return best
 
     # -- gang rounds (barrier-coordinated lockstep) ----------------------
 
@@ -362,10 +494,15 @@ class Controller:
 
     def recover(self) -> list[str]:
         """Re-place member jobs stranded on dead agents. Returns the
-        names of jobs that were moved. Sim/stateless members restart from
-        their spec; checkpointed workloads resume from their last epoch
-        (the workload factory reads the checkpoint — same contract as
-        ``xc_domain_restore``)."""
+        names of jobs that were moved.
+
+        Replicated members fail over to their newest committed replica
+        (``restore_job`` from the shipped record — steps, telemetry
+        counters, and sched params survive, the full Remus promise);
+        unreplicated members restart fresh from their spec, exactly
+        what an unprotected domain loses on host death. Where possible
+        replication is re-armed from the new home so the job isn't left
+        permanently unprotected after one failover."""
         moved = []
         for rec in self.jobs.values():
             for m in rec.members:
@@ -375,22 +512,57 @@ class Controller:
                 live = self.live_agents()
                 if not live:
                     raise RuntimeError(f"no live host for {rec.name}/{m.job}")
-                # Prefer a host with no sibling (anti-stacking); fall
-                # back to least-loaded when the cluster has shrunk below
-                # the gang width — same fallback as anti_stack_pick
-                # returning None (sched_credit_atc.c:545-570).
-                exclude = {mm.agent for mm in rec.members if mm is not m}
-                candidates = [a for a in live
-                              if not (rec.gang and a.name in exclude)]
-                ranked = self._ranked_live(candidates or live)
-                if not ranked:
-                    raise RuntimeError(f"no live host for {rec.name}/{m.job}")
-                target = ranked[0]
-                target.client.call("create_job", job=m.job,
-                                   workload=rec.workload, spec=rec.spec,
-                                   subject=self.subject)
+
+                replica = self._find_replica(
+                    m.job, rec.replica_peers.get(m.job))
+                if replica is not None:
+                    # Failover target = the host already holding the
+                    # state (restoring elsewhere would copy it twice) —
+                    # UNLESS gang anti-stacking forbids it: the saved
+                    # record is portable, so a sibling-occupied holder
+                    # ships it to a clean host instead of co-locating
+                    # gang members (the invariant create_job and the
+                    # from-spec branch both enforce).
+                    holder, r = replica
+                    target = holder
+                    if rec.gang:
+                        sibling_homes = {mm.agent for mm in rec.members
+                                         if mm is not m}
+                        if holder.name in sibling_homes:
+                            ranked = self._ranked_live(
+                                [a for a in live
+                                 if a.name not in sibling_homes])
+                            if not ranked:
+                                raise RuntimeError(
+                                    f"no anti-stacking host for "
+                                    f"{rec.name}/{m.job}")
+                            target = ranked[0]
+                    target.client.call(
+                        "restore_job", job=m.job, workload=rec.workload,
+                        spec=rec.spec, saved=r["saved"],
+                        subject=self.subject)
+                    holder.client.call("drop_replica", job=m.job,
+                                       subject=self.subject)
+                else:
+                    # Prefer a host with no sibling (anti-stacking); fall
+                    # back to least-loaded when the cluster has shrunk
+                    # below the gang width — same fallback as
+                    # anti_stack_pick returning None
+                    # (sched_credit_atc.c:545-570).
+                    exclude = {mm.agent for mm in rec.members if mm is not m}
+                    candidates = [a for a in live
+                                  if not (rec.gang and a.name in exclude)]
+                    ranked = self._ranked_live(candidates or live)
+                    if not ranked:
+                        raise RuntimeError(
+                            f"no live host for {rec.name}/{m.job}")
+                    target = ranked[0]
+                    target.client.call("create_job", job=m.job,
+                                       workload=rec.workload, spec=rec.spec,
+                                       subject=self.subject)
                 m.agent = target.name
                 moved.append(m.job)
+                self._drop_and_rearm(rec, m)
         return moved
 
     # -- observability ---------------------------------------------------

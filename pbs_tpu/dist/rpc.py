@@ -212,7 +212,11 @@ class RpcServer:
         return self
 
     def stop(self) -> None:
-        self._server.shutdown()
+        # shutdown() blocks on an acknowledgment from the serve_forever
+        # loop — which never comes if start() was never called (the
+        # stdlib primitive hangs forever). Only signal a loop that ran.
+        if self._thread is not None:
+            self._server.shutdown()
         self._server.server_close()
         # Handler threads outlive shutdown(); sever their connections so
         # a stopped host really goes silent (heartbeats must fail).
