@@ -1,3 +1,13 @@
 from pbs_tpu.ops.attention import flash_attention
+from pbs_tpu.ops.matmul import (
+    MatmulStats,
+    instrumented_matmul,
+    scale_stats,
+)
 
-__all__ = ["flash_attention"]
+__all__ = [
+    "MatmulStats",
+    "flash_attention",
+    "instrumented_matmul",
+    "scale_stats",
+]

@@ -1,0 +1,112 @@
+"""Instrumented Pallas matmul: correctness + on-device counters.
+
+Verdict #8 'done' bar: the kernel emits its own work counters (MXU
+tiles, HBM tile traffic, data-derived zero-tile events) and at least
+one telemetry test lands them in the ledger. The reference pattern
+being mirrored: the perfctr driver counts events in hardware and
+software scales them (``drivers/perfctr/x86.c:228-312``); here the
+Pallas kernel is the PMU for its own op.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pbs_tpu.ops.matmul import (
+    N_STATS,
+    STAT_A_ZERO_TILES,
+    STAT_MXU_TILES,
+    instrumented_matmul,
+    scale_stats,
+)
+from pbs_tpu.runtime.job import Job
+from pbs_tpu.runtime.partition import Partition
+from pbs_tpu.telemetry.counters import Counter
+from pbs_tpu.telemetry.source import TpuBackend
+
+
+def test_matmul_correct_vs_xla():
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (256, 512), jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(1), (512, 384), jnp.float32)
+    out, _ = instrumented_matmul(a, b, block_m=128, block_n=128, block_k=128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(a @ b),
+                               rtol=2e-5, atol=2e-4)
+
+
+def test_matmul_bf16_inputs_fp32_accum():
+    a = jnp.ones((128, 256), jnp.bfloat16) * 0.5
+    b = jnp.ones((256, 128), jnp.bfloat16) * 2.0
+    out, _ = instrumented_matmul(a, b, block_m=128, block_n=128, block_k=128)
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out), np.full((128, 128), 256.0),
+                               rtol=1e-6)
+
+
+def test_stats_count_tiles_and_traffic():
+    M, K, N, blk = 512, 768, 256, 128
+    a = jnp.ones((M, K), jnp.float32)
+    b = jnp.ones((K, N), jnp.float32)
+    _, raw = instrumented_matmul(a, b, block_m=blk, block_n=blk, block_k=blk)
+    assert raw.shape == (N_STATS,)
+    st = scale_stats(np.asarray(raw), blk, blk, blk)
+    grid = (M // blk) * (N // blk) * (K // blk)
+    assert st.mxu_tiles == grid
+    assert st.flops == grid * 2 * blk * blk * blk == 2 * M * N * K
+    # every grid cell reads one A tile and one B tile
+    assert st.hbm_read_bytes == grid * 2 * (blk * blk * 4)
+    # each (i, j) output block is written once (fp32 out)
+    assert st.hbm_write_bytes == (M // blk) * (N // blk) * (blk * blk * 4)
+    assert st.a_zero_tiles == 0
+
+
+def test_stats_observe_data_zero_tiles():
+    """The data-derived event: an all-zero A half means half the A
+    tiles report zero — the counter reflects what the data DID, not
+    just the schedule (a PMC, not a cost model)."""
+    M, K, N, blk = 256, 256, 256, 128
+    a = jnp.concatenate(
+        [jnp.zeros((128, K), jnp.float32), jnp.ones((128, K), jnp.float32)])
+    b = jnp.ones((K, N), jnp.float32)
+    _, raw = instrumented_matmul(a, b, block_m=blk, block_n=blk, block_k=blk)
+    raw = np.asarray(raw)
+    # A-tiles with i==0 (first row-block) are all-zero; they are visited
+    # once per (j, k) pair.
+    assert raw[STAT_A_ZERO_TILES] == (N // blk) * (K // blk)
+    assert raw[STAT_MXU_TILES] == (M // blk) * (N // blk) * (K // blk)
+
+
+def test_shape_validation():
+    a = jnp.ones((100, 128), jnp.float32)
+    b = jnp.ones((128, 128), jnp.float32)
+    try:
+        instrumented_matmul(a, b, block_m=64, block_n=64, block_k=64)
+        raised = False
+    except ValueError:
+        raised = True
+    assert raised
+
+
+def test_kernel_counters_land_in_ledger():
+    """A job built on the instrumented kernel feeds its measured tile
+    counters into DEVICE_FLOPS / HBM_BYTES — with no `compiled` handle,
+    cost analysis has nothing, so the nonzero ledger values can only
+    have come from the kernel's own counting."""
+    blk = 128
+    a = jnp.ones((256, 256), jnp.float32)
+
+    def step_fn(state):
+        out, raw = instrumented_matmul(state, a, block_m=blk, block_n=blk,
+                                       block_k=blk)
+        st = scale_stats(np.asarray(raw), blk, blk, blk)
+        return out / 256.0, st.metrics()
+
+    be = TpuBackend()
+    part = Partition("p", source=be)
+    job = part.add_job(Job("mm", step_fn=step_fn, state=a, max_steps=3))
+    part.run(max_rounds=10)
+    ctx = job.contexts[0]
+    per_step_flops = 2 * 256 * 256 * 256
+    assert int(ctx.counters[Counter.DEVICE_FLOPS]) == 3 * per_step_flops
+    assert int(ctx.counters[Counter.HBM_BYTES]) > 0
+    assert int(ctx.counters[Counter.STEPS_RETIRED]) == 3
