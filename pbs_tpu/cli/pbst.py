@@ -238,6 +238,17 @@ def cmd_lockprof(args) -> int:
     return 0
 
 
+def cmd_selftest(args) -> int:
+    """Perf canary of the telemetry hot paths (x86_tests.c analog):
+    order-of-magnitude regression gates on the per-quantum costs."""
+    from pbs_tpu.obs.selftest import run_selftest
+
+    results = run_selftest(n=args.n)
+    for r in results:
+        print(r.row())
+    return 0 if all(r.ok for r in results) else 1
+
+
 def cmd_params(args) -> int:
     """Effective boot-param registry (name=value per line)."""
     from pbs_tpu.utils import params as params_mod
@@ -445,6 +456,12 @@ def main(argv=None) -> int:
     sp = sub.add_parser("lockprof", help="lock contention (xenlockprof)")
     sp.add_argument("file", help="obs dump JSON (obs.dumpfile)")
     sp.set_defaults(fn=cmd_lockprof)
+
+    sp = sub.add_parser("selftest",
+                        help="hot-path perf canary (x86_tests.c)")
+    sp.add_argument("-n", type=int, default=2000,
+                    help="iterations per canary")
+    sp.set_defaults(fn=cmd_selftest)
 
     sp = sub.add_parser("params", help="boot-param registry dump")
     g = sp.add_mutually_exclusive_group()

@@ -1,0 +1,232 @@
+"""Credit2 deepening: per-runqueue credits, tickling, load balancing.
+
+Verdict #9 'done' bar: credit2 passes fairness-under-load tests
+DISTINGUISHABLE from credit1's behavior. The distinguishing mechanisms
+(re-derived from xen-4.2.1/xen/common/sched_credit2.c, not ported):
+per-runqueue isolation with balance-only migration (vs credit1's
+steal-anywhere), weight-relative burn via the runqueue max_weight (vs
+credit1's 30 ms redistribution tick), bounded-carryover reset, and
+wake tickling (boundary preemption in favor of a high-credit waker).
+"""
+
+from pbs_tpu.runtime import Job, Partition, SchedParams
+from pbs_tpu.runtime.job import ContextState
+from pbs_tpu.telemetry import Counter, SimBackend, SimProfile
+
+
+def setup(scheduler, jobs, step_time_us=100, n_executors=1, **sched_params):
+    be = SimBackend()
+    part = Partition("t", source=be, scheduler=scheduler,
+                     n_executors=n_executors, sched_params=sched_params)
+    out = {}
+    for name, params, max_steps in jobs:
+        be.register(name, SimProfile.steady(step_time_ns=step_time_us * 1000))
+        job = Job(name, params=params, max_steps=max_steps)
+        for c in job.contexts:
+            c.avg_step_ns = step_time_us * 1000.0
+        part.add_job(job)
+        out[name] = job
+    return part, be, out
+
+
+def dev_time(job):
+    return sum(int(c.counters[Counter.DEVICE_TIME_NS]) for c in job.contexts)
+
+
+def test_three_way_weight_fairness_under_load():
+    """1:2:4 weights on a contended runqueue -> proportional device
+    time, produced by burn-rate scaling alone (no accounting tick)."""
+    part, be, jobs = setup(
+        "credit2",
+        [("w1", SchedParams(weight=128), 1_000_000),
+         ("w2", SchedParams(weight=256), 1_000_000),
+         ("w4", SchedParams(weight=512), 1_000_000)],
+    )
+    part.run(until_ns=4_000_000_000)
+    t1, t2, t4 = (dev_time(jobs[n]) for n in ("w1", "w2", "w4"))
+    assert 1.5 < t2 / t1 < 2.6, (t1, t2, t4)
+    assert 1.5 < t4 / t2 < 2.6, (t1, t2, t4)
+    # resets happened (the credit2 mechanism, not credit1's tick)
+    st = part.scheduler.dump_settings()
+    assert sum(rq["resets"] for rq in st["runqueues"]) > 0
+
+
+def test_runqueue_locality_distinguishes_from_credit_steal():
+    """Balanced load, 4 executors in 2 runqueues: credit2 keeps every
+    context in its home runqueue (zero migrations — locality is a
+    first-class property); credit1 on the same workload steals across
+    executors freely. THE distinguishing observable."""
+    spec = [(f"j{i}", SchedParams(), 5_000) for i in range(2)]
+    part2, _, jobs2 = setup("credit2", spec, n_executors=4,
+                            executors_per_runq=2)
+    part2.run(until_ns=400_000_000)
+    st2 = part2.scheduler.dump_settings()
+    assert st2["migrations"] == 0
+    # every executor still worked: the runqueues self-served
+    assert all(ex.dispatch_count > 0 for ex in part2.executors)
+
+    part1, _, jobs1 = setup("credit", spec, n_executors=4)
+    part1.run(until_ns=400_000_000)
+    steals = sum(part1.scheduler._cc(j.contexts[0]).steals
+                 for j in jobs1.values())
+    # credit1's executors steal contexts across the whole partition on
+    # the same workload; credit2 moved nothing — the behaviors diverge
+    # on the same load, which is exactly the distinguishing property.
+    assert steals > 0, "credit1 should steal on this workload"
+
+
+def test_load_balancing_migrates_only_on_imbalance():
+    """3 contexts land in runqueue 0, none in runqueue 1: the EWMA
+    diverges and balance_load migrates work across — locality is given
+    up exactly when measured imbalance justifies it."""
+    be = SimBackend()
+    part = Partition("t", source=be, scheduler="credit2", n_executors=4,
+                     sched_params={"executors_per_runq": 2})
+    jobs = []
+    for i in range(3):
+        name = f"piled{i}"
+        be.register(name, SimProfile.steady(step_time_ns=100_000))
+        j = Job(name, max_steps=100_000)
+        j.contexts[0].avg_step_ns = 100_000.0
+        # Pin placement at wake time to executor 0 (runqueue 0) by
+        # hint, then clear the hint so balancing may move it.
+        j.contexts[0].executor_hint = 0
+        part.add_job(j)
+        j.contexts[0].executor_hint = None
+        jobs.append(j)
+    part.run(until_ns=500_000_000)
+    st = part.scheduler.dump_settings()
+    assert st["migrations"] > 0
+    # both runqueues ended up doing real work
+    by_rq = {0: 0, 1: 0}
+    for ex in part.executors:
+        rqi = part.scheduler._ex_to_rq[ex.index]
+        by_rq[rqi] += ex.dispatch_count
+    assert by_rq[0] > 0 and by_rq[1] > 0, by_rq
+
+
+def _wake_latency_scenario(scheduler: str):
+    """Resident churner + unboosted waker with superior standing.
+    Returns (sched_count of waker after ONE post-wake dispatch,
+    tickles or None)."""
+    be = SimBackend()
+    part = Partition("t", source=be, scheduler=scheduler)
+    be.register("churn", SimProfile.steady(step_time_ns=100_000))
+    be.register("sleeper", SimProfile.steady(step_time_ns=100_000))
+    churn = Job("churn", max_steps=100_000)
+    churn.contexts[0].avg_step_ns = 100_000.0
+    part.add_job(churn)
+    part.run(until_ns=50_000_000)  # resident burns standing
+
+    sleeper = Job("sleeper", max_steps=100_000,
+                  params=SchedParams(boost_on_wake=False))
+    sleeper.contexts[0].avg_step_ns = 100_000.0
+    part.add_job(sleeper)
+    part.sleep_job(sleeper)
+    part.run(max_rounds=1)  # churner keeps running; waker asleep
+    # Deterministic resident standing in both policies: "in good
+    # standing but below a fresh arrival" — credit2 expresses that as
+    # credit far under CREDIT_INIT; credit1 as positive credit at
+    # PRI_UNDER (its best non-boost class).
+    if scheduler == "credit2":
+        part.scheduler._cc(churn.contexts[0]).credit = 1_000.0
+    else:
+        from pbs_tpu.sched.credit import PRI_UNDER
+
+        cc = part.scheduler._cc(churn.contexts[0])
+        cc.credit = 300.0
+        cc.pri = PRI_UNDER
+    part.wake_job(sleeper)
+    part.run(max_rounds=1)  # exactly one post-wake dispatch round
+    waker_runs = int(sleeper.contexts[0].counters[Counter.SCHED_COUNT])
+    tickles = getattr(part.scheduler, "tickles", None)
+    return waker_runs, tickles
+
+
+def test_wake_preemption_distinguishes_from_credit1():
+    """The runq_tickle analog: an UNBOOSTED waker with superior credit
+    is served at the very next boundary under credit2 (credit order is
+    the urgency); under credit1 the same waker enters at UNDER tail
+    and waits behind the resident — same workload, opposite outcome."""
+    runs2, tickles2 = _wake_latency_scenario("credit2")
+    assert runs2 >= 1  # served immediately at the post-wake boundary
+    assert tickles2 >= 1  # and the would-be IPI was recorded
+
+    runs1, _ = _wake_latency_scenario("credit")
+    assert runs1 == 0  # credit1 made it wait a full rotation
+
+
+def test_reset_carryover_preserves_relative_spacing():
+    """After a reset, contexts keep bounded earned spacing (credit2's
+    reset is set-to-init + carryover, NOT credit1's refill-to-cap)."""
+    from pbs_tpu.sched.credit2 import CREDIT_INIT, Credit2Scheduler
+
+    part, be, jobs = setup(
+        "credit2",
+        [("rich", SchedParams(weight=512), 1_000_000),
+         ("poor", SchedParams(weight=128), 1_000_000)],
+    )
+    sched: Credit2Scheduler = part.scheduler
+    part.run(until_ns=2_000_000_000)
+    st = sched.dump_settings()
+    assert sum(rq["resets"] for rq in st["runqueues"]) > 0
+    # weight-relative burn: the heavy job's credit decays 4x slower, so
+    # across many resets it holds >= the light job's credit.
+    credit = {
+        name: sched._cc(jobs[name].contexts[0]).credit
+        for name in ("rich", "poor")
+    }
+    assert credit["rich"] >= credit["poor"] - CREDIT_INIT * 0.5, credit
+
+
+def test_reset_covers_sleeping_contexts():
+    """A context asleep through a reset must re-baseline with its peers
+    or it wakes a full CREDIT_INIT behind (review finding)."""
+    from pbs_tpu.sched.credit2 import CREDIT_INIT
+
+    part, be, jobs = setup(
+        "credit2",
+        [("runner", SchedParams(), 1_000_000),
+         ("napper", SchedParams(), 1_000_000)],
+    )
+    sched = part.scheduler
+    part.run(max_rounds=2)  # both have sched_priv + runq assignment
+    part.sleep_job(jobs["napper"])
+    napper_cc = sched._cc(jobs["napper"].contexts[0])
+    napper_cc.credit = 100.0  # nearly exhausted, then blocked
+    # drive the runner until its credit sinks and a reset fires
+    before = sched.dump_settings()["runqueues"][0]["resets"]
+    part.run(until_ns=part.clock.now_ns() + 2_000_000_000)
+    after = sched.dump_settings()["runqueues"][0]["resets"]
+    assert after > before
+    # the sleeper re-baselined too: it holds ~CREDIT_INIT+carry, not 100
+    assert napper_cc.credit >= CREDIT_INIT
+
+
+def test_pinned_context_never_balanced_away():
+    be = SimBackend()
+    part = Partition("t", source=be, scheduler="credit2", n_executors=4,
+                     sched_params={"executors_per_runq": 2})
+    for i in range(3):
+        name = f"pin{i}"
+        be.register(name, SimProfile.steady(step_time_ns=100_000))
+        j = Job(name, max_steps=100_000)
+        j.contexts[0].avg_step_ns = 100_000.0
+        j.contexts[0].executor_hint = 0  # hard affinity, stays pinned
+        part.add_job(j)
+    part.run(until_ns=300_000_000)
+    assert part.scheduler.dump_settings()["migrations"] == 0
+    # all dispatches happened inside runqueue 0
+    assert part.executors[2].dispatch_count == 0
+    assert part.executors[3].dispatch_count == 0
+
+
+def test_weight_change_updates_runqueue_max_weight():
+    part, be, jobs = setup(
+        "credit2", [("a", SchedParams(weight=256), 100_000)])
+    part.scheduler.adjust_job(jobs["a"], weight=1024)
+    st = part.scheduler.dump_settings()
+    assert st["runqueues"][0]["max_weight"] == 1024
+    part.scheduler.adjust_job(jobs["a"], weight=64)
+    st = part.scheduler.dump_settings()
+    assert st["runqueues"][0]["max_weight"] == 64

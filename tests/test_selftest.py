@@ -1,0 +1,54 @@
+"""Perf canary (x86_tests.c analog): the hot paths stay hot.
+
+Reference behavior matched: ``drivers/perfctr/x86_tests.c:1-333`` times
+the driver's own rdpmc/rdmsr paths at init so a cost regression is
+caught immediately. Here the canaries guard the per-quantum ledger
+write, the lock-free monitor read, and the trace emit."""
+
+from pbs_tpu.obs.selftest import (
+    DEFAULT_THRESHOLDS_NS,
+    CanaryResult,
+    run_selftest,
+    selftest_ok,
+)
+
+
+def test_canaries_pass_in_ci():
+    results = run_selftest(n=500)
+    names = {(r.name, r.variant) for r in results}
+    # python ledger paths always run; trace emit always runs
+    assert ("ledger_resume_suspend", "python") in names
+    assert ("ledger_snapshot", "python") in names
+    assert any(r.name == "trace_emit" for r in results)
+    for r in results:
+        assert r.ok, r.row()
+    assert selftest_ok(results)
+
+
+def test_native_variant_covered_when_runtime_present():
+    from pbs_tpu.runtime import native as native_mod
+
+    results = run_selftest(n=200)
+    if native_mod.load() is not None:
+        assert any(r.variant == "native" and r.name == "ledger_snapshot"
+                   for r in results)
+
+
+def test_canary_detects_regression():
+    """The gate actually gates: an impossible threshold fails."""
+    results = run_selftest(thresholds={"ledger_snapshot": 0.0001}, n=100)
+    snap = [r for r in results if r.name == "ledger_snapshot"]
+    assert snap and not all(r.ok for r in snap)
+    assert not selftest_ok(results)
+
+
+def test_cli_surface():
+    from pbs_tpu.cli.pbst import main
+
+    assert main(["selftest", "-n", "100"]) == 0
+
+
+def test_result_row_format():
+    r = CanaryResult("x", "python", 10, 5.0,
+                     DEFAULT_THRESHOLDS_NS["trace_emit"])
+    assert "ok" in r.row()
