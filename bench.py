@@ -42,7 +42,20 @@ SEQ = 1024
 ATTEMPT_TIMEOUT_S = float(os.environ.get("PBST_BENCH_TIMEOUT_S", "480"))
 
 
+def _mark(msg: str) -> None:
+    """Stage marker on stderr: when the worker hangs (the TPU plugin
+    blocks in C, uninterruptible), the supervisor reports the LAST
+    stage reached instead of a bare timeout (round-2 lesson: a wedged
+    chip hangs make_c_api_client before any Python error can fire)."""
+    sys.stderr.write(f"[bench +{time.perf_counter() - _T0:7.1f}s] {msg}\n")
+    sys.stderr.flush()
+
+
+_T0 = time.perf_counter()
+
+
 def main() -> None:
+    _mark("importing jax")
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -62,8 +75,11 @@ def main() -> None:
         # ignores JAX_PLATFORMS=cpu and can hang init (VERDICT round 1).
         jax.config.update("jax_platforms", "cpu")
     n_params = cfg.num_params()
+    _mark(f"backend init: {jax.devices()}")
     key = jax.random.PRNGKey(0)
     params = init_params(cfg, key)
+    jax.block_until_ready(params)
+    _mark(f"params initialized ({n_params / 1e6:.0f}M)")
     init_opt, train_step = make_train_step(cfg, learning_rate=3e-4)
     state = (params, jax.jit(init_opt)(params), 0)
 
@@ -82,10 +98,12 @@ def main() -> None:
         return st, losses[-1]
 
     chunk = jax.jit(run_chunk, donate_argnums=(0,))
+    _mark("compiling + warming up train chunk")
 
     for _ in range(WARMUP_CHUNKS):
         state, loss = chunk(state, tokens)
     float(loss)  # host fetch: hard sync
+    _mark("warmup done; timing")
 
     t0 = time.perf_counter()
     for _ in range(BENCH_CHUNKS):
@@ -126,35 +144,57 @@ def _supervise() -> None:
     """Run the benchmark in a child with a hard timeout; the parent has
     no JAX state so it can neither hang nor crash, and always emits the
     one JSON line (the child's on success, an error payload otherwise)."""
+    import tempfile
+
     last_err = "unknown"
     for attempt in range(2):
+        # Child stderr goes to a FILE, not a pipe: on a timeout the
+        # stage markers written so far survive, so the error says how
+        # far the worker got before the chip wedged (round-2 lesson).
+        with tempfile.NamedTemporaryFile(
+                mode="w+", suffix=".bench.log", delete=False) as errf:
+            errpath = errf.name
         try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--worker"],
-                stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE,
-                timeout=ATTEMPT_TIMEOUT_S,
-                cwd=os.path.dirname(os.path.abspath(__file__)),
-            )
-        except subprocess.TimeoutExpired:
-            last_err = (
-                f"timeout: no result within {ATTEMPT_TIMEOUT_S:.0f}s "
-                "(TPU backend hang — chip absent or held by another "
-                "process?)"
-            )
-            # No retry after a full-budget hang: a second 480 s attempt
-            # would overrun any plausible external kill budget and lose
-            # the JSON line entirely (the round-1 rc=124 outcome).
-            break
-        sys.stderr.write(proc.stderr.decode(errors="replace"))
+            with open(errpath, "r+") as ef:
+                try:
+                    proc = subprocess.run(
+                        [sys.executable, os.path.abspath(__file__),
+                         "--worker"],
+                        stdout=subprocess.PIPE,
+                        stderr=ef,
+                        timeout=ATTEMPT_TIMEOUT_S,
+                        cwd=os.path.dirname(os.path.abspath(__file__)),
+                    )
+                except subprocess.TimeoutExpired:
+                    ef.seek(0)
+                    marks = [ln.strip() for ln in ef.read().splitlines()
+                             if ln.startswith("[bench ")]
+                    stage = marks[-1] if marks else "<no stage reached>"
+                    last_err = (
+                        f"timeout after {ATTEMPT_TIMEOUT_S:.0f}s; last "
+                        f"stage: {stage} (TPU backend hang — chip absent "
+                        "or held by another process?)"
+                    )
+                    # No retry after a full-budget hang: a second 480 s
+                    # attempt would overrun any plausible external kill
+                    # budget and lose the JSON line entirely (the
+                    # round-1 rc=124 outcome).
+                    break
+                ef.seek(0)
+                err_text = ef.read()
+        finally:
+            try:
+                os.unlink(errpath)
+            except OSError:
+                pass
+        sys.stderr.write(err_text)
         out = proc.stdout.decode(errors="replace")
         lines = [ln for ln in out.splitlines() if ln.startswith("{")]
         if proc.returncode == 0 and lines:
             print(lines[-1])
             sys.stdout.flush()
             return
-        tail = (proc.stderr.decode(errors="replace").strip()
-                .splitlines() or ["<no stderr>"])[-1]
+        tail = (err_text.strip().splitlines() or ["<no stderr>"])[-1]
         last_err = f"worker rc={proc.returncode}: {tail}"
         if attempt == 0:
             time.sleep(10.0)
