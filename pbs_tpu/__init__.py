@@ -10,7 +10,8 @@ idiomatically for TPUs with JAX/XLA/Pallas/pjit:
   pages, ``linux-3.2.30/drivers/perfctr/x86.c:228-312``).
 - ``pbs_tpu.runtime``    — jobs (domain/vCPU analogs), executors
   (the ``schedule()`` softirq loop, ``xen/common/schedule.c:1082-1185``),
-  partitions (cpupools), event channels, the op dispatch surface.
+  partitions (cpupools), event channels, job images (pygrub analog),
+  lifecycle hooks (hotplug scripts), compile-cache admission.
 - ``pbs_tpu.sched``      — pluggable scheduler framework + policies:
   credit (``xen/common/sched_credit.c``), credit2, sedf, arinc653, and
   the PMU-feedback adaptive quantum policy (the research core).
@@ -19,10 +20,12 @@ idiomatically for TPUs with JAX/XLA/Pallas/pjit:
 - ``pbs_tpu.ops``        — Pallas TPU kernels (instrumented matmul,
   blockwise flash/ring attention).
 - ``pbs_tpu.models``     — flagship workloads (decoder transformer, MoE).
-- ``pbs_tpu.ckpt``       — checkpoint/resume + Remus-style continuous
-  replication (``tools/libxc/xc_domain_save.c``, ``tools/remus``).
-- ``pbs_tpu.obs``        — trace rings, software perf counters, monitors
-  (``xen/common/trace.c``, ``tools/xenmon``, ``tools/xenstat``).
+- ``pbs_tpu.ckpt``       — checkpoint/resume; with ``pbs_tpu.dist``,
+  Remus-style continuous replication to a backup host
+  (``tools/libxc/xc_domain_save.c``, ``tools/remus``).
+- ``pbs_tpu.obs``        — trace rings, software perf counters, monitors,
+  per-job consoles, hot-path perf canaries (``xen/common/trace.c``,
+  ``tools/xenmon``, ``tools/xenstat``, ``drivers/perfctr/x86_tests.c``).
 - ``pbs_tpu.store``      — hierarchical config/rendezvous store
   (xenstore analog).
 - ``pbs_tpu.cli``        — ``pbst`` management CLI (``xl`` analog).

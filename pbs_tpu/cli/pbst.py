@@ -238,6 +238,24 @@ def cmd_lockprof(args) -> int:
     return 0
 
 
+def cmd_lockdep(args) -> int:
+    """Lock-order report (the lockdep analog): established order graph
+    and any AB-BA violations from a published obs dump."""
+    from pbs_tpu.obs.dumpfile import read_obs_dump
+
+    snap = read_obs_dump(args.file).get("lockdep", {})
+    print(f"classes: {len(snap.get('classes', []))}  "
+          f"checked edges: {snap.get('checked_edges', 0)}  "
+          f"violations: {len(snap.get('violations', []))}")
+    for a, bs in snap.get("edges", {}).items():
+        print(f"  {a} -> {', '.join(bs)}")
+    for v in snap.get("violations", []):
+        print(f"VIOLATION: taking {v['taking']!r} while holding "
+              f"{v['holding']!r}; established "
+              f"{' -> '.join(v['established_order'])}")
+    return 1 if snap.get("violations") else 0
+
+
 def cmd_selftest(args) -> int:
     """Perf canary of the telemetry hot paths (x86_tests.c analog):
     order-of-magnitude regression gates on the per-quantum costs."""
@@ -330,6 +348,31 @@ def cmd_list(args) -> int:
               f"{r.get('steps', 0):>10} {r.get('weight', ''):>7} "
               f"{r.get('tslice_us', ''):>7}")
     cli.close()
+    return 0
+
+
+def cmd_console(args) -> int:
+    """xl console analog: stream a job's console ring from an agent."""
+    import time as _t
+
+    cli = _agent_client(args)
+    since = args.since
+    try:
+        while True:
+            r = cli.call("console", job=args.job, since=since,
+                         subject=args.subject)
+            if r.get("dropped"):
+                print(f"[... {r['dropped']} line(s) lost to the ring ...]")
+            for ln in r["lines"]:
+                print(f"[{ln['seq']:>6}] {ln['line']}")
+            since = r["next"]
+            if not args.follow:
+                break
+            _t.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        cli.close()
     return 0
 
 
@@ -457,6 +500,11 @@ def main(argv=None) -> int:
     sp.add_argument("file", help="obs dump JSON (obs.dumpfile)")
     sp.set_defaults(fn=cmd_lockprof)
 
+    sp = sub.add_parser("lockdep",
+                        help="lock-order violations (lockdep)")
+    sp.add_argument("file", help="obs dump artifact")
+    sp.set_defaults(fn=cmd_lockdep)
+
     sp = sub.add_parser("selftest",
                         help="hot-path perf canary (x86_tests.c)")
     sp.add_argument("-n", type=int, default=2000,
@@ -503,6 +551,15 @@ def main(argv=None) -> int:
     agent_args(sp)
     sp.add_argument("--rounds", type=int, default=100)
     sp.set_defaults(fn=cmd_run)
+
+    sp = sub.add_parser("console",
+                        help="stream a job's console (xl console)")
+    sp.add_argument("job")
+    agent_args(sp)
+    sp.add_argument("--since", type=int, default=0)
+    sp.add_argument("-f", "--follow", action="store_true")
+    sp.add_argument("--interval", type=float, default=0.5)
+    sp.set_defaults(fn=cmd_console)
 
     sp = sub.add_parser("migrate", help="migrate a job (xl migrate)")
     sp.add_argument("job")

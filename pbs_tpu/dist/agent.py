@@ -74,7 +74,14 @@ class Agent:
                 n_executors=n_executors,
             )
         self.partition = partition
-        self.workloads: dict[str, WorkloadFactory] = {"sim": sim_workload}
+        from pbs_tpu.runtime.image import image_workload
+
+        self.workloads: dict[str, WorkloadFactory] = {
+            "sim": sim_workload,
+            # pygrub analog: boot a job from an on-disk image directory
+            # (spec={"path": ...}) — `xl create <image>` over the wire.
+            "image": image_workload,
+        }
         self.workloads.update(workloads or {})
         self.server = RpcServer(host=host, port=port, auth_token=auth_token)
         self._auth_token = auth_token
@@ -87,7 +94,8 @@ class Agent:
                    "pause_job", "unpause_job", "run", "dump", "telemetry",
                    "list_jobs", "save_job", "restore_job", "push_replica",
                    "get_replica", "list_replicas", "drop_replica",
-                   "replicate_start", "replicate_stop", "replicate_status"):
+                   "replicate_start", "replicate_stop", "replicate_status",
+                   "console"):
             self.server.register(op, getattr(self, "op_" + op))
         # info answers without the dispatch lock: it only reads counts
         # (torn reads are fine for a placement heuristic) and the
@@ -218,7 +226,9 @@ class Agent:
         counters travel with the job."""
         j = self.partition.job(job)
         xsm_check(subject, "job.save", j.label)
-        self.partition.sleep_job(j)  # stop-and-copy quiesce
+        # stop-and-copy quiesce, not a lifecycle event (the job is
+        # about to continue elsewhere; destroy hooks fire at remove)
+        self.partition.sleep_job(j, notify=False)
         return self._save_record(j)
 
     def snapshot_record(self, job: str) -> dict:
@@ -230,10 +240,10 @@ class Agent:
         (RemusSession does); this is not itself an RPC op."""
         j = self.partition.job(job)
         was_paused = self._job_state(j) == "paused"
-        self.partition.sleep_job(j)
-        saved = self._save_record(j)
+        self.partition.sleep_job(j, notify=False)  # epoch quiesce is
+        saved = self._save_record(j)  # not a lifecycle event
         if not was_paused:
-            self.partition.wake_job(j)
+            self.partition.wake_job(j, notify=False)
         return saved
 
     def op_restore_job(self, job: str, workload: str | None = None,
@@ -470,6 +480,17 @@ class Agent:
             }
             for j in self.partition.jobs
         ]
+
+    def op_console(self, job: str, since: int = 0, max_lines: int = 256,
+                   subject: str = "remote") -> dict:
+        """Stream a job's console ring (xenconsoled relay role): the
+        reply carries lines from ``since`` plus the next cursor, so
+        ``pbst console -f`` polls without duplication."""
+        j = self.partition.job(job)
+        # Console content is the guest's own output: gate like the
+        # telemetry-grade save path.
+        xsm_check(subject, "job.console", j.label)
+        return {"job": j.name, **j.console.read(int(since), int(max_lines))}
 
     def op_telemetry(self, job: str) -> dict:
         j = self.partition.job(job)

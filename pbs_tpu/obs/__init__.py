@@ -1,10 +1,13 @@
+from pbs_tpu.obs.console import Console
 from pbs_tpu.obs.lockprof import ProfiledLock
 from pbs_tpu.obs.mon import Monitor, SchedHistory
 from pbs_tpu.obs.oprofile import ProfileSession, ProfilerBusy
 from pbs_tpu.obs.perfc import Perfc, perfc
+from pbs_tpu.obs.selftest import CanaryResult, run_selftest, selftest_ok
 from pbs_tpu.obs.trace import Ev, TraceBuffer, format_records
 
 __all__ = [
-    "Ev", "Monitor", "Perfc", "ProfileSession", "ProfilerBusy",
-    "ProfiledLock", "SchedHistory", "TraceBuffer", "format_records", "perfc",
+    "CanaryResult", "Console", "Ev", "Monitor", "Perfc", "ProfileSession",
+    "ProfilerBusy", "ProfiledLock", "SchedHistory", "TraceBuffer",
+    "format_records", "perfc", "run_selftest", "selftest_ok",
 ]

@@ -14,16 +14,18 @@ from __future__ import annotations
 import json
 import os
 
-from pbs_tpu.obs import lockprof
+from pbs_tpu.obs import lockdep, lockprof
 from pbs_tpu.obs.perfc import perfc
 from pbs_tpu.utils import params
 
 
 def write_obs_dump(path: str) -> dict:
-    """Snapshot perfc + lockprof + params to ``path`` (atomic rename)."""
+    """Snapshot perfc + lockprof + lockdep + params to ``path``
+    (atomic rename)."""
     snap = {
         "perfc": perfc.dump(),
         "lockprof": lockprof.dump(),
+        "lockdep": lockdep.dump(),
         "params": params.dump(),
     }
     tmp = path + ".tmp"

@@ -5,6 +5,8 @@ from pbs_tpu.runtime.compile_gate import (
 )
 from pbs_tpu.runtime.events import EventBus, EventChannel, Virq
 from pbs_tpu.runtime.executor import Executor, quantum_to_steps
+from pbs_tpu.runtime.hooks import HookError, HookRegistry
+from pbs_tpu.runtime.image import boot_job, image_workload, save_image
 from pbs_tpu.runtime.memory import (
     MemoryAccount,
     MemoryManager,
@@ -53,6 +55,8 @@ __all__ = [
     "GrantError",
     "GrantMapping",
     "GrantTable",
+    "HookError",
+    "HookRegistry",
     "LabelPolicy",
     "MemoryAccount",
     "MemoryManager",
@@ -67,8 +71,11 @@ __all__ = [
     "WallWatchdog",
     "Watchdog",
     "XsmDenied",
+    "boot_job",
     "device_memory_stats",
+    "image_workload",
     "install_crash_handler",
+    "save_image",
     "map_grant",
     "nbytes_of",
     "quantum_to_steps",

@@ -1,0 +1,203 @@
+"""Job images: boot a tenant from on-disk artifacts (the pygrub analog).
+
+Reference: pygrub (``tools/pygrub``) reads a guest's disk image, parses
+its bootloader config, extracts kernel+initrd, and hands them to the
+domain builder — ``xl create`` boots an image with no externally
+supplied kernel. The TPU-native analog makes a *job image directory*
+the workload's self-describing boot medium:
+
+    image.json  — the bootloader config: workload kind, model config,
+                  training hyperparameters, sched params, data spec
+    ckpt/       — optional checkpoint (the kernel/initrd: the state
+                  that actually boots); absent = cold boot from init
+
+``boot_job(path)`` parses the manifest, builds the model + compiled
+train step, restores the checkpoint when present, and returns a ready
+:class:`~pbs_tpu.runtime.job.Job`. ``image_workload`` exposes the same
+flow as an agent workload factory, so ``pbst create -w image`` boots a
+job from disk on any host — completing the xl-create-from-image story
+the round-1 parity table marked "no analog".
+
+``save_image`` is the other direction (the image builder): write the
+manifest + current state so a running job can be turned back into
+bootable media (and shipped, rsync'd, or placed under ``pbst migrate``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import TYPE_CHECKING, Any
+
+if TYPE_CHECKING:
+    from pbs_tpu.runtime.partition import Partition
+
+MANIFEST_NAME = "image.json"
+CKPT_DIR = "ckpt"
+
+_DTYPES = {"bfloat16": "bfloat16", "float32": "float32",
+           "float16": "float16"}
+
+
+def _resolve_dtype(name: str):
+    import jax.numpy as jnp
+
+    if name not in _DTYPES:
+        raise ValueError(f"unsupported dtype {name!r} in image manifest")
+    return getattr(jnp, name)
+
+
+def _dtype_name(dtype) -> str:
+    import numpy as np
+
+    return np.dtype(dtype).name
+
+
+def save_image(path: str, kind: str, config: dict, *, state=None,
+               sched: dict | None = None, train: dict | None = None,
+               data: dict | None = None,
+               metadata: dict | None = None) -> dict:
+    """Write a bootable job image. ``config`` holds the model-config
+    kwargs (dtype as a string); ``state`` (optional) checkpoints the
+    current (params, opt_state, step) so the boot is warm."""
+    from pbs_tpu.ckpt.checkpoint import save_checkpoint
+
+    os.makedirs(path, exist_ok=True)
+    manifest = {
+        "version": 1,
+        "kind": kind,
+        "config": config,
+        "sched": sched or {},
+        "train": {"learning_rate": 3e-4, "batch": 4, "seq": 256,
+                  "seed": 0, **(train or {})},
+        "data": data or {"kind": "synthetic"},
+        "metadata": metadata or {},
+        "has_ckpt": state is not None,
+    }
+    # Checkpoint FIRST, manifest last: the manifest rename is the
+    # commit point, so a crash mid-save can only leave an image that
+    # under-promises (stale manifest), never one that promises warm
+    # state it doesn't have.
+    if state is not None:
+        save_checkpoint(os.path.join(path, CKPT_DIR), state,
+                        metadata={"image": kind})
+    tmp = os.path.join(path, MANIFEST_NAME + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1)
+    os.replace(tmp, os.path.join(path, MANIFEST_NAME))
+    return manifest
+
+
+def read_manifest(path: str) -> dict:
+    with open(os.path.join(path, MANIFEST_NAME)) as f:
+        m = json.load(f)
+    if m.get("version") != 1:
+        raise ValueError(f"unsupported image version {m.get('version')!r}")
+    if m.get("kind") not in ("transformer", "moe"):
+        raise ValueError(f"unknown image kind {m.get('kind')!r}")
+    return m
+
+
+def _build(kind: str, config: dict, train: dict):
+    """(cfg, init_state_fn, step_fn_factory) for a manifest. The
+    returned step closes over a synthetic data stream keyed by the
+    step counter — images are self-contained boot media, so the
+    default data source cannot depend on external files."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg_kwargs = dict(config)
+    if "dtype" in cfg_kwargs:
+        cfg_kwargs["dtype"] = _resolve_dtype(cfg_kwargs["dtype"])
+    lr = float(train.get("learning_rate", 3e-4))
+    batch = int(train.get("batch", 4))
+    seq = int(train.get("seq", 256))
+    seed = int(train.get("seed", 0))
+
+    if kind == "transformer":
+        from pbs_tpu.models import (
+            TransformerConfig,
+            init_params,
+            make_train_step,
+        )
+
+        cfg = TransformerConfig(**cfg_kwargs)
+        init_opt, train_step = make_train_step(cfg, learning_rate=lr)
+
+        def init_state():
+            params = init_params(cfg, jax.random.PRNGKey(seed))
+            return (params, jax.jit(init_opt)(params), 0)
+
+    else:  # moe — validated by read_manifest
+        from pbs_tpu.models import (
+            MoEConfig,
+            init_moe_params,
+            make_moe_train_step,
+        )
+
+        cfg = MoEConfig(**cfg_kwargs)
+        init_opt, train_step = make_moe_train_step(cfg, learning_rate=lr)
+
+        def init_state():
+            params = init_moe_params(cfg, jax.random.PRNGKey(seed))
+            return (params, jax.jit(init_opt)(params), 0)
+
+    seq = min(seq, cfg.max_seq)
+
+    def step_fn(state):
+        step = int(state[2])
+        tokens = jax.random.randint(
+            jax.random.fold_in(jax.random.PRNGKey(seed + 1), step),
+            (batch, seq), 0, cfg.vocab, jnp.int32)
+        return train_step(state, tokens)
+
+    return cfg, init_state, step_fn
+
+
+def boot_job(path: str, name: str | None = None,
+             max_steps: int | None = None):
+    """Boot a Job from an image directory (cold from init, warm from
+    the bundled checkpoint). The job is NOT yet admitted — hand it to
+    ``Partition.add_job`` (or use ``image_workload`` via an agent)."""
+    from pbs_tpu.ckpt.checkpoint import checkpoint_exists, restore_checkpoint
+    from pbs_tpu.runtime.job import Job, SchedParams
+
+    m = read_manifest(path)
+    cfg, init_state, step_fn = _build(m["kind"], m["config"], m["train"])
+    state = init_state()
+    ckpt = os.path.join(path, CKPT_DIR)
+    if m.get("has_ckpt"):
+        if not checkpoint_exists(ckpt):
+            # Never silently cold-boot a warm image: restarting from
+            # step 0 under the same name would discard all progress
+            # without a trace (truncated copy / partial rsync).
+            raise FileNotFoundError(
+                f"image {path!r} promises a checkpoint (has_ckpt) but "
+                f"{ckpt!r} has no manifest — refusing to cold-boot")
+        state, _ = restore_checkpoint(ckpt, like=state)
+    return Job(
+        name or m["metadata"].get("name", os.path.basename(path.rstrip("/"))),
+        step_fn=step_fn,
+        state=state,
+        params=SchedParams(**m.get("sched", {})),
+        max_steps=max_steps if max_steps is not None
+        else m["train"].get("max_steps"),
+        label=str(m["metadata"].get("label", "user")),
+    )
+
+
+def image_workload(partition: "Partition", job_name: str,
+                   spec: dict) -> Any:
+    """Agent workload factory: ``spec={"path": <image dir>, ...}`` —
+    the ``xl create <image>`` flow over the control plane. Extra spec
+    keys override the manifest (sched, max_steps)."""
+    path = spec.get("path")
+    if not path:
+        raise ValueError("image workload needs spec['path']")
+    job = boot_job(path, name=job_name, max_steps=spec.get("max_steps"))
+    for k, v in (spec.get("sched") or {}).items():
+        setattr(job.params, k, v)
+    if "label" in spec:
+        job.label = str(spec["label"])
+    return partition.add_job(job)

@@ -138,6 +138,15 @@ class Job:
         self.error: str | None = None
         # Scheduler-private per-job state hangs here (sched "domdata").
         self.sched_priv: Any = None
+        # Per-job console ring (the xl console analog): lifecycle
+        # events land here; the workload writes via Job.log.
+        from pbs_tpu.obs.console import Console
+
+        self.console = Console()
+
+    def log(self, line: str) -> int:
+        """Workload-side console write (the guest printk)."""
+        return self.console.write(line)
 
     # -- contention hints (batched vcrd_op) ------------------------------
 

@@ -83,6 +83,11 @@ class Partition:
         # TPU-new scarce resource SURVEY.md §7 flags — distinct programs
         # per partition and cumulative compile time.
         self.compile_admission = compile_admission
+        # Lifecycle hook scripts (the /etc/xen/scripts hotplug analog,
+        # runtime.hooks): required job-add hooks gate admission.
+        from pbs_tpu.runtime.hooks import HookRegistry
+
+        self.hooks = HookRegistry()
         self._free_slots = list(range(ledger_slots - 1, -1, -1))
         self.jobs: list[Job] = []
         # Monotone quantum counter; WallWatchdog reads it out-of-band.
@@ -170,6 +175,15 @@ class Partition:
                 if ctx.state is ContextState.RUNNABLE:
                     self.scheduler.wake(ctx)
             self._publish_meta()
+            # Hotplug: a REQUIRED job-add hook failing aborts the whole
+            # admission (the vif-attach-fails semantics) via the unwind
+            # below; optional failures are contained inside fire().
+            self.hooks.fire("job-add", self._hook_env(job),
+                            console=job.console)
+            job.console.write(
+                f"admitted to {self.name} "
+                f"({len(job.contexts)} ctx, scheduler "
+                f"{self.scheduler.name})")
         except Exception:
             if enrolled:
                 try:
@@ -186,6 +200,13 @@ class Partition:
                 self.memory.close_account(job.name)
             if self.compile_admission is not None:
                 self.compile_admission.release(job.name)
+            try:
+                # A required-hook failure lands AFTER the sidecar was
+                # published: republish so monitors never attribute the
+                # freed slots to a job that was never admitted.
+                self._publish_meta()
+            except Exception:  # noqa: BLE001 — unwind must complete
+                pass
             raise
         return job
 
@@ -200,8 +221,26 @@ class Partition:
         job = Job(name, step_fn=step_fn, state=state, params=params, **kw)
         return self.add_job(job)
 
+    def _hook_env(self, job: Job, **extra: str) -> dict[str, str]:
+        return {
+            "PBST_JOB": job.name,
+            "PBST_PARTITION": self.name,
+            "PBST_LABEL": job.label,
+            **extra,
+        }
+
     def remove_job(self, job: Job, subject: str = xsm.SYSTEM) -> None:
         xsm.xsm_check(subject, "job.destroy", job.label)
+        from pbs_tpu.runtime.hooks import HookError
+
+        try:
+            # Teardown hooks run while the job still exists (the detach
+            # script sees the device); failure cannot block destruction.
+            self.hooks.fire("job-remove", self._hook_env(job),
+                            console=job.console)
+        except HookError:
+            pass
+        job.console.write("destroyed")
         if self.memory is not None:
             self.memory.close_account(job.name)
         if self.compile_admission is not None:
@@ -225,17 +264,41 @@ class Partition:
 
     # -- run-state control (vcpu_sleep/wake, schedule.c) -----------------
 
-    def sleep_job(self, job: Job) -> None:
+    def sleep_job(self, job: Job, notify: bool = True) -> None:
+        """``notify=False`` is the internal-quiesce form (Remus epoch
+        capture, migration save): a sub-second suspend/resume cycle is
+        not a lifecycle event, and hotplug scripts must not run inside
+        it (Xen likewise never runs scripts on Remus epochs)."""
+        from pbs_tpu.runtime.hooks import HookError
+
+        changed = False
         for ctx in job.contexts:
             if ctx.runnable():
                 ctx.state = ContextState.BLOCKED
                 self.scheduler.sleep(ctx)
+                changed = True
+        if changed and notify:
+            try:
+                self.hooks.fire("job-sleep", self._hook_env(job),
+                                console=job.console)
+            except HookError:
+                pass  # run-state changes cannot be vetoed
 
-    def wake_job(self, job: Job) -> None:
+    def wake_job(self, job: Job, notify: bool = True) -> None:
+        from pbs_tpu.runtime.hooks import HookError
+
+        changed = False
         for ctx in job.contexts:
             if ctx.state is ContextState.BLOCKED:
                 ctx.state = ContextState.RUNNABLE
                 self.scheduler.wake(ctx)
+                changed = True
+        if changed and notify:
+            try:
+                self.hooks.fire("job-wake", self._hook_env(job),
+                                console=job.console)
+            except HookError:
+                pass
 
     def fail_job(self, job: Job, exc: BaseException,
                  ctx: "ExecutionContext | None" = None,
@@ -246,7 +309,17 @@ class Partition:
         ``ctx``/``lane`` identify the faulting context and executor so
         the postmortem trace names the right victim."""
         job.error = f"{type(exc).__name__}: {exc}"
+        job.console.write(f"FAULT contained: {job.error}")
         self.sampler.disarm_job(job)
+        from pbs_tpu.runtime.hooks import HookError
+
+        try:
+            self.hooks.fire(
+                "job-fail",
+                self._hook_env(job, PBST_ERROR=job.error),
+                console=job.console)
+        except HookError:
+            pass  # containment must complete regardless
         for c in job.contexts:
             if c.state is not ContextState.FAILED:
                 c.state = ContextState.FAILED

@@ -17,6 +17,16 @@ native C++ writer/reader (``native/pbst_runtime.cc``) and cross-process
 mappings (``multiprocessing.shared_memory``) interoperate with this pure
 Python implementation byte-for-byte.
 
+**Writer concurrency contract**: the native path uses real atomics with
+release/acquire ordering and is safe for cross-process writing. The pure
+Python fallback's ``_begin``/``_end`` are plain numpy read-modify-writes
+with no fences — safe for the in-process single-writer case (executors
+serialize under the partition/dispatch model, and in-process readers are
+GIL-ordered), but a CROSS-PROCESS writer must use the native path
+(``native=True``); byte compatibility makes the layouts interchangeable,
+not the write paths. Readers are always safe either way — the retry loop
+tolerates torn reads by construction.
+
 Slot layout (all u64, SLOT_WORDS words per execution-context slot):
 
     [0]      version    — seqlock: odd while a write is in progress

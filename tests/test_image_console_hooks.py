@@ -1,0 +1,319 @@
+"""Job images (pygrub), consoles (xl console), lifecycle hooks
+(hotplug scripts) — the three §2d rows round 1 marked "no analog".
+
+Reference behaviors matched: pygrub boots a guest from its own disk
+image (``tools/pygrub``); every domain's console ring is relayed by
+xenconsoled and streamed by ``xl console``; domain lifecycle runs
+``/etc/xen/scripts/*`` with the device environment, and a script
+failure fails the attach."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pbs_tpu.obs.console import Console
+from pbs_tpu.runtime import (
+    HookError,
+    Job,
+    Partition,
+    boot_job,
+    save_image,
+)
+from pbs_tpu.runtime.hooks import HookRegistry
+from pbs_tpu.telemetry import Counter, SimBackend, SimProfile
+from pbs_tpu.telemetry.source import TpuBackend
+
+TINY = dict(vocab=64, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2,
+            d_ff=64, max_seq=64, dtype="float32")
+
+
+# -- images (pygrub) --------------------------------------------------------
+
+
+def test_cold_boot_image_runs(tmp_path):
+    path = str(tmp_path / "img")
+    save_image(path, "transformer", TINY,
+               sched={"weight": 320},
+               train={"batch": 2, "seq": 32, "max_steps": 2})
+    job = boot_job(path)
+    assert job.params.weight == 320
+    part = Partition("p", source=TpuBackend())
+    part.add_job(job)
+    part.run(max_rounds=10)
+    assert job.steps_retired() == 2
+    assert job.error is None
+
+
+def test_warm_boot_restores_checkpoint(tmp_path):
+    """The ckpt/ directory is the kernel/initrd: a warm boot resumes
+    the saved params/opt/step instead of reinitializing."""
+    path = str(tmp_path / "img")
+    save_image(path, "transformer", TINY, train={"batch": 2, "seq": 32})
+    job = boot_job(path, max_steps=3)
+    part = Partition("p", source=TpuBackend())
+    part.add_job(job)
+    part.run(max_rounds=10)
+    assert job.state[2] == 3  # step counter advanced
+
+    # re-image WITH state, boot elsewhere, state carries over
+    save_image(path, "transformer", TINY, state=job.state,
+               train={"batch": 2, "seq": 32})
+    job2 = boot_job(path, name="warm", max_steps=5)
+    assert int(job2.state[2]) == 3
+    p0 = jax.tree.leaves(job.state[0])[0]
+    p2 = jax.tree.leaves(job2.state[0])[0]
+    np.testing.assert_array_equal(np.asarray(p0), np.asarray(p2))
+
+
+def test_moe_image_kind(tmp_path):
+    path = str(tmp_path / "img")
+    save_image(path, "moe", {**TINY, "n_experts": 4, "top_k": 2},
+               train={"batch": 2, "seq": 32, "max_steps": 1})
+    job = boot_job(path)
+    part = Partition("p", source=TpuBackend())
+    part.add_job(job)
+    part.run(max_rounds=5)
+    assert job.steps_retired() == 1 and job.error is None
+
+
+def test_warm_image_with_missing_checkpoint_refuses_cold_boot(tmp_path):
+    """A manifest promising warm state with no checkpoint behind it
+    must fail loudly, not silently restart from step 0 (review
+    finding)."""
+    from pbs_tpu.ckpt.checkpoint import remove_checkpoint
+
+    path = str(tmp_path / "img")
+    save_image(path, "transformer", TINY, train={"batch": 2, "seq": 32})
+    job = boot_job(path, max_steps=1)
+    save_image(path, "transformer", TINY, state=job.state,
+               train={"batch": 2, "seq": 32})
+    remove_checkpoint(os.path.join(path, "ckpt"))  # partial-rsync case
+    with pytest.raises(FileNotFoundError, match="refusing to cold-boot"):
+        boot_job(path)
+
+
+def test_remus_quiesce_does_not_fire_lifecycle_hooks():
+    """Epoch capture (sleep -> record -> wake with notify=False) is not
+    a lifecycle event: sub-second Remus cycles must not run hotplug
+    scripts or spam the console (review finding)."""
+    from pbs_tpu.dist import Agent
+
+    events = []
+    a = Agent("qhost", n_executors=1)
+    try:
+        a.partition.hooks.on(
+            "job-sleep", lambda ev, env: events.append(ev))
+        a.partition.hooks.on(
+            "job-wake", lambda ev, env: events.append(ev))
+        a.op_create_job("q", spec={"step_time_ns": 1_000_000,
+                                   "max_steps": 100})
+        for _ in range(5):
+            a.snapshot_record("q")  # the Remus epoch path
+        assert events == []  # quiesce invisible to hooks
+        # but a real pause IS a lifecycle event
+        a.op_pause_job("q")
+        assert events == ["job-sleep"]
+    finally:
+        a.stop()
+
+
+def test_hook_failure_after_publish_republishes_meta(tmp_path):
+    """The meta sidecar must not advertise a job whose admission was
+    vetoed by a required hook (review finding)."""
+    import json
+
+    ledger_path = str(tmp_path / "led")
+    be = SimBackend()
+    be.register("veto", SimProfile.steady(step_time_ns=1_000_000))
+    part = Partition("p", source=be, ledger_path=ledger_path)
+    part.hooks.on("job-add",
+                  lambda ev, env: (_ for _ in ()).throw(
+                      RuntimeError("denied")),
+                  required=True)
+    with pytest.raises(HookError):
+        part.add_job(Job("veto", max_steps=10))
+    with open(ledger_path + ".meta.json") as f:
+        meta = json.load(f)
+    assert meta["slots"] == {}  # freed slots not attributed to anyone
+
+
+def test_bad_manifest_rejected(tmp_path):
+    path = str(tmp_path / "img")
+    save_image(path, "transformer", TINY)
+    import json
+
+    with open(os.path.join(path, "image.json")) as f:
+        m = json.load(f)
+    m["kind"] = "diffusion"
+    with open(os.path.join(path, "image.json"), "w") as f:
+        json.dump(m, f)
+    with pytest.raises(ValueError, match="unknown image kind"):
+        boot_job(path)
+
+
+def test_image_workload_over_control_plane(tmp_path):
+    """xl create <image> over the wire: agent boots from disk."""
+    from pbs_tpu.dist import Agent, RpcClient
+
+    path = str(tmp_path / "img")
+    save_image(path, "transformer", TINY,
+               train={"batch": 2, "seq": 32, "max_steps": 1})
+    a = Agent("imghost", partition=Partition("p", source=TpuBackend()),
+              n_executors=1).start()
+    try:
+        cli = RpcClient(a.address)
+        r = cli.call("create_job", job="booted", workload="image",
+                     spec={"path": path, "sched": {"weight": 777}})
+        assert r["job"] == "booted"
+        cli.call("run", max_rounds=5)
+        rows = cli.call("list_jobs")
+        assert rows[0]["steps"] == 1 and rows[0]["weight"] == 777
+        cli.close()
+    finally:
+        a.stop()
+
+
+# -- consoles (xl console) --------------------------------------------------
+
+
+def test_console_ring_and_cursors():
+    c = Console(capacity=4)
+    for i in range(6):
+        c.write(f"line{i}")
+    r = c.read(since=0)
+    # ring of 4: lines 0-1 lost, visible loss reported
+    assert r["dropped"] == 2
+    assert [ln["line"] for ln in r["lines"]] == [
+        "line2", "line3", "line4", "line5"]
+    assert c.read(since=r["next"])["lines"] == []
+
+
+def test_job_lifecycle_lands_in_console():
+    be = SimBackend()
+    be.register("j", SimProfile.steady(step_time_ns=1_000_000))
+    part = Partition("p", source=be)
+    job = part.add_job(Job("j", max_steps=2))
+    job.log("hello from the guest")
+    part.run(max_rounds=5)
+    lines = [ln["line"] for ln in job.console.read()["lines"]]
+    assert any("admitted to p" in ln for ln in lines)
+    assert "hello from the guest" in lines
+
+
+def test_fault_containment_writes_console():
+    be = TpuBackend()
+    part = Partition("p", source=be)
+
+    def bad(state):
+        raise RuntimeError("device on fire")
+
+    job = part.add_job(Job("burny", step_fn=bad, state=0, max_steps=5))
+    part.run(max_rounds=3)
+    lines = [ln["line"] for ln in job.console.read()["lines"]]
+    assert any("FAULT contained" in ln and "device on fire" in ln
+               for ln in lines)
+
+
+def test_console_streamed_over_control_plane():
+    from pbs_tpu.dist import Agent, RpcClient
+
+    a = Agent("chost", n_executors=1).start()
+    try:
+        cli = RpcClient(a.address)
+        cli.call("create_job", job="talky",
+                 spec={"step_time_ns": 1_000_000, "max_steps": 3})
+        cli.call("run", max_rounds=5)
+        r = cli.call("console", job="talky", subject="remote")
+        lines = [ln["line"] for ln in r["lines"]]
+        assert any("admitted" in ln for ln in lines)
+        # cursor resumes without duplication
+        r2 = cli.call("console", job="talky", since=r["next"])
+        assert r2["lines"] == []
+        cli.close()
+    finally:
+        a.stop()
+
+
+# -- lifecycle hooks (hotplug scripts) --------------------------------------
+
+
+def test_hooks_fire_with_env():
+    seen = []
+    be = SimBackend()
+    be.register("j", SimProfile.steady(step_time_ns=1_000_000))
+    part = Partition("p", source=be)
+    part.hooks.on("job-add", lambda ev, env: seen.append((ev, env)))
+    part.hooks.on("job-sleep", lambda ev, env: seen.append((ev, env)))
+    part.hooks.on("job-wake", lambda ev, env: seen.append((ev, env)))
+    part.hooks.on("job-remove", lambda ev, env: seen.append((ev, env)))
+    job = part.add_job(Job("j", max_steps=10))
+    part.sleep_job(job)
+    part.wake_job(job)
+    part.remove_job(job)
+    events = [ev for ev, _ in seen]
+    assert events == ["job-add", "job-sleep", "job-wake", "job-remove"]
+    assert all(env["PBST_JOB"] == "j" and env["PBST_PARTITION"] == "p"
+               for _, env in seen)
+
+
+def test_required_add_hook_failure_aborts_admission():
+    """The vif-attach-fails semantics: admission unwinds completely."""
+    be = SimBackend()
+    be.register("j", SimProfile.steady(step_time_ns=1_000_000))
+    part = Partition("p", source=be)
+    part.hooks.on("job-add",
+                  lambda ev, env: (_ for _ in ()).throw(
+                      RuntimeError("no dataset mount")),
+                  required=True)
+    with pytest.raises(HookError, match="no dataset mount"):
+        part.add_job(Job("j", max_steps=10))
+    assert part.jobs == []  # fully unwound; name retryable
+    part.hooks._hooks["job-add"].clear()
+    part.add_job(Job("j", max_steps=10))
+
+
+def test_optional_hook_failure_contained_and_logged():
+    be = SimBackend()
+    be.register("j", SimProfile.steady(step_time_ns=1_000_000))
+    part = Partition("p", source=be)
+    part.hooks.on("job-add",
+                  lambda ev, env: (_ for _ in ()).throw(
+                      RuntimeError("tracker down")))
+    job = part.add_job(Job("j", max_steps=10))  # admission survives
+    assert part.hooks.failures == 1
+    lines = [ln["line"] for ln in job.console.read()["lines"]]
+    assert any("tracker down" in ln for ln in lines)
+
+
+def test_shell_hook_runs_with_env(tmp_path):
+    out = tmp_path / "hookout"
+    reg = HookRegistry()
+    reg.on("job-fail", f'echo "$PBST_JOB:$PBST_ERROR" > {out}')
+    be = TpuBackend()
+    part = Partition("p", source=be)
+    part.hooks = reg
+
+    def bad(state):
+        raise ValueError("boom")
+
+    part.add_job(Job("crashy", step_fn=bad, state=0, max_steps=5))
+    part.run(max_rounds=3)
+    assert "crashy:ValueError: boom" in out.read_text()
+
+
+def test_fail_hook_fires_on_containment():
+    failures = []
+    be = TpuBackend()
+    part = Partition("p", source=be)
+    part.hooks.on("job-fail",
+                  lambda ev, env: failures.append(env["PBST_ERROR"]))
+
+    def bad(state):
+        raise RuntimeError("cosmic ray")
+
+    part.add_job(Job("unlucky", step_fn=bad, state=0, max_steps=5))
+    part.run(max_rounds=3)
+    assert failures and "cosmic ray" in failures[0]
