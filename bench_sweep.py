@@ -1,0 +1,120 @@
+"""Flagship throughput sweep: justify the benchmarked configuration.
+
+Runs the flagship decoder across remat policy x batch x attention
+implementation in ONE process (the chip tolerates exactly one client —
+never run this concurrently with bench.py), timing a short on-device
+`lax.scan` training chunk per point. Output: one JSON line per point
+plus a final `best` line; paste the table into docs/PERF.md.
+
+Usage:
+    python bench_sweep.py                 # full grid on the real TPU
+    PBST_SWEEP_TINY=1 python bench_sweep.py   # smoke the harness on CPU
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+import sys
+import time
+
+PEAK_FLOPS = 197e12  # bf16, TPU v5e
+
+REMAT = [("none", False, "full"), ("dots", True, "dots"),
+         ("full", True, "full")]
+BATCHES = [4, 6, 8]
+ATTN = ["xla", "pallas"]
+SEQ = 1024
+STEPS = 8  # per timed chunk (one dispatch)
+
+
+def run_point(cfg_base, remat_name, remat, policy, batch, attn,
+              warm_chunks=1, timed_chunks=2):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from pbs_tpu.models import init_params, make_train_step
+
+    cfg = dataclasses.replace(cfg_base, remat=remat, remat_policy=policy,
+                              attn_impl=attn)
+    n_params = cfg.num_params()
+    key = jax.random.PRNGKey(0)
+    params = init_params(cfg, key)
+    init_opt, train_step = make_train_step(cfg, learning_rate=3e-4)
+    state = (params, jax.jit(init_opt)(params), 0)
+    tokens = jax.random.randint(key, (batch, SEQ), 0, cfg.vocab, jnp.int32)
+
+    def chunk_fn(st, toks):
+        def body(carry, _):
+            carry, m = train_step(carry, toks)
+            return carry, m["loss"]
+
+        st, losses = lax.scan(body, st, None, length=STEPS)
+        return st, losses[-1]
+
+    chunk = jax.jit(chunk_fn, donate_argnums=(0,))
+    t_c0 = time.perf_counter()
+    for _ in range(warm_chunks):
+        state, loss = chunk(state, tokens)
+    float(loss)
+    compile_s = time.perf_counter() - t_c0
+
+    t0 = time.perf_counter()
+    for _ in range(timed_chunks):
+        state, loss = chunk(state, tokens)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+
+    n_steps = timed_chunks * STEPS
+    toks_per_s = batch * (SEQ - 1) * n_steps / dt
+    mfu = toks_per_s * 6 * n_params / PEAK_FLOPS
+    return {
+        "remat": remat_name,
+        "batch": batch,
+        "attn": attn,
+        "tokens_per_s": round(toks_per_s, 1),
+        "mfu": round(mfu, 4),
+        "step_ms": round(1e3 * dt / n_steps, 1),
+        "compile_s": round(compile_s, 1),
+        "loss": round(final_loss, 3),
+        "n_params": n_params,
+    }
+
+
+def main() -> int:
+    tiny = os.environ.get("PBST_SWEEP_TINY", "").lower() in ("1", "true")
+    if tiny:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from __graft_entry__ import _flagship_cfg
+
+    cfg_base = _flagship_cfg(tiny=tiny)
+    global SEQ, STEPS, BATCHES
+    if tiny:
+        SEQ, STEPS, BATCHES = 128, 2, [2]
+
+    results = []
+    grid = list(itertools.product(REMAT, BATCHES, ATTN))
+    for (rname, remat, policy), batch, attn in grid:
+        if attn == "pallas" and tiny:
+            continue  # interpreter-mode pallas is too slow to smoke
+        try:
+            r = run_point(cfg_base, rname, remat, policy, batch, attn)
+        except Exception as e:  # noqa: BLE001 — a failing point (OOM,
+            r = {"remat": rname, "batch": batch, "attn": attn,  # eg)
+                 "error": f"{type(e).__name__}: {str(e)[:120]}"}
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    ok = [r for r in results if "error" not in r]
+    if ok:
+        best = max(ok, key=lambda r: r["tokens_per_s"])
+        print(json.dumps({"best": best}), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

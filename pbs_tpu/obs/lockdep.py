@@ -86,7 +86,10 @@ class _Graph:
         held = self._held()
         if held:
             holding = held[-1]
-            if holding != name:  # re-entrant same-class is fine
+            # Re-entrant same-class acquisition is legal no matter how
+            # deep it sits in the stack (A, B, A-again cannot invert:
+            # the thread already owns A).
+            if holding != name and name not in held:
                 with self._mu:
                     self.checked_edges += 1
                     # Inversion: does the graph already require name
@@ -161,8 +164,11 @@ def note_acquire(name: str, where: str = "") -> None:
 
 
 def note_release(name: str) -> None:
-    if lockdep.value:
-        _graph.note_release(name)
+    # Deliberately NOT gated on the live param: flipping lockdep off
+    # while locks are held must still pop the held stacks, or phantom
+    # holds poison the graph when it is re-enabled. (The pop is a
+    # cheap no-op for stacks that were never pushed.)
+    _graph.note_release(name)
 
 
 def violations() -> list[dict]:

@@ -64,6 +64,11 @@ def save_image(path: str, kind: str, config: dict, *, state=None,
     from pbs_tpu.ckpt.checkpoint import save_checkpoint
 
     os.makedirs(path, exist_ok=True)
+    config = dict(config)
+    if "dtype" in config and not isinstance(config["dtype"], str):
+        # callers may pass a live dtype (e.g. jnp.bfloat16); manifests
+        # store the canonical name so images stay JSON + portable
+        config["dtype"] = _dtype_name(config["dtype"])
     manifest = {
         "version": 1,
         "kind": kind,
@@ -197,6 +202,10 @@ def image_workload(partition: "Partition", job_name: str,
         raise ValueError("image workload needs spec['path']")
     job = boot_job(path, name=job_name, max_steps=spec.get("max_steps"))
     for k, v in (spec.get("sched") or {}).items():
+        if not hasattr(job.params, k):
+            # a typo'd knob silently running at defaults is worse than
+            # a loud reject (the manifest path raises the same way)
+            raise KeyError(f"unknown sched param {k!r} in image spec")
         setattr(job.params, k, v)
     if "label" in spec:
         job.label = str(spec["label"])

@@ -140,6 +140,33 @@ def test_hook_failure_after_publish_republishes_meta(tmp_path):
     assert meta["slots"] == {}  # freed slots not attributed to anyone
 
 
+def test_image_spec_rejects_unknown_sched_key(tmp_path):
+    """A typo'd sched knob must reject loudly, not silently run at
+    defaults (review finding)."""
+    from pbs_tpu.runtime import image_workload
+
+    path = str(tmp_path / "img")
+    save_image(path, "transformer", TINY,
+               train={"batch": 2, "seq": 32, "max_steps": 1})
+    part = Partition("p", source=TpuBackend())
+    with pytest.raises(KeyError, match="wieght"):
+        image_workload(part, "oops",
+                       {"path": path, "sched": {"wieght": 512}})
+    assert part.jobs == []
+
+
+def test_save_image_normalizes_live_dtype(tmp_path):
+    import jax.numpy as jnp
+    import json
+
+    path = str(tmp_path / "img")
+    save_image(path, "transformer", {**TINY, "dtype": jnp.bfloat16})
+    with open(os.path.join(path, "image.json")) as f:
+        m = json.load(f)
+    assert m["config"]["dtype"] == "bfloat16"
+    boot_job(path, max_steps=0)  # parses and builds cleanly
+
+
 def test_bad_manifest_rejected(tmp_path):
     path = str(tmp_path / "img")
     save_image(path, "transformer", TINY)

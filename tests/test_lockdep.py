@@ -91,6 +91,33 @@ def test_reentrant_same_class_ok():
     assert lockdep.violations() == []
 
 
+def test_reentrant_deep_in_stack_not_inversion():
+    """A, B, A-again is legal (the thread owns A) — must not be read
+    as a B->A inversion (review finding)."""
+    a = OrderedLock("A", recursive=True)
+    b = OrderedLock("B")
+    lockdep.lockdep_strict.set("1")  # would raise on a false positive
+    with a:
+        with b:
+            with a:
+                pass
+    assert lockdep.violations() == []
+
+
+def test_disabling_mid_hold_does_not_leak_held_stack():
+    """Flip the knob off while holding: release must still pop, or
+    re-enabling poisons the graph with phantom holds (review
+    finding)."""
+    a, b = OrderedLock("A"), OrderedLock("B")
+    a.acquire()
+    lockdep.lockdep.reset()  # off, while A is held
+    a.release()
+    lockdep.lockdep.set("1")
+    with b:
+        pass  # would record phantom A->B if the stack leaked
+    assert lockdep.dump()["edges"] == {}
+
+
 def test_hand_over_hand_release():
     """Out-of-order release (A B -> release A -> take C) must keep the
     held stack coherent."""

@@ -1,0 +1,140 @@
+"""The five north-star workload configurations (BASELINE.md:33-38).
+
+The reference pins no benchmark numbers, but BASELINE.json names five
+validation scenarios. Each test here is the PBS-T realization of one,
+so the behavioral envelope (telemetry cadence, proportional sharing,
+per-tenant attribution, guest-vs-host counter agreement, pinned
+scheduler latency) is exercised end to end as a suite, not scattered.
+"""
+
+import numpy as np
+
+from pbs_tpu.runtime import Job, Partition, SchedParams
+from pbs_tpu.sched import FeedbackPolicy
+from pbs_tpu.telemetry import Counter, SimBackend, SimProfile
+from pbs_tpu.telemetry.ledger import Ledger
+from pbs_tpu.utils.clock import MS, US
+
+
+def test_ns1_boot_and_read_counters():
+    """#1: boot the stack, read hardware counters through the
+    virtualization layer (dom0 + perfctr read path)."""
+    be = SimBackend()
+    be.register("probe", SimProfile.steady(
+        step_time_ns=100_000, flops=1 << 20, hbm_bytes=1 << 16))
+    part = Partition("boot", source=be)
+    job = part.add_job(Job("probe", max_steps=50))
+    part.run()
+    # read through the LEDGER (the shared-page path), not the context
+    snap = part.ledger.snapshot(job.contexts[0].ledger_slot)
+    assert int(snap[Counter.STEPS_RETIRED]) == 50
+    assert int(snap[Counter.DEVICE_FLOPS]) == 50 * (1 << 20)
+
+
+def test_ns2_single_tenant_with_sampling():
+    """#2: one PV guest under credit with PMU sampling — overflow
+    sampling (i-mode) delivers threshold events while the job runs."""
+    be = SimBackend()
+    be.register("solo", SimProfile.steady(step_time_ns=1 * MS, tokens=64))
+    part = Partition("p", source=be, scheduler="credit")
+    job = part.add_job(Job("solo", max_steps=2_000))
+    sid = part.sampler.arm(job.contexts[0], Counter.STEPS_RETIRED,
+                           period=500)
+    part.run(until_ns=int(1.2e9))
+    evs = part.sampler.drain()
+    assert len(evs) == 1 and evs[0].value >= 500  # one event, suspended
+    part.sampler.rearm(sid)
+    part.run(until_ns=int(2.4e9))
+    evs2 = part.sampler.drain()
+    assert len(evs2) == 1 and evs2[0].value >= 1000  # rearm -> next fire
+
+
+def test_ns3_two_tenants_contending_with_attribution():
+    """#3: two co-scheduled guests contending one lane; per-guest
+    counter attribution stays exact (nothing pools or leaks)."""
+    be = SimBackend()
+    be.register("a", SimProfile.steady(step_time_ns=1 * MS,
+                                       flops=1 << 20))
+    be.register("b", SimProfile.steady(step_time_ns=1 * MS,
+                                       flops=1 << 10))
+    part = Partition("p", source=be, scheduler="credit", n_executors=1)
+    ja = part.add_job(Job("a", params=SchedParams(weight=512),
+                          max_steps=100_000))
+    jb = part.add_job(Job("b", params=SchedParams(weight=256),
+                          max_steps=100_000))
+    part.run(until_ns=int(2e9))
+    ta = int(ja.contexts[0].counters[Counter.DEVICE_TIME_NS])
+    tb = int(jb.contexts[0].counters[Counter.DEVICE_TIME_NS])
+    assert 1.5 < ta / tb < 2.7  # proportional share under contention
+    # attribution: flops ratio tracks per-job profiles exactly
+    fa = int(ja.contexts[0].counters[Counter.DEVICE_FLOPS])
+    sa = int(ja.contexts[0].counters[Counter.STEPS_RETIRED])
+    assert fa == sa * (1 << 20)
+
+
+def test_ns4_guest_vs_host_counter_agreement(tmp_path):
+    """#4: vPMU guest/host comparison — the job's own view of its
+    counters must agree with an external monitor's lock-free ledger
+    snapshot (byte-compatible file mapping, zero RPCs)."""
+    ledger_path = str(tmp_path / "led")
+    be = SimBackend()
+    be.register("hvm", SimProfile.steady(step_time_ns=1 * MS,
+                                         hbm_bytes=1 << 12, tokens=7))
+    part = Partition("p", source=be, ledger_path=ledger_path)
+    job = part.add_job(Job("hvm", max_steps=123))
+    part.run()
+    # "guest" view: the context's own counters
+    guest = job.contexts[0].counters
+    # "host"/monitor view: a separate read-only mapping of the file
+    mon = Ledger.file_backed(ledger_path, readonly=True)
+    host = mon.snapshot(job.contexts[0].ledger_slot)
+    np.testing.assert_array_equal(np.asarray(guest), np.asarray(host))
+    assert int(host[Counter.TOKENS]) == 123 * 7
+
+
+def test_ns5_pinned_multicontext_credit2_latency():
+    """#5: multi-vCPU guest with pinned pCPUs under credit2 +
+    scheduler-latency microbench — wake-to-dispatch of a pinned
+    latency context stays bounded while batch contexts churn."""
+    be = SimBackend()
+    part = Partition("p", source=be, scheduler="credit2", n_executors=4,
+                     sched_params={"executors_per_runq": 2})
+    for i in range(3):
+        name = f"batch{i}"
+        be.register(name, SimProfile.steady(step_time_ns=500_000))
+        j = Job(name, max_steps=1_000_000)
+        j.contexts[0].avg_step_ns = 500_000.0
+        part.add_job(j)
+    be.register("svc", SimProfile.steady(step_time_ns=100_000))
+    svc = Job("svc", max_steps=1_000_000, n_contexts=2)
+    for c in svc.contexts:
+        c.avg_step_ns = 100_000.0
+        c.executor_hint = c.index  # pinned pCPUs
+    part.add_job(svc)
+    part.run(until_ns=int(5e8))
+
+    # microbench: sleep/wake cycles; measure wake -> first dispatch
+    latencies = []
+    for _ in range(10):
+        part.sleep_job(svc)
+        part.run(max_rounds=2)
+        t0 = part.clock.now_ns()
+        part.wake_job(svc)
+        before = svc.contexts[0].sched_count
+        rounds = 0
+        while svc.contexts[0].sched_count == before and rounds < 64:
+            part.run(max_rounds=1)
+            rounds += 1
+        latencies.append(part.clock.now_ns() - t0)
+    ordered = sorted(latencies)
+    # pinned + fresh credit: typically served within ~2 batch quanta
+    # of wake; worst case stays bounded by a handful (never a full
+    # rotation of the churners).
+    assert ordered[len(ordered) // 2] <= 3 * 500_000, latencies
+    assert ordered[-1] <= 8 * 500_000, latencies
+    # and pinning held: the svc contexts stayed on their hinted lanes'
+    # runqueues (batch contexts may balance freely — that's the point
+    # of pinning only the latency tenant)
+    sched = part.scheduler
+    for c in svc.contexts:
+        assert c.sched_priv.runq == sched._ex_to_rq[c.executor_hint]
