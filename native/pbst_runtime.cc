@@ -189,6 +189,95 @@ uint64_t pbst_trace_lost(const uint64_t* buf) {
   return __atomic_load_n(&buf[3], __ATOMIC_RELAXED);
 }
 
+// ---------------------------------------------------------------------------
+// Cross-process doorbells (event-channel shared page analog).
+//
+// Xen event channels notify across domains through pending bits in the
+// shared_info page plus an upcall (xen/common/event_channel.c); the
+// cross-process notify path here is the same shape over caller-provided
+// shared memory: per-channel pending COUNTS (coalescing like the evtchn
+// pending bit, but lossless for consumers that want the count) and one
+// global notify sequence a waiter can block on.
+//
+// Layout (u64 words): [0] magic  [1] n_channels  [2] notify_seq
+//                     [3] reserved  [4 .. 4+n) per-channel pending
+// ---------------------------------------------------------------------------
+
+static const uint64_t kDoorbellMagic = 0x70627374'6462ULL;  // "pbstdb"
+static const int kDoorbellHeaderWords = 4;
+
+int pbst_db_header_words() { return kDoorbellHeaderWords; }
+
+void pbst_db_init(uint64_t* buf, uint64_t n_channels) {
+  buf[1] = n_channels;
+  buf[2] = 0;
+  buf[3] = 0;
+  std::memset(buf + kDoorbellHeaderWords, 0,
+              n_channels * sizeof(uint64_t));
+  __atomic_store_n(&buf[0], kDoorbellMagic, __ATOMIC_RELEASE);
+}
+
+int pbst_db_valid(const uint64_t* buf) {
+  return __atomic_load_n(&buf[0], __ATOMIC_ACQUIRE) == kDoorbellMagic;
+}
+
+// Ring a channel: bump its pending count and the notify sequence.
+// Returns the channel's new pending count, or 0 on a bad channel.
+uint64_t pbst_db_send(uint64_t* buf, uint64_t chan) {
+  if (chan >= buf[1]) return 0;
+  uint64_t n = __atomic_add_fetch(&buf[kDoorbellHeaderWords + chan], 1,
+                                  __ATOMIC_RELEASE);
+  __atomic_add_fetch(&buf[2], 1, __ATOMIC_RELEASE);
+  return n;
+}
+
+uint64_t pbst_db_pending(const uint64_t* buf, uint64_t chan) {
+  if (chan >= buf[1]) return 0;
+  return __atomic_load_n(&buf[kDoorbellHeaderWords + chan],
+                         __ATOMIC_ACQUIRE);
+}
+
+// Consume a channel: atomically take (and zero) its pending count —
+// the edge-triggered clear-on-dispatch step.
+uint64_t pbst_db_take(uint64_t* buf, uint64_t chan) {
+  if (chan >= buf[1]) return 0;
+  return __atomic_exchange_n(&buf[kDoorbellHeaderWords + chan], 0,
+                             __ATOMIC_ACQ_REL);
+}
+
+uint64_t pbst_db_seq(const uint64_t* buf) {
+  return __atomic_load_n(&buf[2], __ATOMIC_ACQUIRE);
+}
+
+}  // extern "C"
+
+#include <time.h>
+
+extern "C" {
+
+// Block until notify_seq differs from last_seq or timeout_us elapses.
+// Adaptive: brief spin (latency), then 50 us sleeps (CPU). Returns the
+// current notify_seq either way — the caller compares with last_seq.
+uint64_t pbst_db_wait(const uint64_t* buf, uint64_t last_seq,
+                      uint64_t timeout_us) {
+  for (int i = 0; i < 1024; i++) {  // spin phase: ~tens of us
+    uint64_t s = __atomic_load_n(&buf[2], __ATOMIC_ACQUIRE);
+    if (s != last_seq) return s;
+  }
+  struct timespec start, now;
+  clock_gettime(CLOCK_MONOTONIC, &start);
+  struct timespec nap = {0, 50 * 1000};  // 50 us
+  for (;;) {
+    uint64_t s = __atomic_load_n(&buf[2], __ATOMIC_ACQUIRE);
+    if (s != last_seq) return s;
+    clock_gettime(CLOCK_MONOTONIC, &now);
+    uint64_t el = (uint64_t)(now.tv_sec - start.tv_sec) * 1000000ULL +
+                  (uint64_t)(now.tv_nsec - start.tv_nsec) / 1000ULL;
+    if (el >= timeout_us) return s;
+    nanosleep(&nap, nullptr);
+  }
+}
+
 }  // extern "C"
 
 // ---------------------------------------------------------------------------

@@ -34,6 +34,7 @@ DEFAULT_THRESHOLDS_NS = {
     "ledger_resume_suspend": 500_000.0,  # healthy: ~5-40 µs (py), <1 µs (nat)
     "ledger_snapshot": 250_000.0,  # healthy: ~2-20 µs (py), <1 µs (nat)
     "trace_emit": 250_000.0,  # healthy: ~1-10 µs
+    "doorbell_send_take": 250_000.0,  # healthy: ~1-10 µs
 }
 
 
@@ -103,6 +104,19 @@ def run_selftest(thresholds: dict[str, float] | None = None,
     results.append(CanaryResult(
         "trace_emit", "native" if tb._nat is not None else "python", n,
         _bench(lambda: tb.emit(1, 7, 42, 43), n), th["trace_emit"]))
+
+    from pbs_tpu.runtime.doorbell import Doorbell
+
+    db = Doorbell(n_channels=8)
+
+    def ring():
+        db.send(3)
+        db.take(3)
+
+    results.append(CanaryResult(
+        "doorbell_send_take",
+        "native" if db._nat is not None else "python", n,
+        _bench(ring, n), th["doorbell_send_take"]))
     return results
 
 

@@ -3,6 +3,7 @@ from pbs_tpu.runtime.compile_gate import (
     CompileBudget,
     CompileBudgetExceeded,
 )
+from pbs_tpu.runtime.doorbell import Doorbell, bridge_events
 from pbs_tpu.runtime.events import EventBus, EventChannel, Virq
 from pbs_tpu.runtime.executor import Executor, quantum_to_steps
 from pbs_tpu.runtime.hooks import HookError, HookRegistry
@@ -45,6 +46,8 @@ __all__ = [
     "CompileBudget",
     "CompileBudgetExceeded",
     "ContextState",
+    "Doorbell",
+    "bridge_events",
     "DummyPolicy",
     "EventBus",
     "EventChannel",

@@ -50,6 +50,17 @@ class EventBus:
         self.synchronous = synchronous
         self._channels: dict[int, EventChannel] = {}
         self._next_port = 64  # low ports reserved for VIRQs
+        # Send-time taps: observe EVERY signal without occupying a port
+        # (the doorbell bridge rides here — an interrupt is raised at
+        # send, independent of in-process binding/masking/delivery).
+        self._taps: list[Callable[[int], None]] = []
+
+    def add_tap(self, fn: Callable[[int], None]) -> None:
+        self._taps.append(fn)
+
+    def remove_tap(self, fn: Callable[[int], None]) -> None:
+        if fn in self._taps:
+            self._taps.remove(fn)
 
     # -- binding (evtchn_bind_* analogs) ---------------------------------
 
@@ -76,6 +87,8 @@ class EventBus:
     # -- signaling (evtchn_send / send_guest_vcpu_virq analogs) ----------
 
     def send(self, port: int) -> bool:
+        for tap in self._taps:
+            tap(port)  # fires even with no in-process subscriber
         ch = self._channels.get(port)
         if ch is None:
             return False

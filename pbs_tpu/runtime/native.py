@@ -64,6 +64,20 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pbst_gather_rows.argtypes = [
         _U8P, ctypes.c_uint64, _U64P, ctypes.c_int, ctypes.c_uint64, _U8P]
     lib.pbst_gather_rows.restype = ctypes.c_int
+    lib.pbst_db_header_words.restype = ctypes.c_int
+    lib.pbst_db_init.argtypes = [_U64P, ctypes.c_uint64]
+    lib.pbst_db_valid.argtypes = [_U64P]
+    lib.pbst_db_valid.restype = ctypes.c_int
+    lib.pbst_db_send.argtypes = [_U64P, ctypes.c_uint64]
+    lib.pbst_db_send.restype = ctypes.c_uint64
+    lib.pbst_db_pending.argtypes = [_U64P, ctypes.c_uint64]
+    lib.pbst_db_pending.restype = ctypes.c_uint64
+    lib.pbst_db_take.argtypes = [_U64P, ctypes.c_uint64]
+    lib.pbst_db_take.restype = ctypes.c_uint64
+    lib.pbst_db_seq.argtypes = [_U64P]
+    lib.pbst_db_seq.restype = ctypes.c_uint64
+    lib.pbst_db_wait.argtypes = [_U64P, ctypes.c_uint64, ctypes.c_uint64]
+    lib.pbst_db_wait.restype = ctypes.c_uint64
 
 
 def load() -> ctypes.CDLL | None:

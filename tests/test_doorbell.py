@@ -1,0 +1,175 @@
+"""Cross-process doorbells: the event-channel shared-page notify path.
+
+Reference behavior matched: Xen event channels notify across domains
+via pending bits in shared_info + an upcall
+(``xen/common/event_channel.c``; the perfctr overflow virq rides it,
+``pmustate.c:66-80``). These tests cover the counts/sequence
+semantics, the EventBus bridge, and a REAL second process waiting on
+the file-backed block with zero RPCs."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from pbs_tpu.runtime import Doorbell, EventBus, Virq, bridge_events
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_send_take_counts():
+    db = Doorbell(n_channels=8)
+    assert db.pending(3) == 0
+    assert db.send(3) == 1
+    assert db.send(3) == 2
+    assert db.seq() == 2
+    assert db.pending(3) == 2
+    assert db.take(3) == 2  # consume-and-zero
+    assert db.pending(3) == 0
+    assert db.take(3) == 0
+
+
+def test_channel_bounds():
+    db = Doorbell(n_channels=2)
+    with pytest.raises(IndexError):
+        db.send(2)
+
+
+def test_wait_returns_on_ring_and_timeout():
+    db = Doorbell(n_channels=4)
+    s0 = db.seq()
+    t0 = time.monotonic()
+    assert db.wait(s0, timeout_s=0.2) == s0  # nothing rang: timeout
+    assert time.monotonic() - t0 >= 0.15
+    db.send(1)
+    assert db.wait(s0, timeout_s=5.0) == s0 + 1  # returns immediately
+
+
+def test_bridge_forwards_virqs():
+    bus = EventBus(synchronous=True)
+    db = Doorbell(n_channels=64)
+    seen = []
+    bus.bind_virq(Virq.TELEMETRY, lambda p: seen.append(p))  # existing
+    bridge_events(bus, db)
+    bus.send_virq(Virq.TELEMETRY)
+    bus.send_virq(Virq.JOB_FAILED)  # no local subscriber: still rings
+    # the in-process subscriber still fired...
+    assert seen == [int(Virq.TELEMETRY)]
+    # ...and both interrupts rang the shared block
+    assert db.take(int(Virq.TELEMETRY)) == 1
+    assert db.take(int(Virq.JOB_FAILED)) == 1
+
+
+def test_bind_after_bridge_still_works():
+    """The bridge is a tap, not a port owner: subscribing AFTER
+    bridging must neither raise nor lose either consumer (review
+    finding)."""
+    bus = EventBus(synchronous=True)
+    db = Doorbell(n_channels=64)
+    tap = bridge_events(bus, db)
+    seen = []
+    bus.bind_virq(Virq.JOB_FAILED, lambda p: seen.append(p))  # after!
+    bus.send_virq(Virq.JOB_FAILED)
+    assert seen == [int(Virq.JOB_FAILED)]
+    assert db.take(int(Virq.JOB_FAILED)) == 1
+    bus.remove_tap(tap)  # unbridge: bus-only delivery resumes
+    bus.send_virq(Virq.JOB_FAILED)
+    assert db.pending(int(Virq.JOB_FAILED)) == 0
+
+
+def test_attach_rejects_truncated_block(tmp_path):
+    """A truncated file with an intact header must not let the native
+    sender write past the mapping (review finding)."""
+    path = str(tmp_path / "db")
+    Doorbell.file_backed(path, n_channels=64)
+    os.truncate(path, (4 + 8) * 8)  # header + 8 channels remain
+    with pytest.raises(ValueError, match="claims 64 channels"):
+        Doorbell.file_backed(path, attach=True)
+
+
+def test_negative_channel_rejected_everywhere():
+    """take(-4) on the fallback would zero the MAGIC word (review
+    finding)."""
+    db = Doorbell(n_channels=4, native=False)
+    for fn in (db.send, db.pending, db.take):
+        with pytest.raises(IndexError):
+            fn(-1)
+        with pytest.raises(IndexError):
+            fn(4)
+    # the magic survived all the rejected calls
+    assert int(db._arr[0]) != 0
+
+
+def test_partition_virqs_visible_cross_block(tmp_path):
+    """End to end in-process: a partition's overflow sampling rings the
+    doorbell an attached (separately-mapped) observer sees."""
+    from pbs_tpu.runtime import Job, Partition
+    from pbs_tpu.telemetry import Counter, SimBackend, SimProfile
+    from pbs_tpu.utils.clock import MS
+
+    path = str(tmp_path / "db")
+    db = Doorbell.file_backed(path, n_channels=64)
+    be = SimBackend()
+    be.register("j", SimProfile.steady(step_time_ns=1 * MS))
+    part = Partition("p", source=be)
+    bridge_events(part.events, db)
+    job = part.add_job(Job("j", max_steps=2_000))
+    part.sampler.arm(job.contexts[0], Counter.STEPS_RETIRED, period=100)
+    part.run(until_ns=int(5e8))
+
+    observer = Doorbell.file_backed(path, attach=True)
+    assert observer.take(int(Virq.TELEMETRY)) >= 1
+
+
+@pytest.mark.skipif(
+    not __import__("pbs_tpu.runtime.native",
+                   fromlist=["available"]).available(),
+    reason="cross-process senders need the native runtime")
+def test_cross_process_wait_wakes_on_ring(tmp_path):
+    """A REAL second process blocks in wait() and reports the wake
+    latency; the parent rings after a known delay. Zero RPCs."""
+    path = str(tmp_path / "db")
+    db = Doorbell.file_backed(path, n_channels=8)
+
+    waiter = subprocess.Popen(
+        [sys.executable, "-c", f"""
+import sys, time
+sys.path.insert(0, {REPO!r})
+from pbs_tpu.runtime.doorbell import Doorbell
+db = Doorbell.file_backed({path!r}, attach=True)
+s0 = db.seq()
+print("READY", flush=True)
+t0 = time.monotonic()
+s1 = db.wait(s0, timeout_s=10.0)
+dt = time.monotonic() - t0
+assert s1 != s0, "timed out instead of waking"
+print(f"WOKE {{dt:.4f}} pending={{db.take(5)}}", flush=True)
+"""],
+        stdout=subprocess.PIPE, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    try:
+        assert waiter.stdout.readline().strip() == "READY"
+        time.sleep(0.3)
+        db.send(5)
+        line = waiter.stdout.readline().strip()
+        assert line.startswith("WOKE")
+        woke_s = float(line.split()[1])
+        assert "pending=1" in line
+        # the waiter saw the ring promptly (50 us naps; generous slop
+        # for loaded CI — the mechanism matters, not the percentile)
+        assert woke_s < 5.0
+        assert waiter.wait(timeout=10) == 0
+    finally:
+        if waiter.poll() is None:
+            waiter.kill()
+        waiter.stdout.close()
+
+
+def test_attach_rejects_uninitialized(tmp_path):
+    path = str(tmp_path / "raw")
+    with open(path, "wb") as f:
+        f.write(b"\x00" * 1024)
+    with pytest.raises(ValueError, match="not an initialized"):
+        Doorbell.file_backed(path, attach=True)
