@@ -1,4 +1,5 @@
 from pbs_tpu.ckpt.checkpoint import (
+    AsyncCheckpointer,
     Replicator,
     checkpoint_exists,
     remove_checkpoint,
@@ -7,6 +8,7 @@ from pbs_tpu.ckpt.checkpoint import (
 )
 
 __all__ = [
+    "AsyncCheckpointer",
     "Replicator",
     "checkpoint_exists",
     "remove_checkpoint",

@@ -163,6 +163,82 @@ def remove_checkpoint(path: str) -> None:
         shutil.rmtree(path, ignore_errors=True)
 
 
+class AsyncCheckpointer:
+    """Orbax-style async save (SURVEY.md §7 conceptual map): the
+    device→host snapshot happens synchronously on the caller's thread
+    (consistent — the training loop may donate/overwrite device buffers
+    immediately after), while serialization + atomic publish run on a
+    background thread, so checkpoint I/O overlaps the next training
+    steps instead of stalling them.
+
+    One save in flight at a time (a second ``save`` waits for the
+    first — same back-pressure contract as orbax's AsyncCheckpointer):
+    unbounded queueing would hide a slow disk until memory ran out.
+    """
+
+    def __init__(self):
+        self._thread: threading.Thread | None = None
+        self._error: BaseException | None = None
+        self._last_manifest: dict | None = None
+        self.saves = 0
+
+    def save(self, path: str, state: Any, metadata: dict | None = None,
+             telemetry: np.ndarray | None = None) -> None:
+        """Snapshot ``state`` to host NOW; write to ``path`` in the
+        background. Raises any error from the PREVIOUS save (delayed
+        failure must surface, not vanish)."""
+        import jax
+
+        self.wait()  # back-pressure + surface prior failure
+        # Host snapshot on the caller's thread: after this returns the
+        # caller may freely mutate/donate the device arrays.
+        leaves, treedef = _flatten(state)
+        # The snapshot must not alias anything the caller can mutate or
+        # donate: np.asarray on a host numpy leaf returns the SAME
+        # object, and on the CPU JAX backend it can be a zero-copy view
+        # of the device buffer (which XLA reuses after donation). Copy
+        # whenever the result doesn't own its bytes.
+        host_leaves = []
+        for leaf in leaves:
+            arr = np.asarray(leaf)
+            if arr is leaf or not arr.flags.owndata:
+                arr = arr.copy()
+            host_leaves.append(arr)
+        host_state = jax.tree_util.tree_unflatten(treedef, host_leaves)
+        tel = None if telemetry is None else np.asarray(telemetry).copy()
+
+        def _write() -> None:
+            try:
+                self._last_manifest = save_checkpoint(
+                    path, host_state, metadata, tel)
+                self.saves += 1
+            except BaseException as e:  # noqa: BLE001 — re-raised at
+                self._error = e  # the next save()/wait()
+
+        self._thread = threading.Thread(
+            target=_write, daemon=True, name="pbst-async-ckpt")
+        self._thread.start()
+
+    def wait(self, timeout: float | None = None) -> dict | None:
+        """Join the in-flight save; returns its manifest (None if no
+        save has completed). Raises a background failure exactly once."""
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():
+                raise TimeoutError("checkpoint write still in flight")
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+        return self._last_manifest
+
+    @property
+    def in_flight(self) -> bool:
+        t = self._thread  # capture: wait() may None it concurrently
+        return t is not None and t.is_alive()
+
+
 class Replicator:
     """Remus analog: continuous periodic checkpointing with retention.
 

@@ -1,0 +1,121 @@
+"""The flagship research story, end to end on REAL workloads.
+
+This is the reference's reason to exist (SURVEY §0): co-located
+tenants multiplexed on one accelerator, with per-tenant virtualized
+telemetry feeding an adaptive-quantum scheduler. Round 1 demonstrated
+it only against SimBackend; this test runs the whole loop on real
+jitted programs with MEASURED telemetry:
+
+  train tenant (matmul-heavy jit) + serve tenant (small latency jit)
+  -> TpuBackend with XLA-profiler sampling (measured stall/compute)
+  -> ledger (seqlock, monitor-readable) -> FeedbackPolicy phases
+  -> per-job tslice adaptation -> credit dispatch honoring it
+  -> async checkpoints of the train tenant overlapping its steps
+
+plus the weighted-share and fault-containment invariants along the
+way. Slow-ish (~20 s); it is the e2e gate for the research core.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pbs_tpu.ckpt import AsyncCheckpointer, restore_checkpoint
+from pbs_tpu.runtime import Job, Partition, SchedParams
+from pbs_tpu.sched import FeedbackPolicy
+from pbs_tpu.telemetry import Counter
+from pbs_tpu.telemetry.source import TpuBackend
+
+
+def test_flagship_story(tmp_path):
+    n = 256
+
+    # -- tenants ---------------------------------------------------------
+    @jax.jit
+    def train_fn(x):  # HBM-heavy: elementwise chains dominate
+        for _ in range(30):
+            x = jnp.tanh(x) + 0.01 * x
+        return x
+
+    @jax.jit
+    def serve_fn(x):  # MXU-heavy and short: latency tenant
+        for _ in range(4):
+            x = x @ x / n
+        return x
+
+    x0 = jnp.ones((n, n), jnp.float32)
+    train_fn(x0).block_until_ready()
+    serve_fn(x0).block_until_ready()
+
+    def train_step(st):
+        return ({"x": train_fn(st["x"]), "step": st["step"] + 1},
+                {"tokens": 128})
+
+    def serve_step(st):
+        return {"x": serve_fn(st["x"]), "step": st["step"] + 1}
+
+    be = TpuBackend(profile_every=4)  # measured telemetry
+    part = Partition("flag", source=be)
+    fb = FeedbackPolicy(part, tick_ns=1)
+    train = part.add_job(Job(
+        "train", step_fn=train_step, state={"x": x0, "step": 0},
+        params=SchedParams(weight=512, tslice_us=100)))
+    serve = part.add_job(Job(
+        "serve", step_fn=serve_step, state={"x": x0, "step": 0},
+        params=SchedParams(weight=256, tslice_us=100)))
+
+    ck = AsyncCheckpointer()
+    ckpt_path = str(tmp_path / "train_ck")
+    for round_i in range(14):
+        part.run(max_rounds=1)
+        if round_i % 5 == 4:  # periodic async checkpoint, off-path
+            ck.save(ckpt_path, train.state)
+    ck.wait()
+
+    # -- measured telemetry actually measured ----------------------------
+    assert be.profiler.samples >= 2, be.profiler.last_error
+    m_train = be.measured("train")
+    m_serve = be.measured("serve")
+    assert m_train is not None and m_serve is not None
+    # the two tenants look DIFFERENT to the measured backend
+    assert m_train.stall_frac > m_serve.stall_frac, (
+        m_train.stall_frac, m_serve.stall_frac)
+
+    # -- phases drove the quanta apart -----------------------------------
+    # train: memory-bound steady phase -> slice grew; serve: compute
+    # phase -> slice stayed at/returned to the floor
+    assert train.params.tslice_us > 100, fb.dump()
+    assert serve.params.tslice_us == 100, fb.dump()
+    assert train.stall_rate > serve.stall_rate
+
+    # -- ledger view matches context view (monitor path) -----------------
+    for job in (train, serve):
+        snap = part.ledger.snapshot(job.contexts[0].ledger_slot)
+        np.testing.assert_array_equal(
+            np.asarray(snap), np.asarray(job.contexts[0].counters))
+    assert int(train.contexts[0].counters[Counter.TOKENS]) > 0
+
+    # -- both made progress; the weighted tenant was dispatched more -----
+    # (dispatch counts are the scheduler's own decision — device TIME
+    # on real wall clocks is load-noisy at this few rounds, and the
+    # exact-share property is pinned by the deterministic Sim tests)
+    assert train.state["step"] > 0 and serve.state["step"] > 0
+    assert (train.contexts[0].sched_count
+            >= serve.contexts[0].sched_count), (
+        train.contexts[0].sched_count, serve.contexts[0].sched_count)
+
+    # -- the async checkpoint is restorable and consistent ---------------
+    got, _ = restore_checkpoint(
+        ckpt_path, like={"x": np.zeros((n, n), np.float32), "step": 0})
+    assert got["step"] > 0
+
+    # -- fault containment leaves the other tenant running ---------------
+    def crash(st):
+        raise RuntimeError("synthetic device fault")
+
+    doomed = part.add_job(Job("doomed", step_fn=crash, state=0,
+                              max_steps=10))
+    before = serve.state["step"]
+    part.run(max_rounds=4)
+    assert doomed.error is not None
+    assert serve.state["step"] > before  # neighbors unharmed
