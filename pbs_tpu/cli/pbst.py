@@ -351,6 +351,53 @@ def cmd_list(args) -> int:
     return 0
 
 
+def cmd_replicate(args) -> int:
+    """Remus surface: start/stop/status of a job's replication pump on
+    its source agent (tools/remus CLI analog)."""
+    cli = _agent_client(args)
+    try:
+        if args.action == "start":
+            if not args.peer:
+                print("pbst: replicate start needs --peer host:port",
+                      file=sys.stderr)
+                return 1
+            try:
+                host, port = _parse_addr(args.peer)
+            except ValueError:
+                print(f"pbst: bad --peer {args.peer!r} "
+                      "(expected host:port)", file=sys.stderr)
+                return 1
+            st = cli.call("replicate_start", job=args.job, peer_host=host,
+                          peer_port=port, period_s=args.period,
+                          subject=args.subject)
+            print(json.dumps(st))
+        elif args.action == "stop":
+            ok = cli.call("replicate_stop", job=args.job,
+                          subject=args.subject)
+            print(json.dumps({"stopped": ok}))
+        else:  # status
+            st = cli.call("replicate_status", job=args.job,
+                          subject=args.subject)
+            print(json.dumps(st, indent=1))
+    finally:
+        cli.close()
+    return 0
+
+
+def cmd_replicas(args) -> int:
+    """What replicas a backup host holds (the failover inventory)."""
+    cli = _agent_client(args)
+    try:
+        rows = cli.call("list_replicas", subject=args.subject)
+        print(f"{'job':<16} {'epoch':>8} {'source':<12} {'age_s':>8}")
+        for r in rows:
+            print(f"{r['job']:<16} {r['epoch']:>8} {r['source']:<12} "
+                  f"{r['age_s']:>8.2f}")
+    finally:
+        cli.close()
+    return 0
+
+
 def cmd_console(args) -> int:
     """xl console analog: stream a job's console ring from an agent."""
     import time as _t
@@ -551,6 +598,20 @@ def main(argv=None) -> int:
     agent_args(sp)
     sp.add_argument("--rounds", type=int, default=100)
     sp.set_defaults(fn=cmd_run)
+
+    sp = sub.add_parser("replicate",
+                        help="Remus replication control (tools/remus)")
+    sp.add_argument("action", choices=["start", "stop", "status"])
+    sp.add_argument("job")
+    agent_args(sp)
+    sp.add_argument("--peer", default=None, help="backup host:port")
+    sp.add_argument("--period", type=float, default=0.5)
+    sp.set_defaults(fn=cmd_replicate)
+
+    sp = sub.add_parser("replicas",
+                        help="replicas held by a backup host")
+    agent_args(sp)
+    sp.set_defaults(fn=cmd_replicas)
 
     sp = sub.add_parser("console",
                         help="stream a job's console (xl console)")

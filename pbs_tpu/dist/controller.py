@@ -565,6 +565,91 @@ class Controller:
                 self._drop_and_rearm(rec, m)
         return moved
 
+    # -- persistence (xend-restart story) --------------------------------
+    #
+    # xend persisted its domain map in xenstore so a restarted daemon
+    # rediscovered the world instead of orphaning every guest. Same
+    # split here: durable intent (job records, membership, replication
+    # topology) goes into the Store under /cluster; live state (which
+    # hosts answer, what their load is) is re-learned by heartbeats.
+
+    def save_state(self, store, prefix: str = "/cluster",
+                   subject: str = "system") -> None:
+        """Persist membership + job records; one transaction so a
+        reader never sees a half-written cluster map."""
+        tx = store.transaction(subject=subject)
+        tx.rm(prefix)
+        for name, h in self.agents.items():
+            tx.write(f"{prefix}/agents/{name}",
+                     {"host": h.address[0], "port": h.address[1]})
+        for name, rec in self.jobs.items():
+            tx.write(f"{prefix}/jobs/{name}", {
+                "workload": rec.workload,
+                "spec": rec.spec,
+                "gang": rec.gang,
+                "members": [{"agent": m.agent, "job": m.job}
+                            for m in rec.members],
+                "replica_peers": dict(rec.replica_peers),
+                "replica_period_s": rec.replica_period_s,
+            })
+        tx.commit()
+
+    @classmethod
+    def load_state(cls, store, prefix: str = "/cluster",
+                   store_subject: str = "system", **kw) -> "Controller":
+        """Rebuild a controller from the persisted map. Agents are
+        re-dialed CONCURRENTLY (N dead hosts cost one connect timeout,
+        not N — the heartbeat lesson); unreachable hosts come up
+        present-but-dead and surface through the normal heartbeat
+        path, so a restarted daemon is usable even with half the fleet
+        down. ``store_subject`` is the XSM label for the store reads;
+        the controller's own RPC identity passes through ``**kw``
+        (``subject=...``) untouched."""
+        ctl = cls(**kw)
+        names = store.ls(f"{prefix}/agents", subject=store_subject)
+        addrs = {
+            name: store.read(f"{prefix}/agents/{name}",
+                             subject=store_subject)
+            for name in names
+        }
+
+        def _dial(name: str) -> None:
+            addr = addrs[name]
+            try:
+                ctl.add_agent(name, (addr["host"], addr["port"]))
+            except Exception:  # noqa: BLE001 — host down: mark dead,
+                h = AgentHandle(  # heartbeat/recover() handle the rest
+                    name,
+                    RpcClient((addr["host"], addr["port"]),
+                              auth_token=ctl.auth_token),
+                    probe=RpcClient((addr["host"], addr["port"]),
+                                    timeout_s=2.0,
+                                    auth_token=ctl.auth_token),
+                    address=(addr["host"], addr["port"]),
+                    alive=False, missed=ctl.dead_after_missed)
+                ctl.agents[name] = h
+
+        threads = [threading.Thread(target=_dial, args=(n,), daemon=True)
+                   for n in names]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for name in store.ls(f"{prefix}/jobs", subject=store_subject):
+            rec = store.read(f"{prefix}/jobs/{name}",
+                             subject=store_subject)
+            ctl.jobs[name] = JobRecord(
+                name=name,
+                workload=rec["workload"],
+                spec=rec["spec"],
+                members=[MemberRef(m["agent"], m["job"])
+                         for m in rec["members"]],
+                gang=rec.get("gang", False),
+                replica_peers=dict(rec.get("replica_peers", {})),
+                replica_period_s=rec.get("replica_period_s", 0.5),
+            )
+        return ctl
+
     # -- observability ---------------------------------------------------
 
     def cluster_dump(self) -> dict[str, Any]:
