@@ -85,7 +85,17 @@ class Controller:
 
     # -- membership ------------------------------------------------------
 
+    @staticmethod
+    def _check_name(kind: str, name: str) -> None:
+        # Names become Store path segments in save_state; a '/' would
+        # silently splinter the persisted record (and an empty name is
+        # unaddressable everywhere).
+        if not name or "/" in name:
+            raise ValueError(f"invalid {kind} name {name!r}: must be "
+                             "non-empty and contain no '/'")
+
     def add_agent(self, name: str, address: tuple[str, int]) -> AgentHandle:
+        self._check_name("agent", name)
         h = AgentHandle(name, RpcClient(address, auth_token=self.auth_token),
                         probe=RpcClient(address, timeout_s=2.0,
                                         auth_token=self.auth_token),
@@ -210,6 +220,7 @@ class Controller:
     ) -> JobRecord:
         """Create a job with ``n_members`` member jobs placed across
         agents; gang members land on distinct hosts."""
+        self._check_name("job", name)
         if name in self.jobs:
             raise ValueError(f"job {name!r} already exists")
         spec = dict(spec or {})
@@ -629,12 +640,7 @@ class Controller:
                     alive=False, missed=ctl.dead_after_missed)
                 ctl.agents[name] = h
 
-        threads = [threading.Thread(target=_dial, args=(n,), daemon=True)
-                   for n in names]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        cls._fanout(names, _dial)
         for name in store.ls(f"{prefix}/jobs", subject=store_subject):
             rec = store.read(f"{prefix}/jobs/{name}",
                              subject=store_subject)

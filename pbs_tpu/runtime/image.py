@@ -104,11 +104,57 @@ def read_manifest(path: str) -> dict:
     return m
 
 
-def _build(kind: str, config: dict, train: dict):
-    """(cfg, init_state_fn, step_fn_factory) for a manifest. The
-    returned step closes over a synthetic data stream keyed by the
-    step counter — images are self-contained boot media, so the
-    default data source cannot depend on external files."""
+def _make_batch_fn(data: dict, image_path: str, batch: int, seq: int,
+                   vocab: int, seed: int):
+    """step -> (batch, seq) int32 host tokens, from the manifest's
+    data spec. ``synthetic`` (default) needs no files; ``corpus``
+    memory-maps a packed token file — a RELATIVE path resolves inside
+    the image directory, so an image can carry its own data shard and
+    stay a fully self-contained boot medium."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    kind = data.get("kind", "synthetic")
+    if kind == "synthetic":
+        def batch_fn(step: int):
+            return jax.random.randint(
+                jax.random.fold_in(jax.random.PRNGKey(seed + 1), step),
+                (batch, seq), 0, vocab, jnp.int32)
+
+        return batch_fn
+    if kind == "corpus":
+        from pbs_tpu.data.tokens import TokenDataset
+
+        path = data.get("path")
+        if not path:
+            raise ValueError("corpus data spec needs 'path'")
+        if not os.path.isabs(path):
+            path = os.path.join(image_path, path)
+        ds = TokenDataset(path)
+        if seq > ds.n_tokens:
+            # Loud at boot, not contained-as-job.error at step 0 (over
+            # the control plane the create RPC would report success
+            # while the job sat dead).
+            raise ValueError(
+                f"corpus {path!r} holds {ds.n_tokens} tokens — shorter "
+                f"than one training sequence (seq={seq})")
+        sequential = data.get("sampling", "random") == "sequential"
+
+        def batch_fn(step: int):
+            if sequential:
+                return ds.window(step, batch, seq)
+            # reproducible random windows: one generator per step
+            rng = np.random.default_rng(seed * 1_000_003 + step)
+            return ds.sample(batch, seq, rng)
+
+        return batch_fn
+    raise ValueError(f"unknown data kind {kind!r} in image manifest")
+
+
+def _build(kind: str, config: dict, train: dict, data: dict,
+           image_path: str):
+    """(cfg, init_state_fn, step_fn) for a manifest."""
     import jax
     import jax.numpy as jnp
 
@@ -149,12 +195,11 @@ def _build(kind: str, config: dict, train: dict):
             return (params, jax.jit(init_opt)(params), 0)
 
     seq = min(seq, cfg.max_seq)
+    batch_fn = _make_batch_fn(data, image_path, batch, seq, cfg.vocab,
+                              seed)
 
     def step_fn(state):
-        step = int(state[2])
-        tokens = jax.random.randint(
-            jax.random.fold_in(jax.random.PRNGKey(seed + 1), step),
-            (batch, seq), 0, cfg.vocab, jnp.int32)
+        tokens = batch_fn(int(state[2]))
         return train_step(state, tokens)
 
     return cfg, init_state, step_fn
@@ -169,7 +214,9 @@ def boot_job(path: str, name: str | None = None,
     from pbs_tpu.runtime.job import Job, SchedParams
 
     m = read_manifest(path)
-    cfg, init_state, step_fn = _build(m["kind"], m["config"], m["train"])
+    cfg, init_state, step_fn = _build(
+        m["kind"], m["config"], m["train"],
+        m.get("data") or {"kind": "synthetic"}, path)
     state = init_state()
     ckpt = os.path.join(path, CKPT_DIR)
     if m.get("has_ckpt"):

@@ -135,6 +135,42 @@ def test_load_state_dead_hosts_cost_one_timeout(hosts):
         ctl2.close()
 
 
+def test_slash_names_rejected_at_source(hosts):
+    """Names become Store path segments: '/' would splinter the
+    persisted record, so it is rejected at create time (review
+    finding)."""
+    ctl, _ = hosts
+    with pytest.raises(ValueError, match="no '/'"):
+        ctl.create_job("team/run1", spec={"step_time_ns": 1_000_000})
+    with pytest.raises(ValueError, match="no '/'"):
+        ctl.add_agent("rack/host", ("127.0.0.1", 1))
+    with pytest.raises(ValueError, match="non-empty"):
+        ctl.create_job("", spec={})
+
+
+def test_short_corpus_rejected_at_boot(tmp_path):
+    """A shard shorter than one sequence fails the BOOT, not step 0
+    (review finding)."""
+    import numpy as np
+
+    from pbs_tpu.data.tokens import write_token_file
+    from pbs_tpu.runtime import boot_job, save_image
+
+    path = str(tmp_path / "img")
+    import os
+
+    os.makedirs(path)
+    write_token_file(os.path.join(path, "tiny.tok"),
+                     np.arange(8) % 4)
+    save_image(path, "transformer",
+               dict(vocab=64, d_model=32, n_layers=1, n_heads=2,
+                    n_kv_heads=2, d_ff=64, max_seq=64, dtype="float32"),
+               train={"batch": 2, "seq": 32},
+               data={"kind": "corpus", "path": "tiny.tok"})
+    with pytest.raises(ValueError, match="shorter than one training"):
+        boot_job(path)
+
+
 def test_replicate_cli_bad_peer_is_usage_error(hosts):
     from pbs_tpu.cli.pbst import main
 

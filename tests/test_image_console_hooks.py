@@ -181,6 +181,47 @@ def test_bad_manifest_rejected(tmp_path):
         boot_job(path)
 
 
+def test_image_with_bundled_corpus(tmp_path):
+    """A corpus shard INSIDE the image directory (relative path):
+    the image is a fully self-contained boot medium with real data."""
+    from pbs_tpu.data.tokens import write_token_file
+
+    path = str(tmp_path / "img")
+    os.makedirs(path, exist_ok=True)
+    rng = np.random.default_rng(0)
+    write_token_file(os.path.join(path, "shard.tok"),
+                     rng.integers(0, 64, size=8_192))
+    save_image(path, "transformer", TINY,
+               train={"batch": 2, "seq": 32, "max_steps": 3},
+               data={"kind": "corpus", "path": "shard.tok"})
+    job = boot_job(path)
+    part = Partition("p", source=TpuBackend())
+    part.add_job(job)
+    part.run(max_rounds=10)
+    assert job.steps_retired() == 3 and job.error is None
+
+
+def test_image_corpus_sequential_is_deterministic(tmp_path):
+    from pbs_tpu.data.tokens import write_token_file
+    from pbs_tpu.runtime.image import _make_batch_fn
+
+    corpus = str(tmp_path / "c.tok")
+    write_token_file(corpus, np.arange(1_000) % 64)
+    fn = _make_batch_fn({"kind": "corpus", "path": corpus,
+                         "sampling": "sequential"},
+                        str(tmp_path), batch=2, seq=16, vocab=64, seed=0)
+    np.testing.assert_array_equal(np.asarray(fn(0)), np.asarray(fn(0)))
+    assert not np.array_equal(np.asarray(fn(0)), np.asarray(fn(1)))
+
+
+def test_image_bad_data_kind_rejected(tmp_path):
+    path = str(tmp_path / "img")
+    save_image(path, "transformer", TINY,
+               data={"kind": "parquet"})
+    with pytest.raises(ValueError, match="unknown data kind"):
+        boot_job(path)
+
+
 def test_image_workload_over_control_plane(tmp_path):
     """xl create <image> over the wire: agent boots from disk."""
     from pbs_tpu.dist import Agent, RpcClient
