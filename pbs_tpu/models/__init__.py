@@ -6,6 +6,11 @@ from pbs_tpu.models.generate import (
     prefill,
 )
 from pbs_tpu.models.microstep import make_micro_train_step
+from pbs_tpu.models.serving import (
+    Completion,
+    ContinuousBatcher,
+    make_continuous_serve_step,
+)
 from pbs_tpu.models.moe import (
     MoEConfig,
     init_moe_params,
@@ -23,9 +28,12 @@ from pbs_tpu.models.transformer import (
 )
 
 __all__ = [
+    "Completion",
+    "ContinuousBatcher",
     "MoEConfig",
     "TransformerConfig",
     "forward",
+    "make_continuous_serve_step",
     "forward_with_cache",
     "init_cache",
     "init_moe_params",

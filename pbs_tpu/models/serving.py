@@ -1,0 +1,338 @@
+"""Continuous batching: slot-based serving engine, TPU-first.
+
+The reference has no serving story at all (SURVEY.md §0); PBS-T's
+batch-inference tenant (``make_serve_step``) generates request batches
+in lockstep — a late request waits for the whole previous batch. This
+module adds the serving engine modern LLM systems use: **continuous
+batching** — a fixed pool of decode slots advancing one token per step
+for ALL active requests, with new requests admitted into free slots at
+step boundaries and finished ones retired immediately.
+
+TPU-first expression of the idea:
+
+- **Static everything**: ``n_slots`` decode lanes, one shared KV slab
+  ``(L, n_slots, T, nkv, hd)``, prompts padded to a static bucket.
+  Admission/retirement changes DATA (per-slot cursors and masks),
+  never shapes — so exactly two XLA programs exist (slot-prefill,
+  slot-decode) regardless of traffic.
+- **Per-slot cursors**: unlike ``forward_with_cache`` (one scalar
+  position for the whole batch), every slot carries its own ``pos``;
+  rope tables are gathered per row, cache writes scatter per row, and
+  the causal mask compares against each row's own position.
+- **Inactive lanes ride along**: an empty slot still computes (masked
+  to self-attention on garbage it never emits). Wasted FLOPs on idle
+  lanes buy shape stability — the standard TPU trade.
+- **Host admission between dispatches**: the engine's ``step()`` is
+  a scheduler-quantum-sized unit (one token across slots), so a
+  serving Job under the credit scheduler interleaves with training at
+  token granularity — the latency story the reference's BOOST class
+  exists for.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from collections import deque
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pbs_tpu.models.generate import _sample
+from pbs_tpu.models.transformer import (
+    TransformerConfig,
+    rms_norm,
+    rope_tables,
+)
+
+
+def _rope_rows(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Per-row rope: x (B, S, H, hd); cos/sin (B, S, half)."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    c = cos[:, :, None, :].astype(x.dtype)
+    s = sin[:, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+def init_slot_cache(cfg: TransformerConfig, n_slots: int,
+                    max_len: int) -> dict:
+    shape = (cfg.n_layers, n_slots, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "pos": jnp.zeros((n_slots,), jnp.int32),  # per-slot cursors
+    }
+
+
+def _slot_forward(cfg: TransformerConfig, params: dict, tokens: jax.Array,
+                  cache: dict, row_pos: jax.Array) -> tuple[jax.Array, dict]:
+    """Forward (B, S) tokens where row b sits at absolute position
+    ``row_pos[b]`` (S static; per-row cursors). Writes K/V at
+    ``row_pos[b] + s``; row b's query s attends cols <= row_pos[b]+s.
+    Returns (logits (B, S, vocab) fp32, updated cache slabs)."""
+    B, S = tokens.shape
+    T = cache["k"].shape[2]
+    dt = cfg.dtype
+    nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    group = nh // nkv
+
+    x = params["embed"].astype(dt)[tokens]
+    cos_full, sin_full = rope_tables(cfg, T)
+    # absolute position of every (row, s) element: (B, S)
+    abs_pos = row_pos[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    abs_pos = jnp.minimum(abs_pos, T - 1)  # clamp: masked rows only
+    cos = cos_full[abs_pos]  # (B, S, half)
+    sin = sin_full[abs_pos]
+
+    def body(x, layer):
+        lp, ck, cv = layer  # ck/cv: (B, T, nkv, hd)
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"].astype(dt)).reshape(B, S, nh, hd)
+        k = (h @ lp["wk"].astype(dt)).reshape(B, S, nkv, hd)
+        v = (h @ lp["wv"].astype(dt)).reshape(B, S, nkv, hd)
+        q = _rope_rows(q, cos, sin)
+        k = _rope_rows(k, cos, sin)
+        # scatter each row's S new entries at its own cursor
+        ck = ck.at[jnp.arange(B)[:, None], abs_pos].set(k)
+        cv = cv.at[jnp.arange(B)[:, None], abs_pos].set(v)
+        # attention with per-row causal horizon
+        qg = q.reshape(B, S, nkv, group, hd).transpose(0, 2, 3, 1, 4)
+        kt = ck.transpose(0, 2, 1, 3)  # (B, nkv, T, hd)
+        vt = cv.transpose(0, 2, 1, 3)
+        scores = jnp.einsum("bngqh,bnkh->bngqk", qg, kt) / np.sqrt(hd)
+        # per-row causal horizon: row b's query s sees cols <= abs_pos
+        reach = (jnp.arange(T)[None, None, :]
+                 <= abs_pos[:, :, None])  # (B, S, T)
+        mask = jnp.broadcast_to(reach[:, None, None, :, :], scores.shape)
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(
+            scores.astype(jnp.float32), axis=-1).astype(dt)
+        attn = jnp.einsum("bngqk,bnkh->bngqh", probs, vt)
+        attn = attn.transpose(0, 3, 1, 2, 4).reshape(B, S, nh * hd)
+        x = x + attn @ lp["wo"].astype(dt)
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h @ lp["w1"].astype(dt))
+        up = h @ lp["w3"].astype(dt)
+        x = x + (gate * up) @ lp["w2"].astype(dt)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["head"].astype(dt)).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v, "pos": cache["pos"]}
+
+
+@dataclasses.dataclass
+class Completion:
+    request_id: int
+    tokens: list[int]
+    prompt_len: int
+    steps_waited: int  # decode steps between submit and first token
+
+
+class ContinuousBatcher:
+    """The slot engine. Host-side control, two compiled programs.
+
+    ``submit`` enqueues; ``step()`` admits into free slots, advances
+    one decode token for every active slot, and returns finished
+    :class:`Completion`s. All shapes static: ``n_slots`` lanes,
+    prompts padded to ``prompt_bucket``, caches sized ``max_len``.
+    """
+
+    def __init__(self, cfg: TransformerConfig, params: dict,
+                 n_slots: int = 4, prompt_bucket: int = 64,
+                 max_len: int | None = None, temperature: float = 0.0,
+                 eos_id: int | None = None, seed: int = 0):
+        self.cfg = cfg
+        self.params = params
+        self.n_slots = n_slots
+        self.bucket = prompt_bucket
+        self.max_len = max_len or cfg.max_seq
+        if self.bucket >= self.max_len:
+            raise ValueError("prompt_bucket must be < max_len")
+        self.temperature = temperature
+        self.eos_id = eos_id
+        self.cache = init_slot_cache(cfg, n_slots, self.max_len)
+        self._key = jax.random.PRNGKey(seed)
+        self._ids = itertools.count()
+        self.queue: deque = deque()
+        # host-side slot table
+        self.slot_req: list[int | None] = [None] * n_slots
+        self.slot_tokens: list[list[int]] = [[] for _ in range(n_slots)]
+        self.slot_remaining = np.zeros(n_slots, np.int32)
+        self.slot_prompt_len = np.zeros(n_slots, np.int32)
+        self.slot_waited = np.zeros(n_slots, np.int32)
+        self._submitted_step: dict[int, int] = {}
+        self.active = np.zeros(n_slots, bool)
+        self.last_tok = np.zeros(n_slots, np.int32)
+        self.steps = 0
+        self.tokens_emitted = 0
+
+        cfg_ = cfg
+
+        @jax.jit
+        def _prefill(params, cache, slot, prompt, plen, key):
+            """Write one request's prompt into ``slot`` and sample its
+            first token. prompt: (bucket,) padded; plen: real length."""
+            # gather the slot's slabs as a B=1 view
+            sub = {
+                "k": jax.lax.dynamic_slice_in_dim(cache["k"], slot, 1,
+                                                  axis=1),
+                "v": jax.lax.dynamic_slice_in_dim(cache["v"], slot, 1,
+                                                  axis=1),
+                "pos": jnp.zeros((1,), jnp.int32),
+            }
+            logits, sub = _slot_forward(
+                cfg_, params, prompt[None, :], sub, jnp.zeros((1,),
+                                                             jnp.int32))
+            first = _sample(logits[0, plen - 1][None, :], key,
+                            self.temperature)[0]
+            cache = dict(cache)
+            cache["k"] = jax.lax.dynamic_update_slice_in_dim(
+                cache["k"], sub["k"], slot, axis=1)
+            cache["v"] = jax.lax.dynamic_update_slice_in_dim(
+                cache["v"], sub["v"], slot, axis=1)
+            cache["pos"] = cache["pos"].at[slot].set(plen)
+            return first, cache
+
+        @jax.jit
+        def _decode(params, cache, last_tok, active, key):
+            """One token for every slot; inactive lanes masked."""
+            logits, new_cache = _slot_forward(
+                cfg_, params, last_tok[:, None], cache, cache["pos"])
+            keys = jax.random.split(key, self.n_slots)
+            nxt = jax.vmap(
+                lambda lg, k: _sample(lg[None, :], k,
+                                      self.temperature)[0]
+            )(logits[:, 0, :], keys)
+            nxt = jnp.where(active, nxt, 0)
+            new_cache["pos"] = cache["pos"] + active.astype(jnp.int32)
+            return nxt, new_cache
+
+        self._prefill_fn = _prefill
+        self._decode_fn = _decode
+
+    # -- request intake ---------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if not 0 < len(prompt) <= self.bucket:
+            raise ValueError(
+                f"prompt length {len(prompt)} not in (0, {self.bucket}]")
+        if max_new_tokens < 1:
+            # prefill always samples one token; a zero-budget request
+            # would still emit it and break caller-side accounting
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError("prompt + max_new_tokens exceeds max_len")
+        rid = next(self._ids)
+        self.queue.append((rid, prompt, int(max_new_tokens)))
+        self._submitted_step[rid] = self.steps
+        return rid
+
+    # -- the engine tick --------------------------------------------------
+
+    def _admit(self) -> None:
+        for slot in range(self.n_slots):
+            if self.active[slot] or not self.queue:
+                continue
+            rid, prompt, max_new = self.queue.popleft()
+            padded = np.zeros(self.bucket, np.int32)
+            padded[:len(prompt)] = prompt
+            self._key, sub = jax.random.split(self._key)
+            first, self.cache = self._prefill_fn(
+                self.params, self.cache, slot, jnp.asarray(padded),
+                len(prompt), sub)
+            first = int(first)
+            self.slot_req[slot] = rid
+            self.slot_tokens[slot] = [first]
+            self.slot_prompt_len[slot] = len(prompt)
+            self.slot_remaining[slot] = max_new - 1
+            self.slot_waited[slot] = (
+                self.steps - self._submitted_step.pop(rid, self.steps))
+            self.active[slot] = True
+            self.last_tok[slot] = first
+            self.tokens_emitted += 1
+
+    def _retire(self, slot: int) -> Completion:
+        comp = Completion(
+            request_id=self.slot_req[slot],
+            tokens=list(self.slot_tokens[slot]),
+            prompt_len=int(self.slot_prompt_len[slot]),
+            steps_waited=int(self.slot_waited[slot]),
+        )
+        self.slot_req[slot] = None
+        self.slot_tokens[slot] = []
+        self.active[slot] = False
+        return comp
+
+    def step(self) -> list[Completion]:
+        """Admit waiting requests, decode one token for every active
+        slot, retire finished requests. Returns completions."""
+        self._admit()
+        done: list[Completion] = []
+        # retire prefill-only requests (max_new_tokens == 1) and EOS
+        for slot in range(self.n_slots):
+            if self.active[slot] and (
+                    self.slot_remaining[slot] <= 0
+                    or (self.eos_id is not None
+                        and self.last_tok[slot] == self.eos_id)):
+                done.append(self._retire(slot))
+        if not self.active.any():
+            self.steps += 1
+            return done
+        self._key, sub = jax.random.split(self._key)
+        nxt, self.cache = self._decode_fn(
+            self.params, self.cache, jnp.asarray(self.last_tok),
+            jnp.asarray(self.active), sub)
+        nxt = np.asarray(nxt)
+        for slot in range(self.n_slots):
+            if not self.active[slot]:
+                continue
+            tok = int(nxt[slot])
+            self.slot_tokens[slot].append(tok)
+            self.last_tok[slot] = tok
+            self.slot_remaining[slot] -= 1
+            self.tokens_emitted += 1
+            if (self.slot_remaining[slot] <= 0
+                    or (self.eos_id is not None and tok == self.eos_id)):
+                done.append(self._retire(slot))
+        self.steps += 1
+        return done
+
+    def has_work(self) -> bool:
+        return bool(self.queue) or bool(self.active.any())
+
+    def stats(self) -> dict:
+        return {
+            "steps": self.steps,
+            "active_slots": int(self.active.sum()),
+            "queued": len(self.queue),
+            "tokens_emitted": self.tokens_emitted,
+        }
+
+
+def make_continuous_serve_step(engine: ContinuousBatcher,
+                               next_requests=None):
+    """Job-shaped wrapper: one engine tick per step (one token across
+    slots — a quantum-sized unit, so the credit scheduler interleaves
+    serving with training at token granularity). ``next_requests(step)``
+    optionally feeds new (prompt, max_new) pairs each tick. The
+    ``tokens`` metric is the tick's DELTA of the engine's emitted
+    counter, so the TOKENS ledger slot is exact goodput."""
+
+    def serve_step(state):
+        step = int(state["step"])
+        if next_requests is not None:
+            for prompt, max_new in next_requests(step):
+                engine.submit(prompt, max_new)
+        before = engine.tokens_emitted
+        done = engine.step()
+        state = {"step": step + 1,
+                 "completed": state["completed"] + len(done)}
+        return state, {"tokens": engine.tokens_emitted - before}
+
+    return serve_step

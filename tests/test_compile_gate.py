@@ -53,6 +53,28 @@ def test_compile_attribution_per_job():
     assert total >= 3
 
 
+def test_compile_time_excluded_from_runtime_charge():
+    """First-dispatch jit cost must not be billed as device time — a
+    tenant whose first quantum compiles for seconds would sink into
+    credit debt and starve behind its neighbors (found by the
+    co-located continuous-batching drive). Compile spend lives in its
+    own counters; DEVICE_TIME_NS reflects execution only."""
+    be = TpuBackend()
+    part = Partition("p", source=be)
+    job = part.add_job(_distinct_program_job("firstcomp", 3.14, size=96))
+    part.run(max_rounds=1)  # the compiling quantum
+    ctx = job.contexts[0]
+    dev = int(ctx.counters[Counter.DEVICE_TIME_NS])
+    comp = int(ctx.counters[Counter.COMPILE_TIME_NS])
+    assert comp > 0
+    # execution of a 96x96 tanh is far cheaper than its compilation;
+    # had compile leaked into the runtime charge, dev would dwarf it
+    assert dev < comp, (dev, comp)
+    # and the measured step-time estimate stays execution-sized, so
+    # the scheduler's quantum->steps conversion isn't poisoned either
+    assert ctx.avg_step_ns < comp
+
+
 def test_cached_program_does_not_recharge():
     """Steps after the first reuse the compiled program: compile
     counters stop growing (the cache hit is visible as absence)."""
