@@ -8,6 +8,12 @@ from pbs_tpu.runtime.events import EventBus, EventChannel, Virq
 from pbs_tpu.runtime.executor import Executor, quantum_to_steps
 from pbs_tpu.runtime.hooks import HookError, HookRegistry
 from pbs_tpu.runtime.image import boot_job, image_workload, save_image
+from pbs_tpu.runtime.paging import (
+    PagingError,
+    page_in_job,
+    page_out_job,
+    register_paging_reclaim,
+)
 from pbs_tpu.runtime.memory import (
     MemoryAccount,
     MemoryManager,
@@ -64,6 +70,7 @@ __all__ = [
     "MemoryAccount",
     "MemoryManager",
     "OutOfDeviceMemory",
+    "PagingError",
     "SharedRegion",
     "Virq",
     "Job",
@@ -81,7 +88,10 @@ __all__ = [
     "save_image",
     "map_grant",
     "nbytes_of",
+    "page_in_job",
+    "page_out_job",
     "quantum_to_steps",
+    "register_paging_reclaim",
     "set_policy",
     "write_crash_dump",
     "xsm_check",

@@ -143,6 +143,11 @@ class Job:
         from pbs_tpu.obs.console import Console
 
         self.console = Console()
+        # xenpaging analog (runtime.paging): while non-None, the
+        # device leaves of ``state`` live in host memory and the job
+        # must stay BLOCKED; wake_job restores transparently.
+        self.paged = None
+        self.paged_bytes = 0
 
     def log(self, line: str) -> int:
         """Workload-side console write (the guest printk)."""

@@ -167,26 +167,33 @@ class MemoryManager:
     def balloon(self, want_bytes: int) -> int:
         """Reclaim until ``want_bytes`` are free (or callbacks are
         exhausted). Returns bytes actually freed. Biggest consumers
-        first, like the balloon targeting policy."""
+        first, like the balloon targeting policy.
+
+        A callback that frees nothing is skipped for the REST OF THIS
+        CALL only — never unregistered. "Nothing to give right now"
+        (a runnable tenant the pager must not evict, a cache already
+        empty) is a transient state; dropping the hook forever would
+        silently kill the reclaim path the first time it missed."""
         freed_total = 0
+        asked: set[str] = set()
         while self.free_bytes() < want_bytes:
             with self._lock:
                 candidates = sorted(
                     (a for a in self._accounts.values()
-                     if a.owner in self._reclaim and a.used_bytes > 0),
+                     if a.owner in self._reclaim and a.used_bytes > 0
+                     and a.owner not in asked),
                     key=lambda a: -a.used_bytes)
             if not candidates:
                 break
             acct = candidates[0]
             need = want_bytes - self.free_bytes()
             fn = self._reclaim.get(acct.owner)
-            if fn is None:  # concurrently dropped as uncooperative
+            if fn is None:  # concurrently unregistered
+                asked.add(acct.owner)
                 continue
+            asked.add(acct.owner)
             freed = int(fn(need))
             if freed <= 0:
-                # Uncooperative: stop asking it this round.
-                with self._lock:
-                    self._reclaim.pop(acct.owner, None)
                 continue
             self.release(acct.owner, freed)
             freed_total += freed

@@ -1,0 +1,190 @@
+"""HBM paging: evict parked tenants' device state to host memory.
+
+Reference: xenpaging (``tools/xenpaging``) pages guest memory out to a
+dom0 file under pressure and faults it back transparently on access —
+the mechanism that lets more guests exist than RAM strictly allows.
+The TPU analog is stronger, not weaker: a job's state is only touched
+at step boundaries and a BLOCKED job cannot be dispatched, so paging a
+sleeping tenant is exact by construction — no dirty tracking, no fault
+path, just whole-state eviction and restore. A parked tenant's
+params/optimizer slabs are pure HBM cost; paging them means the chip
+multiplexes more tenants than fit in HBM simultaneously.
+
+Two entry points:
+
+- explicit: ``page_out_job``/``page_in_job`` (``pbst``-driveable policy
+  decisions, like ``xenpaging``'s target file size);
+- automatic: ``register_paging_reclaim`` hooks a job into the
+  MemoryManager's balloon path, so ``claim_or_balloon`` for a NEW
+  tenant transparently pages out sleeping neighbors, biggest first —
+  admission pressure is what xenpaging exists for.
+
+Shardings are captured per leaf at page-out and reapplied at page-in,
+so multi-device states restore onto the same mesh layout.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any
+
+import numpy as np
+
+from pbs_tpu.obs.perfc import perfc
+
+if TYPE_CHECKING:
+    from pbs_tpu.runtime.job import Job
+    from pbs_tpu.runtime.partition import Partition
+
+
+class PagingError(RuntimeError):
+    pass
+
+
+def _is_device_array(leaf: Any) -> bool:
+    import jax
+
+    return isinstance(leaf, jax.Array)
+
+
+def _evict_state(state: Any) -> tuple[Any, list, int]:
+    """(host_state_placeholder, paged_leaves, bytes_freed): device
+    leaves become index markers; the paged list holds (np array,
+    sharding) pairs for restore."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    paged: list[tuple[np.ndarray, Any]] = []
+    out_leaves = []
+    freed = 0
+    for leaf in leaves:
+        if _is_device_array(leaf):
+            sharding = leaf.sharding
+            host = np.asarray(jax.device_get(leaf))
+            freed += int(leaf.nbytes)
+            out_leaves.append(_PagedLeaf(len(paged)))
+            paged.append((host, sharding))
+        else:
+            out_leaves.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out_leaves), paged, freed
+
+
+class _PagedLeaf:
+    """Marker standing where a device array lived (never dispatched:
+    the owning job is BLOCKED while paged)."""
+
+    __slots__ = ("index",)
+
+    def __init__(self, index: int):
+        self.index = index
+
+    def __repr__(self) -> str:  # surfaces clearly if ever leaked
+        return f"<paged-out leaf #{self.index}>"
+
+
+def _restore_state(state: Any, paged: list) -> Any:
+    import jax
+
+    live = set(jax.devices())
+    leaves, treedef = jax.tree_util.tree_flatten(
+        state, is_leaf=lambda x: isinstance(x, _PagedLeaf))
+    out = []
+    for leaf in leaves:
+        if isinstance(leaf, _PagedLeaf):
+            host, sharding = paged[leaf.index]
+            devs = getattr(sharding, "device_set", None)
+            if devs is not None and not set(devs) <= live:
+                # ONLY the devices-gone case falls back to default
+                # placement (post-restart restore on a different
+                # topology); any other device_put failure — real HBM
+                # exhaustion especially — must propagate so the job
+                # stays asleep+paged instead of waking mislaid.
+                out.append(jax.device_put(host))
+            else:
+                out.append(jax.device_put(host, sharding))
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _sleeping(job: "Job") -> bool:
+    from pbs_tpu.runtime.job import ContextState
+
+    return {c.state for c in job.contexts} <= {
+        ContextState.BLOCKED, ContextState.DONE, ContextState.FAILED}
+
+
+def _do_page_out(job: "Job", pressure: bool) -> int:
+    """Shared eviction body (explicit + balloon paths); the caller
+    decides policy (raise vs skip) and accounting."""
+    new_state, paged, freed = _evict_state(job.state)
+    if freed == 0:
+        return 0
+    job.state = new_state
+    job.paged = paged
+    job.paged_bytes = freed
+    perfc.incr("paging_out_bytes", freed)
+    job.console.write(
+        f"paged out{' under pressure' if pressure else ''}: "
+        f"{freed} bytes to host")
+    return freed
+
+
+def page_out_job(partition: "Partition", job: "Job") -> int:
+    """Evict ``job``'s device state to host memory; returns bytes
+    freed. The job must be asleep (BLOCKED) — it is un-runnable until
+    :func:`page_in_job` (which ``Partition.wake_job`` invokes
+    automatically). Idempotent: paging a paged job frees 0."""
+    if getattr(job, "paged", None) is not None:
+        return 0
+    if not _sleeping(job):
+        raise PagingError(
+            f"job {job.name!r} is runnable; sleep it before paging "
+            "(a dispatched paged state would fault)")
+    freed = _do_page_out(job, pressure=False)
+    if freed and partition.memory is not None:
+        partition.memory.release(job.name, freed)
+    return freed
+
+
+def page_in_job(partition: "Partition", job: "Job") -> int:
+    """Restore a paged job's device state (claiming its HBM back,
+    ballooning/paging others if needed). Raises OutOfDeviceMemory when
+    the chip genuinely cannot host it — the job stays paged+asleep."""
+    paged = getattr(job, "paged", None)
+    if paged is None:
+        return 0
+    nbytes = job.paged_bytes
+    if partition.memory is not None:
+        # may balloon (and thereby page out) other sleeping tenants
+        partition.memory.claim_or_balloon(job.name, nbytes)
+    try:
+        job.state = _restore_state(job.state, paged)
+    except BaseException:
+        if partition.memory is not None:
+            partition.memory.release(job.name, nbytes)
+        raise
+    job.paged = None
+    job.paged_bytes = 0
+    perfc.incr("paging_in_bytes", nbytes)
+    job.console.write(f"paged in: {nbytes} bytes to device")
+    return nbytes
+
+
+def register_paging_reclaim(partition: "Partition", job: "Job") -> None:
+    """Hook ``job`` into the balloon path: under admission pressure,
+    ``claim_or_balloon`` pages it out IF it is asleep at that moment
+    (a runnable job reports 0 and the balloon moves on). The released
+    accounting is handled by the balloon itself."""
+    if partition.memory is None:
+        raise PagingError("partition has no MemoryManager")
+
+    def _reclaim(need: int) -> int:
+        if getattr(job, "paged", None) is not None:
+            return 0
+        if not _sleeping(job):
+            return 0  # running tenants are never paged out from under;
+            # "nothing right now" is transient — balloon() skips this
+            # call only, never unregisters the hook
+        return _do_page_out(job, pressure=True)  # balloon() releases
+
+    partition.memory.register_reclaim(job.name, _reclaim)

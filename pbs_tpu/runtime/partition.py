@@ -287,6 +287,13 @@ class Partition:
     def wake_job(self, job: Job, notify: bool = True) -> None:
         from pbs_tpu.runtime.hooks import HookError
 
+        if getattr(job, "paged", None) is not None:
+            # xenpaging fault path: touching a paged tenant restores
+            # its device state first (claiming HBM back; may raise
+            # OutOfDeviceMemory, leaving the job asleep+paged).
+            from pbs_tpu.runtime.paging import page_in_job
+
+            page_in_job(self, job)
         changed = False
         for ctx in job.contexts:
             if ctx.state is ContextState.BLOCKED:

@@ -1,0 +1,150 @@
+"""HBM paging (xenpaging analog): parked tenants leave the device.
+
+Reference behavior matched: ``tools/xenpaging`` pages guest memory to
+dom0 storage under pressure and faults it back on access — here a
+BLOCKED job's device arrays move to host memory (releasing its HBM
+account) and restore transparently on wake, and the balloon path pages
+sleeping neighbors automatically when a new tenant's claim needs
+room."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pbs_tpu.runtime import (
+    Job,
+    MemoryManager,
+    OutOfDeviceMemory,
+    PagingError,
+    Partition,
+    page_in_job,
+    page_out_job,
+    register_paging_reclaim,
+)
+from pbs_tpu.telemetry import Counter, SimBackend, SimProfile
+from pbs_tpu.telemetry.source import TpuBackend
+
+MB = 1 << 20
+
+
+def _train_job(name, n=128, max_steps=50):
+    @jax.jit
+    def step(x):
+        return jnp.tanh(x) + 0.01
+
+    x0 = jnp.zeros((n, n), jnp.float32)
+    step(x0).block_until_ready()
+    return Job(name, step_fn=step, state=x0, max_steps=max_steps)
+
+
+def test_page_out_in_round_trip_exact():
+    part = Partition("p", source=TpuBackend())
+    job = part.add_job(_train_job("t"))
+    part.run(max_rounds=3)
+    before = np.asarray(job.state).copy()
+    steps_before = job.steps_retired()
+
+    part.sleep_job(job)
+    freed = page_out_job(part, job)
+    assert freed == before.nbytes
+    assert job.paged is not None
+    # state is host-resident markers now; counters untouched
+    assert job.steps_retired() == steps_before
+
+    part.wake_job(job)  # transparent fault-back
+    assert job.paged is None
+    np.testing.assert_array_equal(np.asarray(job.state), before)
+    part.run(max_rounds=3)
+    assert job.steps_retired() > steps_before  # trains on, bit-exact
+
+
+def test_runnable_job_refuses_page_out():
+    part = Partition("p", source=TpuBackend())
+    job = part.add_job(_train_job("r"))
+    with pytest.raises(PagingError, match="sleep it"):
+        page_out_job(part, job)
+
+
+def test_paging_releases_and_reclaims_accounting():
+    mem = MemoryManager(capacity_bytes=2 * MB)
+    part = Partition("p", source=TpuBackend(), memory=mem)
+    job = part.add_job(_train_job("acct", n=256))  # 256KB claim
+    used0 = mem.account("acct").used_bytes
+    assert used0 >= 256 * 256 * 4
+    part.sleep_job(job)
+    freed = page_out_job(part, job)
+    assert mem.account("acct").used_bytes == used0 - freed
+    part.wake_job(job)
+    assert mem.account("acct").used_bytes == used0
+
+
+def test_admission_pressure_pages_out_sleeping_neighbor():
+    """The xenpaging raison d'etre: a new tenant fits because a parked
+    one gets paged, automatically, through the balloon path."""
+    mem = MemoryManager(capacity_bytes=300 * 1024)
+    part = Partition("p", source=TpuBackend(), memory=mem)
+    a = part.add_job(_train_job("a", n=256))  # 256KB of 300KB
+    register_paging_reclaim(part, a)
+    part.sleep_job(a)  # parked
+
+    b = part.add_job(_train_job("b", n=256))  # would not fit...
+    assert a.paged is not None  # ...so the sleeper got paged out
+    part.run(max_rounds=3)
+    assert b.steps_retired() > 0
+
+    # waking A now must fail loudly — B holds the chip
+    with pytest.raises(OutOfDeviceMemory):
+        part.wake_job(a)
+    assert a.paged is not None  # still safe, still asleep
+
+    part.remove_job(b)
+    part.wake_job(a)  # now it fits again
+    assert a.paged is None
+    part.run(max_rounds=3)
+    assert a.error is None
+
+
+def test_balloon_skips_runnable_jobs():
+    mem = MemoryManager(capacity_bytes=300 * 1024)
+    part = Partition("p", source=TpuBackend(), memory=mem)
+    a = part.add_job(_train_job("a", n=256))
+    register_paging_reclaim(part, a)  # registered but RUNNABLE
+    with pytest.raises(OutOfDeviceMemory):
+        part.add_job(_train_job("b", n=256))
+    assert a.paged is None  # never paged out from under a runnable job
+
+
+def test_reclaim_hook_survives_a_miss():
+    """One balloon pass while the tenant is runnable must NOT
+    unregister its paging hook — 'nothing right now' is transient
+    (review finding: the balloon used to drop 0-returning callbacks
+    forever, silently killing admission-pressure paging)."""
+    mem = MemoryManager(capacity_bytes=300 * 1024)
+    part = Partition("p", source=TpuBackend(), memory=mem)
+    a = part.add_job(_train_job("a", n=256))
+    register_paging_reclaim(part, a)
+    # miss #1: a is runnable, the claim fails, hook returns 0
+    with pytest.raises(OutOfDeviceMemory):
+        part.add_job(_train_job("b", n=256))
+    # now park a: the SAME hook must still fire for the next claim
+    part.sleep_job(a)
+    c = part.add_job(_train_job("c", n=256))
+    assert a.paged is not None  # paged via the surviving hook
+    part.run(max_rounds=2)
+    assert c.steps_retired() > 0
+
+
+def test_sim_jobs_page_as_noop():
+    """A SimBackend job has no device arrays: paging frees 0 and wake
+    stays cheap — the API is uniform across backends."""
+    be = SimBackend()
+    be.register("s", SimProfile.steady(step_time_ns=1_000_000))
+    part = Partition("p", source=be)
+    job = part.add_job(Job("s", max_steps=100))
+    part.sleep_job(job)
+    assert page_out_job(part, job) == 0
+    assert job.paged is None
+    part.wake_job(job)
+    part.run(max_rounds=3)
+    assert job.steps_retired() > 0
