@@ -14,6 +14,7 @@ from pbs_tpu.runtime.paging import (
     page_out_job,
     register_paging_reclaim,
 )
+from pbs_tpu.runtime.sharing import SharedWeights, WeightsRegistry
 from pbs_tpu.runtime.memory import (
     MemoryAccount,
     MemoryManager,
@@ -72,6 +73,8 @@ __all__ = [
     "OutOfDeviceMemory",
     "PagingError",
     "SharedRegion",
+    "SharedWeights",
+    "WeightsRegistry",
     "Virq",
     "Job",
     "Partition",

@@ -148,6 +148,7 @@ class Job:
         # must stay BLOCKED; wake_job restores transparently.
         self.paged = None
         self.paged_bytes = 0
+        self.paged_acct_bytes = 0
 
     def log(self, line: str) -> int:
         """Workload-side console write (the guest printk)."""

@@ -151,10 +151,15 @@ class MemoryManager:
             acct.claims += 1
             perfc.incr("mem_claims")
 
-    def release(self, owner: str, nbytes: int) -> None:
+    def release(self, owner: str, nbytes: int) -> int:
+        """Returns the bytes actually deducted (the account clamps at
+        zero — callers re-claiming later must re-claim THIS amount,
+        not their request, or the ledger inflates)."""
         with self._lock:
             acct = self._accounts[owner]
-            acct.used_bytes = max(0, acct.used_bytes - int(nbytes))
+            deducted = min(acct.used_bytes, max(0, int(nbytes)))
+            acct.used_bytes -= deducted
+            return deducted
 
     # -- ballooning (cooperative reclaim) --------------------------------
 
@@ -169,19 +174,20 @@ class MemoryManager:
         exhausted). Returns bytes actually freed. Biggest consumers
         first, like the balloon targeting policy.
 
-        A callback that frees nothing is skipped for the REST OF THIS
-        CALL only — never unregistered. "Nothing to give right now"
-        (a runnable tenant the pager must not evict, a cache already
-        empty) is a transient state; dropping the hook forever would
-        silently kill the reclaim path the first time it missed."""
+        A callback that frees nothing — or whose reported freeing does
+        not actually grow free capacity — is skipped for the REST OF
+        THIS CALL only, never unregistered ("nothing to give right
+        now" is transient). A callback that DID free stays eligible,
+        so chunked reclaimers (a cache evicting 100 MB per ask) are
+        re-asked until the target is met or they dry up."""
         freed_total = 0
-        asked: set[str] = set()
+        skip: set[str] = set()
         while self.free_bytes() < want_bytes:
             with self._lock:
                 candidates = sorted(
                     (a for a in self._accounts.values()
                      if a.owner in self._reclaim and a.used_bytes > 0
-                     and a.owner not in asked),
+                     and a.owner not in skip),
                     key=lambda a: -a.used_bytes)
             if not candidates:
                 break
@@ -189,15 +195,19 @@ class MemoryManager:
             need = want_bytes - self.free_bytes()
             fn = self._reclaim.get(acct.owner)
             if fn is None:  # concurrently unregistered
-                asked.add(acct.owner)
+                skip.add(acct.owner)
                 continue
-            asked.add(acct.owner)
+            free_before = self.free_bytes()
             freed = int(fn(need))
-            if freed <= 0:
-                continue
-            self.release(acct.owner, freed)
-            freed_total += freed
-            perfc.incr("mem_balloon_freed_bytes", freed)
+            if freed > 0:
+                deducted = self.release(acct.owner, freed)
+                freed_total += deducted
+                perfc.incr("mem_balloon_freed_bytes", deducted)
+            if freed <= 0 or self.free_bytes() <= free_before:
+                # dry, uncooperative, or claims bytes the ledger never
+                # charged it for — either way, asking again this call
+                # cannot make progress
+                skip.add(acct.owner)
         return freed_total
 
     def claim_or_balloon(self, owner: str, nbytes: int) -> None:

@@ -49,15 +49,21 @@ def _is_device_array(leaf: Any) -> bool:
 def _evict_state(state: Any) -> tuple[Any, list, int]:
     """(host_state_placeholder, paged_leaves, bytes_freed): device
     leaves become index markers; the paged list holds (np array,
-    sharding) pairs for restore."""
+    sharding) pairs for restore. Leaves belonging to a PUBLISHED
+    shared weight set (runtime.sharing) are left in place: evicting a
+    refcounted set through one tenant and restoring it as a private
+    copy would silently break the dedup (and the tenant's account
+    never paid for those bytes)."""
     import jax
+
+    from pbs_tpu.runtime.sharing import is_shared_leaf
 
     leaves, treedef = jax.tree_util.tree_flatten(state)
     paged: list[tuple[np.ndarray, Any]] = []
     out_leaves = []
     freed = 0
     for leaf in leaves:
-        if _is_device_array(leaf):
+        if _is_device_array(leaf) and not is_shared_leaf(leaf):
             sharding = leaf.sharding
             host = np.asarray(jax.device_get(leaf))
             freed += int(leaf.nbytes)
@@ -113,15 +119,22 @@ def _sleeping(job: "Job") -> bool:
         ContextState.BLOCKED, ContextState.DONE, ContextState.FAILED}
 
 
-def _do_page_out(job: "Job", pressure: bool) -> int:
+def _do_page_out(job: "Job", pressure: bool,
+                 acct_used: int | None = None) -> int:
     """Shared eviction body (explicit + balloon paths); the caller
-    decides policy (raise vs skip) and accounting."""
+    decides policy (raise vs skip) and accounting. ``acct_used`` (the
+    job's CURRENT ledger balance, when accounting is on) bounds the
+    re-claim at page-in: the account may hold less than the device
+    bytes (declared mem_bytes, post-admission growth) and the round
+    trip must not inflate it."""
     new_state, paged, freed = _evict_state(job.state)
     if freed == 0:
         return 0
     job.state = new_state
     job.paged = paged
     job.paged_bytes = freed
+    job.paged_acct_bytes = (freed if acct_used is None
+                            else min(freed, acct_used))
     perfc.incr("paging_out_bytes", freed)
     job.console.write(
         f"paged out{' under pressure' if pressure else ''}: "
@@ -140,7 +153,10 @@ def page_out_job(partition: "Partition", job: "Job") -> int:
         raise PagingError(
             f"job {job.name!r} is runnable; sleep it before paging "
             "(a dispatched paged state would fault)")
-    freed = _do_page_out(job, pressure=False)
+    acct_used = None
+    if partition.memory is not None:
+        acct_used = partition.memory.account(job.name).used_bytes
+    freed = _do_page_out(job, pressure=False, acct_used=acct_used)
     if freed and partition.memory is not None:
         partition.memory.release(job.name, freed)
     return freed
@@ -154,17 +170,22 @@ def page_in_job(partition: "Partition", job: "Job") -> int:
     if paged is None:
         return 0
     nbytes = job.paged_bytes
+    # Re-claim exactly what the ACCOUNT gave up at page-out (which may
+    # be less than the device bytes) — claiming the device size would
+    # inflate the ledger on every round trip (review finding).
+    acct_bytes = getattr(job, "paged_acct_bytes", nbytes)
     if partition.memory is not None:
         # may balloon (and thereby page out) other sleeping tenants
-        partition.memory.claim_or_balloon(job.name, nbytes)
+        partition.memory.claim_or_balloon(job.name, acct_bytes)
     try:
         job.state = _restore_state(job.state, paged)
     except BaseException:
         if partition.memory is not None:
-            partition.memory.release(job.name, nbytes)
+            partition.memory.release(job.name, acct_bytes)
         raise
     job.paged = None
     job.paged_bytes = 0
+    job.paged_acct_bytes = 0
     perfc.incr("paging_in_bytes", nbytes)
     job.console.write(f"paged in: {nbytes} bytes to device")
     return nbytes
@@ -185,6 +206,8 @@ def register_paging_reclaim(partition: "Partition", job: "Job") -> None:
             return 0  # running tenants are never paged out from under;
             # "nothing right now" is transient — balloon() skips this
             # call only, never unregisters the hook
-        return _do_page_out(job, pressure=True)  # balloon() releases
+        acct_used = partition.memory.account(job.name).used_bytes
+        return _do_page_out(job, pressure=True,
+                            acct_used=acct_used)  # balloon() releases
 
     partition.memory.register_reclaim(job.name, _reclaim)
