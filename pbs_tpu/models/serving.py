@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import time
 from collections import deque
 from typing import Any
 
@@ -130,7 +131,9 @@ class Completion:
     request_id: int
     tokens: list[int]
     prompt_len: int
-    steps_waited: int  # decode steps between submit and first token
+    steps_waited: int  # engine ticks queued before admission
+    ttft_s: float = 0.0  # wall time submit -> first token
+    latency_s: float = 0.0  # wall time submit -> completion
 
 
 class ContinuousBatcher:
@@ -165,11 +168,18 @@ class ContinuousBatcher:
         self.slot_remaining = np.zeros(n_slots, np.int32)
         self.slot_prompt_len = np.zeros(n_slots, np.int32)
         self.slot_waited = np.zeros(n_slots, np.int32)
+        self.slot_ttft = np.zeros(n_slots, np.float64)
+        self.slot_submit_t = np.zeros(n_slots, np.float64)
         self._submitted_step: dict[int, int] = {}
+        self._submitted_t: dict[int, float] = {}
+        # completed-request latency record (SLO surface): bounded
+        self._ttfts: deque = deque(maxlen=1024)
+        self._latencies: deque = deque(maxlen=1024)
         self.active = np.zeros(n_slots, bool)
         self.last_tok = np.zeros(n_slots, np.int32)
         self.steps = 0
         self.tokens_emitted = 0
+        self.requests_completed = 0
 
         cfg_ = cfg
 
@@ -231,6 +241,7 @@ class ContinuousBatcher:
         rid = next(self._ids)
         self.queue.append((rid, prompt, int(max_new_tokens)))
         self._submitted_step[rid] = self.steps
+        self._submitted_t[rid] = time.monotonic()
         return rid
 
     # -- the engine tick --------------------------------------------------
@@ -253,17 +264,28 @@ class ContinuousBatcher:
             self.slot_remaining[slot] = max_new - 1
             self.slot_waited[slot] = (
                 self.steps - self._submitted_step.pop(rid, self.steps))
+            now = time.monotonic()
+            t_submit = self._submitted_t.pop(rid, now)
+            self.slot_submit_t[slot] = t_submit
+            self.slot_ttft[slot] = now - t_submit  # first token sampled
             self.active[slot] = True
             self.last_tok[slot] = first
             self.tokens_emitted += 1
 
     def _retire(self, slot: int) -> Completion:
+        lat = time.monotonic() - float(self.slot_submit_t[slot])
+        ttft = float(self.slot_ttft[slot])
         comp = Completion(
             request_id=self.slot_req[slot],
             tokens=list(self.slot_tokens[slot]),
             prompt_len=int(self.slot_prompt_len[slot]),
             steps_waited=int(self.slot_waited[slot]),
+            ttft_s=ttft,
+            latency_s=lat,
         )
+        self._ttfts.append(ttft)
+        self._latencies.append(lat)
+        self.requests_completed += 1
         self.slot_req[slot] = None
         self.slot_tokens[slot] = []
         self.active[slot] = False
@@ -306,12 +328,29 @@ class ContinuousBatcher:
     def has_work(self) -> bool:
         return bool(self.queue) or bool(self.active.any())
 
+    @staticmethod
+    def _pct(values, q: float) -> float:
+        if not values:
+            return 0.0
+        v = sorted(values)
+        return v[min(len(v) - 1, int(q * len(v)))]
+
     def stats(self) -> dict:
+        """Engine + SLO surface: time-to-first-token and completion
+        latency percentiles over the last 1024 completions — the
+        numbers a serving tenant's latency SLO is written against
+        (and what the feedback policy's BOOST class protects)."""
         return {
             "steps": self.steps,
             "active_slots": int(self.active.sum()),
             "queued": len(self.queue),
             "tokens_emitted": self.tokens_emitted,
+            "completed": self.requests_completed,
+            "window": len(self._latencies),
+            "ttft_p50_s": round(self._pct(self._ttfts, 0.50), 6),
+            "ttft_p99_s": round(self._pct(self._ttfts, 0.99), 6),
+            "latency_p50_s": round(self._pct(self._latencies, 0.50), 6),
+            "latency_p99_s": round(self._pct(self._latencies, 0.99), 6),
         }
 
 

@@ -137,6 +137,19 @@ def test_submit_validation(model):
         eng.submit([1, 2], max_new_tokens=0)  # prefill would emit 1
 
 
+def test_slo_stats_populate(model):
+    cfg, params = model
+    eng = ContinuousBatcher(cfg, params, n_slots=2, prompt_bucket=16)
+    for i in range(3):
+        eng.submit([1 + i], max_new_tokens=3)
+    done = _drain(eng)
+    st = eng.stats()
+    assert st["completed"] == 3
+    assert 0 < st["ttft_p50_s"] <= st["latency_p99_s"]
+    for c in done.values():
+        assert 0 < c.ttft_s <= c.latency_s
+
+
 def test_job_shaped_serve_step(model):
     """The engine as a schedulable tenant: one token per quantum."""
     cfg, params = model
