@@ -95,9 +95,15 @@ def _slot_forward(cfg: TransformerConfig, params: dict, tokens: jax.Array,
         v = (h @ lp["wv"].astype(dt)).reshape(B, S, nkv, hd)
         q = _rope_rows(q, cos, sin)
         k = _rope_rows(k, cos, sin)
-        # scatter each row's S new entries at its own cursor
-        ck = ck.at[jnp.arange(B)[:, None], abs_pos].set(k)
-        cv = cv.at[jnp.arange(B)[:, None], abs_pos].set(v)
+        # Each row writes S CONTIGUOUS entries at its own cursor: a
+        # vmapped dynamic_update_slice, not a scatter — GSPMD
+        # partitions DUS on an unsharded axis natively, where the
+        # equivalent scatter made tp>2 compiles blow up.
+        write = jax.vmap(
+            lambda slab, new, p: jax.lax.dynamic_update_slice(
+                slab, new, (p, 0, 0)))
+        ck = write(ck, k, row_pos)
+        cv = write(cv, v, row_pos)
         # attention with per-row causal horizon
         qg = q.reshape(B, S, nkv, group, hd).transpose(0, 2, 3, 1, 4)
         kt = ck.transpose(0, 2, 1, 3)  # (B, nkv, T, hd)
@@ -148,9 +154,9 @@ class ContinuousBatcher:
     def __init__(self, cfg: TransformerConfig, params: dict,
                  n_slots: int = 4, prompt_bucket: int = 64,
                  max_len: int | None = None, temperature: float = 0.0,
-                 eos_id: int | None = None, seed: int = 0):
+                 eos_id: int | None = None, seed: int = 0,
+                 mesh=None):
         self.cfg = cfg
-        self.params = params
         self.n_slots = n_slots
         self.bucket = prompt_bucket
         self.max_len = max_len or cfg.max_seq
@@ -158,7 +164,36 @@ class ContinuousBatcher:
             raise ValueError("prompt_bucket must be < max_len")
         self.temperature = temperature
         self.eos_id = eos_id
-        self.cache = init_slot_cache(cfg, n_slots, self.max_len)
+        self.mesh = mesh
+        cache = init_slot_cache(cfg, n_slots, self.max_len)
+        if mesh is not None:
+            # Tensor-parallel serving by PLACEMENT (the GSPMD recipe):
+            # shard params Megatron-style and the KV slabs over the kv
+            # heads; the two jitted programs below are unchanged — XLA
+            # propagates the shardings and inserts the collectives.
+            import jax.sharding as jsh
+
+            from pbs_tpu.parallel.sharding import shard_params
+
+            if "tp" not in mesh.axis_names:
+                raise ValueError(
+                    f"serving mesh needs a 'tp' axis; got "
+                    f"{mesh.axis_names}")
+            if cfg.n_kv_heads % mesh.shape["tp"]:
+                raise ValueError(
+                    f"n_kv_heads={cfg.n_kv_heads} not divisible by "
+                    f"tp={mesh.shape['tp']}")
+            params = shard_params(params, mesh, cfg)
+            kv = jsh.NamedSharding(
+                mesh, jsh.PartitionSpec(None, None, None, "tp", None))
+            rep = jsh.NamedSharding(mesh, jsh.PartitionSpec(None))
+            cache = {
+                "k": jax.device_put(cache["k"], kv),
+                "v": jax.device_put(cache["v"], kv),
+                "pos": jax.device_put(cache["pos"], rep),
+            }
+        self.params = params
+        self.cache = cache
         self._key = jax.random.PRNGKey(seed)
         self._ids = itertools.count()
         self.queue: deque = deque()
@@ -224,6 +259,17 @@ class ContinuousBatcher:
 
         self._prefill_fn = _prefill
         self._decode_fn = _decode
+        # Warm both programs NOW: compilation belongs to engine
+        # construction, not to the first unlucky request's TTFT — a
+        # multi-second jit landing in the SLO percentiles would read
+        # as a false violation for the next ~1024 completions.
+        wk = jax.random.PRNGKey(0)
+        _prefill(self.params, self.cache, 0,
+                 jnp.zeros((self.bucket,), jnp.int32), 1, wk)
+        _decode(self.params, self.cache,
+                jnp.zeros((n_slots,), jnp.int32),
+                jnp.zeros((n_slots,), bool), wk)  # results discarded:
+        # self.cache is untouched (jit is functional)
 
     # -- request intake ---------------------------------------------------
 

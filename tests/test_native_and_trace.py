@@ -7,6 +7,7 @@ written pages concurrently, x86.c:228-312)."""
 
 import multiprocessing as mp
 import os
+import time
 
 import numpy as np
 import pytest
@@ -67,13 +68,25 @@ def test_seqlock_cross_process_consistency():
         p.start()
         torn = 0
         reads = 0
+        stalls = 0
         while p.is_alive():
-            snap = led.snapshot(0)
+            try:
+                snap = led.snapshot(0)
+            except RuntimeError:
+                # Retries exhausted: the WRITER process is descheduled
+                # mid-write (odd version) — inherent to seqlocks under
+                # CPU starvation, and exactly what a production
+                # monitor does here: back off and try again. Only
+                # CONSISTENCY failures (torn data) fail the test.
+                stalls += 1
+                time.sleep(0.001)
+                continue
             reads += 1
             if snap[Counter.STEPS_RETIRED] != snap[Counter.DEVICE_TIME_NS]:
                 torn += 1
         p.join()
         assert torn == 0, f"{torn}/{reads} torn snapshots"
+        assert reads > 0, f"reader starved: 0 reads, {stalls} stalls"
         assert led.snapshot(0)[Counter.STEPS_RETIRED] == iters
     finally:
         import gc
