@@ -14,8 +14,10 @@ from pbs_tpu.models.serving import (
 from pbs_tpu.models.moe import (
     MoEConfig,
     init_moe_params,
+    make_moe_generate,
     make_moe_train_step,
     moe_forward,
+    moe_forward_with_cache,
     moe_loss,
 )
 from pbs_tpu.models.transformer import (
@@ -41,7 +43,9 @@ __all__ = [
     "make_eval_step",
     "make_generate",
     "make_micro_train_step",
+    "make_moe_generate",
     "make_moe_train_step",
+    "moe_forward_with_cache",
     "make_serve_step",
     "make_train_step",
     "moe_forward",

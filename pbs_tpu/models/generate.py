@@ -66,13 +66,15 @@ def _cached_attention(q, ck, cv, start_pos, cfg: TransformerConfig):
     return out.transpose(0, 3, 1, 2, 4).reshape(B, S, H, hd)
 
 
-def forward_with_cache(cfg: TransformerConfig, params: dict,
-                       tokens: jax.Array, cache: dict,
-                       constrain=lambda x: x) -> tuple[jax.Array, dict]:
-    """Run ``tokens`` (B, S) through the model starting at the cache
-    position: new K/V are written into the slabs, attention sees the
-    whole prefix. Returns (logits (B, S, vocab) fp32, updated cache).
-    S is static; use S=prompt_len for prefill and S=1 for decode."""
+def _forward_with_cache_impl(cfg: TransformerConfig, params: dict,
+                             tokens: jax.Array, cache: dict,
+                             constrain=lambda x: x, mlp_fn=None):
+    """Shared cached-forward plumbing (embed, rope slice, KV update,
+    cached attention, norms, head) parameterized over the FFN block so
+    the dense and MoE serving paths keep ONE copy. ``mlp_fn(lp, h) ->
+    (y, extra)`` replaces the dense SwiGLU when given; per-layer
+    ``extra`` scalars (e.g. MoE drop fractions) are summed. Returns
+    (logits, new_cache, extra_sum)."""
     B, S = tokens.shape
     T = cache["k"].shape[2]
     dt = cfg.dtype
@@ -84,7 +86,8 @@ def forward_with_cache(cfg: TransformerConfig, params: dict,
     cos = jax.lax.dynamic_slice_in_dim(cos_full, start, S)
     sin = jax.lax.dynamic_slice_in_dim(sin_full, start, S)
 
-    def body(x, layer):
+    def body(carry, layer):
+        x, extra = carry
         lp, ck, cv = layer
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         q = (h @ lp["wq"].astype(dt)).reshape(B, S, nh, hd)
@@ -96,16 +99,34 @@ def forward_with_cache(cfg: TransformerConfig, params: dict,
         attn = _cached_attention(q, ck, cv, start, cfg)
         x = constrain(x + attn.reshape(B, S, nh * hd) @ lp["wo"].astype(dt))
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(h @ lp["w1"].astype(dt))
-        up = h @ lp["w3"].astype(dt)
-        x = constrain(x + (gate * up) @ lp["w2"].astype(dt))
-        return x, (ck, cv)
+        if mlp_fn is None:
+            gate = jax.nn.silu(h @ lp["w1"].astype(dt))
+            up = h @ lp["w3"].astype(dt)
+            y = (gate * up) @ lp["w2"].astype(dt)
+            e = jnp.zeros((), jnp.float32)
+        else:
+            y, e = mlp_fn(lp, h)
+        x = constrain(x + y)
+        return (x, extra + e), (ck, cv)
 
-    x, (new_k, new_v) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"]))
+    zero = jnp.zeros((), jnp.float32)
+    (x, extra), (new_k, new_v) = jax.lax.scan(
+        body, (x, zero), (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["head"].astype(dt)).astype(jnp.float32)
     new_cache = {"k": new_k, "v": new_v, "pos": start + S}
+    return logits, new_cache, extra
+
+
+def forward_with_cache(cfg: TransformerConfig, params: dict,
+                       tokens: jax.Array, cache: dict,
+                       constrain=lambda x: x) -> tuple[jax.Array, dict]:
+    """Run ``tokens`` (B, S) through the model starting at the cache
+    position: new K/V are written into the slabs, attention sees the
+    whole prefix. Returns (logits (B, S, vocab) fp32, updated cache).
+    S is static; use S=prompt_len for prefill and S=1 for decode."""
+    logits, new_cache, _ = _forward_with_cache_impl(
+        cfg, params, tokens, cache, constrain)
     return logits, new_cache
 
 

@@ -239,6 +239,72 @@ def moe_forward(cfg: MoEConfig, params: dict, tokens: jax.Array,
     return logits, aux / cfg.n_layers, drop / cfg.n_layers
 
 
+# -- serving (KV-cached autoregressive decode) ------------------------------
+
+
+def moe_forward_with_cache(cfg: MoEConfig, params: dict,
+                           tokens: jax.Array, cache: dict,
+                           constrain=lambda x: x,
+                           constrain_ec=lambda x: x):
+    """The MoE twin of ``generate.forward_with_cache``: attention runs
+    against the KV slabs (same cache layout — MoE changes the FFN, not
+    attention), the FFN routes per position (no cross-token state, so
+    S=1 decode routes exactly like training did). Returns
+    (logits (B, S, V) fp32, updated cache, mean drop_frac) — the drop
+    fraction stays observable in serving, where a capacity-starved
+    router silently degrades quality."""
+    from pbs_tpu.models.generate import _forward_with_cache_impl
+
+    def mlp_fn(lp, h):
+        y, _aux, drop = moe_mlp(cfg, h, lp, constrain_ec)
+        return y, drop
+
+    logits, new_cache, drop_sum = _forward_with_cache_impl(
+        cfg, params, tokens, cache, constrain, mlp_fn=mlp_fn)
+    return logits, new_cache, drop_sum / cfg.n_layers
+
+
+def make_moe_generate(cfg: MoEConfig, max_new_tokens: int,
+                      temperature: float = 0.0,
+                      constrain=lambda x: x,
+                      constrain_ec=lambda x: x):
+    """MoE twin of ``generate.make_generate``: prefill + on-device
+    decode scan; ``generate(params, prompt, key) ->
+    ((B, max_new_tokens) tokens, mean drop_frac)``."""
+    from pbs_tpu.models.generate import _sample, init_cache
+
+    def generate(params: dict, prompt: jax.Array, key: jax.Array):
+        B, P = prompt.shape
+        cache = init_cache(cfg, B, max_len=P + max_new_tokens)
+        logits, cache, drop0 = moe_forward_with_cache(
+            cfg, params, prompt, cache, constrain, constrain_ec)
+        key, first_key = jax.random.split(key)
+        first = _sample(logits[:, -1, :], first_key, temperature)
+
+        def step(carry, step_key):
+            tok, cache, dsum = carry
+            logits, cache, drop = moe_forward_with_cache(
+                cfg, params, tok[:, None], cache, constrain,
+                constrain_ec)
+            nxt = _sample(logits[:, -1, :], step_key, temperature)
+            return (nxt, cache, dsum + drop), nxt
+
+        n_rest = max_new_tokens - 1
+        keys = jax.random.split(key, max(n_rest, 1))[:n_rest]
+        # TOKEN-weighted drop: the prefill routed P tokens per forward,
+        # each decode step 1 — an unweighted per-forward mean would let
+        # a capacity-starved long-prompt prefill hide behind clean
+        # decode steps (review finding).
+        (_, _, dsum), rest = jax.lax.scan(
+            step, (first, cache, jnp.zeros((), jnp.float32)), keys)
+        total_tokens = P + max(0, n_rest)
+        weighted = drop0 * P + dsum
+        toks = jnp.concatenate([first[None], rest], axis=0)
+        return toks.transpose(1, 0), weighted / total_tokens
+
+    return generate
+
+
 def moe_loss(cfg: MoEConfig, params: dict, tokens: jax.Array,
              constrain=lambda x: x, constrain_ec=lambda x: x):
     logits, aux, drop = moe_forward(
