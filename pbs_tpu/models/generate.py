@@ -146,6 +146,42 @@ def _sample(logits: jax.Array, key: jax.Array,
         key, logits / temperature, axis=-1).astype(jnp.int32)
 
 
+def make_generate_loop(cfg: TransformerConfig, max_new_tokens: int,
+                       temperature: float, fwd):
+    """The shared decode loop (cache init, prefill, single-use keys,
+    on-device step scan) parameterized over the forward:
+    ``fwd(params, tokens, cache) -> (logits, cache, extra)``. Returns
+    ``loop(params, prompt, key) -> (toks (B, max_new), extra_prefill,
+    extra_decode_sum, P)`` — wrappers decide what ``extra`` means
+    (dense: nothing; MoE: router drop fractions)."""
+
+    def loop(params: dict, prompt: jax.Array, key: jax.Array):
+        B, P = prompt.shape
+        cache = init_cache(cfg, B, max_len=P + max_new_tokens)
+        logits, cache, extra0 = fwd(params, prompt, cache)
+        key, first_key = jax.random.split(key)  # single-use keys
+        first = _sample(logits[:, -1, :], first_key, temperature)
+
+        # max_new_tokens - 1 decode forwards produce the remaining
+        # tokens; the step emits what it sampled, so no forward's
+        # output is discarded.
+        def step(carry, step_key):
+            tok, cache, esum = carry
+            logits, cache, e = fwd(params, tok[:, None], cache)
+            nxt = _sample(logits[:, -1, :], step_key, temperature)
+            return (nxt, cache, esum + e), nxt
+
+        n_rest = max_new_tokens - 1
+        keys = jax.random.split(key, max(n_rest, 1))[:n_rest]
+        zero = jnp.zeros((), jnp.float32)
+        (_, _, esum), rest = jax.lax.scan(step, (first, cache, zero),
+                                          keys)
+        toks = jnp.concatenate([first[None], rest], axis=0)
+        return toks.transpose(1, 0), extra0, esum, P
+
+    return loop
+
+
 def make_generate(cfg: TransformerConfig, max_new_tokens: int,
                   temperature: float = 0.0, constrain=lambda x: x):
     """Returns ``generate(params, prompt, key) -> (B, max_new_tokens)``
@@ -155,29 +191,16 @@ def make_generate(cfg: TransformerConfig, max_new_tokens: int,
     ``P + max_new_tokens`` so serving memory is exactly what the request
     class needs, not cfg.max_seq."""
 
+    def fwd(params, tokens, cache):
+        return _forward_with_cache_impl(cfg, params, tokens, cache,
+                                        constrain)
+
+    loop = make_generate_loop(cfg, max_new_tokens, temperature, fwd)
+
     def generate(params: dict, prompt: jax.Array,
                  key: jax.Array) -> jax.Array:
-        B, P = prompt.shape
-        cache = init_cache(cfg, B, max_len=P + max_new_tokens)
-        last_logits, cache = prefill(cfg, params, prompt, cache, constrain)
-        key, first_key = jax.random.split(key)  # single-use keys
-        first = _sample(last_logits, first_key, temperature)
-
-        # max_new_tokens - 1 decode forwards produce the remaining
-        # tokens; the step emits what it sampled, so no forward's output
-        # is discarded.
-        def step(carry, step_key):
-            tok, cache = carry
-            logits, cache = forward_with_cache(
-                cfg, params, tok[:, None], cache, constrain)
-            nxt = _sample(logits[:, -1, :], step_key, temperature)
-            return (nxt, cache), nxt
-
-        n_rest = max_new_tokens - 1
-        keys = jax.random.split(key, max(n_rest, 1))[:n_rest]
-        (_, _), rest = jax.lax.scan(step, (first, cache), keys)
-        toks = jnp.concatenate([first[None], rest], axis=0)
-        return toks.transpose(1, 0)  # (B, max_new_tokens)
+        toks, _extra0, _esum, _P = loop(params, prompt, key)
+        return toks
 
     return generate
 

@@ -268,39 +268,25 @@ def make_moe_generate(cfg: MoEConfig, max_new_tokens: int,
                       temperature: float = 0.0,
                       constrain=lambda x: x,
                       constrain_ec=lambda x: x):
-    """MoE twin of ``generate.make_generate``: prefill + on-device
-    decode scan; ``generate(params, prompt, key) ->
-    ((B, max_new_tokens) tokens, mean drop_frac)``."""
-    from pbs_tpu.models.generate import _sample, init_cache
+    """MoE twin of ``generate.make_generate`` (same shared decode
+    loop); ``generate(params, prompt, key) ->
+    ((B, max_new_tokens) tokens, token-weighted mean drop_frac)``."""
+    from pbs_tpu.models.generate import make_generate_loop
+
+    def fwd(params, tokens, cache):
+        return moe_forward_with_cache(cfg, params, tokens, cache,
+                                      constrain, constrain_ec)
+
+    loop = make_generate_loop(cfg, max_new_tokens, temperature, fwd)
 
     def generate(params: dict, prompt: jax.Array, key: jax.Array):
-        B, P = prompt.shape
-        cache = init_cache(cfg, B, max_len=P + max_new_tokens)
-        logits, cache, drop0 = moe_forward_with_cache(
-            cfg, params, prompt, cache, constrain, constrain_ec)
-        key, first_key = jax.random.split(key)
-        first = _sample(logits[:, -1, :], first_key, temperature)
-
-        def step(carry, step_key):
-            tok, cache, dsum = carry
-            logits, cache, drop = moe_forward_with_cache(
-                cfg, params, tok[:, None], cache, constrain,
-                constrain_ec)
-            nxt = _sample(logits[:, -1, :], step_key, temperature)
-            return (nxt, cache, dsum + drop), nxt
-
-        n_rest = max_new_tokens - 1
-        keys = jax.random.split(key, max(n_rest, 1))[:n_rest]
+        toks, drop0, dsum, P = loop(params, prompt, key)
         # TOKEN-weighted drop: the prefill routed P tokens per forward,
         # each decode step 1 — an unweighted per-forward mean would let
         # a capacity-starved long-prompt prefill hide behind clean
         # decode steps (review finding).
-        (_, _, dsum), rest = jax.lax.scan(
-            step, (first, cache, jnp.zeros((), jnp.float32)), keys)
-        total_tokens = P + max(0, n_rest)
-        weighted = drop0 * P + dsum
-        toks = jnp.concatenate([first[None], rest], axis=0)
-        return toks.transpose(1, 0), weighted / total_tokens
+        total_tokens = P + max(0, max_new_tokens - 1)
+        return toks, (drop0 * P + dsum) / total_tokens
 
     return generate
 
