@@ -239,10 +239,12 @@ class Agent:
         user paused stays paused. Callers must hold ``dispatch_lock``
         (RemusSession does); this is not itself an RPC op."""
         j = self.partition.job(job)
-        was_paused = self._job_state(j) == "paused"
+        # 'paged' implies asleep too: the epoch capture must not wake
+        # (and thereby page back in!) a parked/evicted tenant.
+        was_asleep = self._job_state(j) in ("paused", "paged")
         self.partition.sleep_job(j, notify=False)  # epoch quiesce is
         saved = self._save_record(j)  # not a lifecycle event
-        if not was_paused:
+        if not was_asleep:
             self.partition.wake_job(j, notify=False)
         return saved
 
@@ -461,6 +463,8 @@ class Agent:
             return "failed"
         if j.finished():
             return "finished"
+        if getattr(j, "paged", None) is not None:
+            return "paged"  # evicted to host (xenpaging state)
         live = {c.state for c in j.contexts}
         if live and live <= {ContextState.BLOCKED, ContextState.DONE}:
             return "paused"

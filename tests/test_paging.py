@@ -135,6 +135,49 @@ def test_reclaim_hook_survives_a_miss():
     assert c.steps_retired() > 0
 
 
+def test_paged_state_visible_on_control_plane():
+    """`pbst list` must show a paged tenant as 'paged', and unpausing
+    it over RPC transparently pages it back in."""
+    from pbs_tpu.dist import Agent, RpcClient
+
+    part = Partition("p", source=TpuBackend())
+    a = Agent("ph", partition=part, n_executors=1).start()
+    try:
+        job = part.add_job(_train_job("pj"))
+        cli = RpcClient(a.address)
+        cli.call("pause_job", job="pj", subject="remote")
+        page_out_job(part, job)
+        rows = cli.call("list_jobs")
+        assert rows[0]["state"] == "paged"
+        cli.call("unpause_job", job="pj", subject="remote")
+        rows = cli.call("list_jobs")
+        assert rows[0]["state"] == "running"
+        assert job.paged is None  # transparently restored
+        cli.close()
+    finally:
+        a.stop()
+
+
+def test_remus_snapshot_leaves_paged_job_paged():
+    """A Remus epoch capture of a paged tenant must not wake it (which
+    would page it back into HBM and undo the eviction) — review
+    finding on the new 'paged' state string."""
+    from pbs_tpu.dist import Agent
+
+    part = Partition("p", source=TpuBackend())
+    a = Agent("rh", partition=part, n_executors=1)
+    try:
+        job = part.add_job(_train_job("rj"))
+        part.sleep_job(job)
+        page_out_job(part, job)
+        saved = a.snapshot_record("rj")
+        assert saved["job"] == "rj"
+        assert job.paged is not None  # STILL evicted
+        assert a._job_state(job) == "paged"
+    finally:
+        a.stop()
+
+
 def test_sim_jobs_page_as_noop():
     """A SimBackend job has no device arrays: paging frees 0 and wake
     stays cheap — the API is uniform across backends."""
