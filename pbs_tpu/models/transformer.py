@@ -55,6 +55,10 @@ class TransformerConfig:
     # Attention implementation: "xla" (fused by compiler), "pallas"
     # (pbs_tpu.ops.attention), "ring" (sequence-parallel ring attention).
     attn_impl: str = "xla"
+    # Intra-chunk block computation for attn_impl="ring": "dense" (XLA
+    # einsum) or "flash" (Pallas kernel per visiting chunk — long local
+    # chunks never materialize probabilities).
+    ring_block: str = "dense"
 
     @property
     def head_dim(self) -> int:
@@ -163,7 +167,7 @@ def causal_attention(
 
         return ring_attention(
             q, k, v, mesh, axis="sp", causal=True,
-            batch_axis="dp", head_axis="tp",
+            batch_axis="dp", head_axis="tp", block_impl=cfg.ring_block,
         )
     if cfg.attn_impl != "xla":
         raise ValueError(

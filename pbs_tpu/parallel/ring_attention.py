@@ -52,6 +52,63 @@ def _local_block(q, k, v, sm_scale, mask):
     return m, l, acc
 
 
+def _ring_attention_local_flash(q, k, v, axis_name: str, causal: bool):
+    """Per-device ring body with the Pallas flash kernel as the
+    chunk-vs-chunk block: each visiting k/v chunk contributes a
+    *normalized* partial (o_b, lse_b) from
+    :func:`pbs_tpu.ops.attention.flash_attention_lse`, folded with the
+    logsumexp combiner  lse' = logaddexp(lse, lse_b),
+    o' = o·e^{lse−lse'} + o_b·e^{lse_b−lse'}.  Block masking modes
+    (fully visible / diagonal / skip) select between two compiled
+    kernels via ``lax.cond`` — static shapes, only the taken branch
+    executes. Differentiable end to end: the flash kernel's custom VJP
+    carries the lse cotangent that the combiner introduces."""
+    from pbs_tpu.ops.attention import flash_attention_lse
+
+    B, Sq, H, hd = q.shape
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    o = jnp.zeros((B, Sq, H, hd), jnp.float32)
+    lse = jnp.full((B, Sq, H, 1), NEG_INF, jnp.float32)
+
+    def block(k_cur, v_cur, src):
+        if not causal:
+            return flash_attention_lse(q, k_cur, v_cur, causal=False)
+
+        def diag_or_skip(k_, v_):
+            # src == my → intra-chunk causal; src > my → fully masked
+            # (skip: identity contribution under the lse combiner).
+            def diag(k2, v2):
+                return flash_attention_lse(q, k2, v2, causal=True)
+
+            def skip(k2, v2):
+                return (jnp.zeros((B, Sq, H, hd), jnp.float32),
+                        jnp.full((B, Sq, H, 1), NEG_INF, jnp.float32))
+
+            return jax.lax.cond(src == my, diag, skip, k_, v_)
+
+        def full(k_, v_):
+            return flash_attention_lse(q, k_, v_, causal=False)
+
+        return jax.lax.cond(src < my, full, diag_or_skip, k_cur, v_cur)
+
+    def step(carry, _):
+        o, lse, k_cur, v_cur, src = carry
+        o_b, lse_b = block(k_cur, v_cur, src)  # o_b fp32 (out_f32 path)
+        lse_new = jnp.logaddexp(lse, lse_b)
+        o_new = (o * jnp.exp(lse - lse_new)
+                 + o_b * jnp.exp(lse_b - lse_new))
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o_new, lse_new, k_nxt, v_nxt, (src - 1) % n), None
+
+    carry = (o, lse, k, v, my)
+    (o, lse, _, _, _), _ = jax.lax.scan(step, carry, None, length=n)
+    return o.astype(q.dtype)
+
+
 def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
                           sm_scale: float):
     """Per-device body (runs under shard_map). q/k/v are local chunks
@@ -106,6 +163,7 @@ def ring_attention(
     causal: bool = True,
     batch_axis: str | None = None,
     head_axis: str | None = None,
+    block_impl: str = "dense",
 ) -> jax.Array:
     """Exact attention with the sequence sharded over ``mesh[axis]``.
 
@@ -117,15 +175,29 @@ def ring_attention(
     are purely data-parallel inside the ring body; only ``axis`` carries
     the k/v rotation. Axes absent from the mesh are ignored so callers
     can pass their full layout unconditionally.
+
+    ``block_impl`` picks the intra-chunk block computation: ``"dense"``
+    (XLA einsum, materializes one (S/n)² block at a time) or
+    ``"flash"`` (the Pallas flash kernel per chunk — long local chunks
+    never materialize probabilities at all, so sp-sharded long-context
+    runs at MXU speed inside each shard too).
     """
     hd = q.shape[-1]
     sm_scale = 1.0 / np.sqrt(hd)
     ba = batch_axis if batch_axis in mesh.axis_names else None
     ha = head_axis if head_axis in mesh.axis_names else None
     spec = P(ba, axis, ha, None)
-    fn = functools.partial(
-        _ring_attention_local, axis_name=axis, causal=causal,
-        sm_scale=sm_scale)
+    if block_impl == "flash":
+        fn = functools.partial(
+            _ring_attention_local_flash, axis_name=axis, causal=causal)
+    elif block_impl == "dense":
+        fn = functools.partial(
+            _ring_attention_local, axis_name=axis, causal=causal,
+            sm_scale=sm_scale)
+    else:
+        raise ValueError(
+            f"unknown block_impl {block_impl!r}; expected 'dense' or "
+            "'flash'")
     mapped = jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
