@@ -53,11 +53,13 @@ class TransformerConfig:
     # elementwise/attention — usually the best throughput point.
     remat_policy: str = "full"
     # Attention implementation: "xla" (fused by compiler), "pallas"
-    # (pbs_tpu.ops.attention), "ring" (sequence-parallel ring attention).
+    # (pbs_tpu.ops.attention), "ring" (sequence-parallel ring
+    # attention), "ulysses" (sequence-parallel via head-scattering
+    # all-to-alls; needs H and Hkv divisible by the sp axis).
     attn_impl: str = "xla"
-    # Intra-chunk block computation for attn_impl="ring": "dense" (XLA
-    # einsum) or "flash" (Pallas kernel per visiting chunk — long local
-    # chunks never materialize probabilities).
+    # Intra-device block computation for the sequence-parallel impls
+    # ("ring"/"ulysses"): "dense" (XLA einsum) or "flash" (Pallas
+    # kernel — long chunks never materialize probabilities).
     ring_block: str = "dense"
 
     @property
@@ -169,10 +171,23 @@ def causal_attention(
             q, k, v, mesh, axis="sp", causal=True,
             batch_axis="dp", head_axis="tp", block_impl=cfg.ring_block,
         )
+    if cfg.attn_impl == "ulysses":
+        if mesh is None or "sp" not in mesh.axis_names:
+            raise ValueError(
+                "attn_impl='ulysses' needs a mesh with an 'sp' axis "
+                "threaded through forward(..., mesh=...); use "
+                "pbs_tpu.parallel.make_sharded_train with an sp mesh"
+            )
+        from pbs_tpu.parallel.ulysses import ulysses_attention
+
+        return ulysses_attention(
+            q, k, v, mesh, axis="sp", causal=True,
+            batch_axis="dp", block_impl=cfg.ring_block,
+        )
     if cfg.attn_impl != "xla":
         raise ValueError(
             f"unknown attn_impl {cfg.attn_impl!r}; "
-            "expected 'xla', 'pallas', or 'ring'"
+            "expected 'xla', 'pallas', 'ring', or 'ulysses'"
         )
     B, S, H, hd = q.shape
     nkv = k.shape[2]

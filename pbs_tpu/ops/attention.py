@@ -339,13 +339,17 @@ def _flash_padded(q, k, v, causal, block_q, block_k, interpret,
     Hkv = k.shape[2]
     if H % Hkv:
         raise ValueError(f"H={H} not a multiple of Hkv={Hkv}")
-    import math
-
     bq = min(block_q, max(S, 1))
     bk = min(block_k, max(S, 1))
-    # Ceil to a common block multiple (lcm handles asymmetric clamped
-    # blocks, e.g. block_q=128, block_k=32 at S=100 -> bq=100, bk=32).
-    blk = math.lcm(bq, bk)
+    # Asymmetric clamped blocks (e.g. block_q=128, block_k=32 at S=100
+    # -> bq=100, bk=32): shrink the larger to a multiple of the smaller
+    # so the padded length is one small multiple, not an lcm blow-up.
+    if bq % bk and bk % bq:
+        if bq > bk:
+            bq = (bq // bk) * bk
+        else:
+            bk = (bk // bq) * bq
+    blk = max(bq, bk)
     S_pad = -(-S // blk) * blk
     if interpret is None:
         interpret = jax.default_backend() != "tpu"

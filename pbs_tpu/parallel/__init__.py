@@ -8,6 +8,7 @@ from pbs_tpu.parallel.expert import (
 from pbs_tpu.parallel.gang import GangMonitor, anti_stack_pick
 from pbs_tpu.parallel.mesh import make_mesh, split_devices
 from pbs_tpu.parallel.ring_attention import ring_attention
+from pbs_tpu.parallel.ulysses import ulysses_attention
 from pbs_tpu.parallel.sharding import (
     activation_constrainer,
     batch_sharding,
@@ -26,6 +27,7 @@ __all__ = [
     "anti_stack_pick",
     "make_mesh",
     "ring_attention",
+    "ulysses_attention",
     "split_devices",
     "activation_constrainer",
     "batch_sharding",

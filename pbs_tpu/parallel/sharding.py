@@ -126,17 +126,18 @@ def make_sharded_train(
 
     key = key if key is not None else jax.random.PRNGKey(0)
     constrain = activation_constrainer(mesh)
-    # Ring attention needs the mesh in-graph (shard_map) and a sequence
-    # length divisible by the sp axis — full_seq keeps S intact in-graph.
-    ring = cfg.attn_impl == "ring"
-    if ring and "sp" not in mesh.axis_names:
+    # Sequence-parallel attention (ring / ulysses) needs the mesh
+    # in-graph (shard_map) and a sequence length divisible by the sp
+    # axis — full_seq keeps S intact in-graph.
+    seq_par = cfg.attn_impl in ("ring", "ulysses")
+    if seq_par and "sp" not in mesh.axis_names:
         raise ValueError(
-            "attn_impl='ring' requires an 'sp' axis in the mesh; got "
-            f"axes {mesh.axis_names}"
+            f"attn_impl={cfg.attn_impl!r} requires an 'sp' axis in the "
+            f"mesh; got axes {mesh.axis_names}"
         )
     init_opt, train_step = make_train_step(
-        cfg, learning_rate, constrain, mesh=mesh if ring else None,
-        full_seq=ring,
+        cfg, learning_rate, constrain, mesh=mesh if seq_par else None,
+        full_seq=seq_par,
     )
 
     # NamedSharding carries its mesh: no ambient mesh context needed.

@@ -1,0 +1,118 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second of the two long-context strategies (SURVEY.md §5 flags
+sequence parallelism as a new design area with no reference analog;
+ring attention in ``ring_attention.py`` is the neighbor-exchange
+strategy). Ulysses re-partitions instead of rotating: the sequence
+arrives sharded over the ``sp`` axis; one ``all_to_all`` scatters
+*heads* and gathers the full sequence, each device runs exact attention
+over the whole sequence for its head subset, and a second ``all_to_all``
+restores sequence sharding. Communication is two all-to-alls of the
+activation size, independent of sequence length — cheaper than a ring
+when the head count covers the axis, at the cost of requiring
+``H % n == 0`` (and ``Hkv % n == 0`` for GQA).
+
+Both strategies share the intra-device block choice: ``"dense"`` (XLA
+einsum) or ``"flash"`` (the Pallas kernel — here over the *full*
+sequence per device, which is exactly flash attention's sweet spot).
+Differentiable end to end (``all_to_all`` has a native transpose; the
+flash block carries its custom VJP).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _dense_block(q, k, v, causal: bool, sm_scale: float):
+    """Exact attention, full sequence, local heads. q:(B,S,H,hd)."""
+    B, S, H, hd = q.shape
+    group = H // k.shape[2]
+    kr = jnp.repeat(k, group, axis=2)
+    vr = jnp.repeat(v, group, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, kr).astype(jnp.float32) * sm_scale
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+        s = jnp.where((cols <= rows)[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, vr.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _ulysses_local(q, k, v, axis_name: str, causal: bool, sm_scale: float,
+                   block_impl: str):
+    """Per-device body (under shard_map). q/k/v: (B, S/n, H|Hkv, hd)."""
+    # Scatter heads, gather sequence: (B, S/n, H, hd) -> (B, S, H/n, hd).
+    a2a = functools.partial(
+        jax.lax.all_to_all, axis_name=axis_name, tiled=True)
+    qh = a2a(q, split_axis=2, concat_axis=1)
+    kh = a2a(k, split_axis=2, concat_axis=1)
+    vh = a2a(v, split_axis=2, concat_axis=1)
+    if block_impl == "flash":
+        from pbs_tpu.ops.attention import flash_attention
+
+        o = flash_attention(qh, kh, vh, causal=causal)
+    else:
+        o = _dense_block(qh, kh, vh, causal, sm_scale)
+    # Scatter sequence, gather heads back.
+    return a2a(o, split_axis=1, concat_axis=2)
+
+
+def ulysses_attention(
+    q: jax.Array,  # (B, S, H, hd), S sharded over ``axis``
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = True,
+    batch_axis: str | None = None,
+    block_impl: str = "dense",
+) -> jax.Array:
+    """Exact attention with the sequence sharded over ``mesh[axis]``,
+    via head-scattering all-to-alls (DeepSpeed-Ulysses style, re-derived
+    for XLA collectives — no reference analog, SURVEY.md §5).
+
+    Requires the (kv) head counts to be divisible by the axis size;
+    rejects loudly otherwise (use ring attention there — it has no head
+    constraint). ``batch_axis`` names a dp axis to compose with; it is
+    ignored if absent from the mesh.
+    """
+    B, S, H, hd = q.shape
+    Hkv = k.shape[2]
+    n = mesh.shape[axis]
+    if H % n or Hkv % n:
+        raise ValueError(
+            f"ulysses needs H ({H}) and Hkv ({Hkv}) divisible by the "
+            f"'{axis}' axis size ({n}); use ring attention for this shape"
+        )
+    if "tp" in mesh.axis_names and mesh.shape["tp"] > 1:
+        # Heads are the resource ulysses scatters over sp; a tp axis
+        # sharding the same heads would silently all-gather them here
+        # (undoing tp's memory/compute savings) — reject instead.
+        raise ValueError(
+            "ulysses does not compose with tensor parallelism (both "
+            "shard heads); use ring attention on tp meshes"
+        )
+    if block_impl not in ("dense", "flash"):
+        raise ValueError(
+            f"unknown block_impl {block_impl!r}; expected 'dense' or "
+            "'flash'")
+    sm_scale = 1.0 / np.sqrt(hd)
+    ba = batch_axis if batch_axis in mesh.axis_names else None
+    spec = P(ba, axis, None, None)
+    fn = functools.partial(
+        _ulysses_local, axis_name=axis, causal=causal, sm_scale=sm_scale,
+        block_impl=block_impl)
+    mapped = jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    return mapped(q, k, v)

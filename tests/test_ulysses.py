@@ -1,0 +1,134 @@
+"""Ulysses all-to-all sequence parallelism — op parity, gradients,
+head-divisibility rejection, and end-to-end training parity.
+
+The second long-context strategy beside ring attention (SURVEY.md §5:
+sequence parallelism is a new design area with no reference analog).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from test_attention import dense_attention, qkv
+
+from pbs_tpu.models import init_params, make_train_step
+from pbs_tpu.models.transformer import TransformerConfig
+from pbs_tpu.parallel import (
+    batch_sharding,
+    make_mesh,
+    make_sharded_train,
+    ulysses_attention,
+)
+
+
+def _shard(mesh, *arrays):
+    s = NamedSharding(mesh, P(None, "sp", None, None))
+    return tuple(jax.device_put(x, s) for x in arrays)
+
+
+needs8 = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices")
+
+
+@needs8
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("block_impl", ["dense", "flash"])
+def test_ulysses_matches_dense(causal, block_impl):
+    mesh = make_mesh({"sp": 8})
+    q, k, v = qkv(H=8, Hkv=8)
+    qs, ks, vs = _shard(mesh, q, k, v)
+    out = ulysses_attention(qs, ks, vs, mesh, causal=causal,
+                            block_impl=block_impl)
+    ref = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=3e-5, rtol=3e-5)
+
+
+@needs8
+def test_ulysses_gqa_grad_matches_dense():
+    """GQA (Hkv=4 on an sp=4 axis) + gradient parity through the two
+    all-to-alls."""
+    mesh = make_mesh({"dp": 2, "sp": 4})
+    q, k, v = qkv(H=8, Hkv=4)
+    qs, ks, vs = _shard(mesh, q, k, v)
+    w = jax.random.normal(jax.random.PRNGKey(7), q.shape, q.dtype)
+
+    def loss_u(q, k, v):
+        return jnp.sum(ulysses_attention(q, k, v, mesh) * w)
+
+    def loss_d(q, k, v):
+        return jnp.sum(dense_attention(q, k, v) * w)
+
+    gu = jax.grad(loss_u, argnums=(0, 1, 2))(qs, ks, vs)
+    gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gu, gd):
+        scale = float(jnp.max(jnp.abs(b))) + 1e-9
+        np.testing.assert_allclose(
+            np.asarray(a) / scale, b / scale, atol=3e-5,
+            err_msg=f"d{name}")
+
+
+@needs8
+def test_ulysses_head_divisibility_rejected():
+    mesh = make_mesh({"sp": 8})
+    q, k, v = qkv(H=8, Hkv=4)  # Hkv=4 not divisible by sp=8
+    qs, ks, vs = _shard(mesh, q, k, v)
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention(qs, ks, vs, mesh)
+
+
+@needs8
+def test_ulysses_tp_mesh_rejected():
+    """Both ulysses and tp shard heads — composing them would silently
+    all-gather; must reject (ring is the tp-composable strategy)."""
+    mesh = make_mesh({"sp": 2, "tp": 4})
+    q, k, v = qkv(H=8, Hkv=8)
+    qs, ks, vs = _shard(mesh, q, k, v)
+    with pytest.raises(ValueError, match="tensor parallelism"):
+        ulysses_attention(qs, ks, vs, mesh)
+
+
+@needs8
+def test_ulysses_training_matches_dense():
+    """2 optimizer steps on dp2 x sp2: attn_impl='ulysses' == dense."""
+    TINY = dict(
+        vocab=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq=64, dtype=jnp.float32,
+    )
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(7), (4, 64), 0, 128, jnp.int32)
+
+    dense_cfg = TransformerConfig(**TINY, attn_impl="xla")
+    init_opt, dense_step = make_train_step(
+        dense_cfg, learning_rate=1e-2, full_seq=True)
+    params = init_params(dense_cfg, jax.random.PRNGKey(0))
+    dense_state = (params, init_opt(params), 0)
+    dense_step = jax.jit(dense_step)
+    dense_losses = []
+    for _ in range(2):
+        dense_state, m = dense_step(dense_state, tokens)
+        dense_losses.append(float(m["loss"]))
+
+    uly_cfg = TransformerConfig(**TINY, attn_impl="ulysses")
+    mesh = make_mesh({"dp": 4, "sp": 2})  # Hkv=2 % sp=2 == 0
+    state, step = make_sharded_train(uly_cfg, mesh, learning_rate=1e-2)
+    toks = jax.device_put(tokens, batch_sharding(mesh))
+    losses = []
+    for _ in range(2):
+        state, m = step(state, toks)
+        losses.append(float(m["loss"]))
+
+    assert losses == pytest.approx(dense_losses, rel=2e-4)
+
+
+@needs8
+def test_ulysses_without_sp_rejected():
+    TINY = dict(
+        vocab=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq=64, dtype=jnp.float32,
+    )
+    cfg = TransformerConfig(**TINY, attn_impl="ulysses")
+    mesh = make_mesh({"dp": 8})
+    with pytest.raises(ValueError, match="sp"):
+        make_sharded_train(cfg, mesh)
