@@ -1,0 +1,96 @@
+"""Serving benchmark: flagship decode throughput + prefill latency.
+
+Companion to bench.py (training headline): measures the serving path a
+reference user would care about — steady-state decode tokens/s of the
+KV-cached generate loop (one on-device scan), and prefill
+time-to-first-token latency, on the flagship ~700M decoder. One JSON
+line per metric. Never run concurrently with bench.py /
+bench_sweep.py (single-client chip; see docs/PERF.md).
+
+    python bench_serving.py                     # real TPU
+    PBST_BENCH_TINY=1 python bench_serving.py   # CPU smoke
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    tiny = os.environ.get("PBST_BENCH_TINY", "").lower() in ("1", "true")
+    if tiny:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _flagship_cfg
+    from pbs_tpu.models import init_params
+    from pbs_tpu.models.generate import init_cache, make_generate, prefill
+
+    cfg = _flagship_cfg(tiny=tiny)
+    batch = 2 if tiny else 8
+    prompt_len = 16 if tiny else 512
+    new_tokens = 8 if tiny else 128
+
+    key = jax.random.PRNGKey(0)
+    params = init_params(cfg, key)
+    jax.block_until_ready(params)
+    prompt = jax.random.randint(
+        key, (batch, prompt_len), 0, cfg.vocab, jnp.int32)
+
+    # Prefill latency (the TTFT floor): prompt pass into a fresh cache.
+    @jax.jit
+    def pre(params, toks):
+        cache = init_cache(cfg, batch, max_len=prompt_len + new_tokens)
+        logits, cache = prefill(cfg, params, toks, cache)
+        return logits
+
+    jax.block_until_ready(pre(params, prompt))  # compile
+    ttfts = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        jax.block_until_ready(pre(params, prompt))
+        ttfts.append((time.perf_counter() - t0) * 1e3)
+    ttfts.sort()
+    print(json.dumps({
+        "metric": "serving_prefill_ms",
+        "value": round(ttfts[len(ttfts) // 2], 1),
+        "unit": "ms",
+        "p90_ms": round(ttfts[-1], 1),
+        "batch": batch,
+        "prompt_len": prompt_len,
+    }), flush=True)
+
+    # Decode throughput: the full generate loop (prefill + on-device
+    # scan over new_tokens decode steps), steady state.
+    gen = jax.jit(make_generate(cfg, max_new_tokens=new_tokens,
+                                temperature=0.0))
+    jax.block_until_ready(gen(params, prompt, key))  # compile
+    iters = 2 if tiny else 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        toks = gen(params, prompt, key)
+    jax.block_until_ready(toks)
+    dt = time.perf_counter() - t0
+    total_new = batch * new_tokens * iters
+    # Subtract the measured prefill share to isolate decode rate.
+    decode_dt = max(dt - iters * ttfts[len(ttfts) // 2] / 1e3, 1e-9)
+    print(json.dumps({
+        "metric": "serving_decode_throughput",
+        "value": round(total_new / decode_dt, 1),
+        "unit": "tokens/s",
+        "per_step_ms": round(1e3 * decode_dt / (new_tokens * iters), 2),
+        "batch": batch,
+        "new_tokens": new_tokens,
+        "device": str(jax.devices()[0]),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

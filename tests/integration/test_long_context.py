@@ -1,0 +1,74 @@
+"""Long-context capstone: sequence-parallel training at S=2048.
+
+Both long-context strategies (ring attention with Pallas flash blocks,
+and Ulysses all-to-all) train a tiny decoder at a sequence length 32x
+the usual test length, sharded over the sp axis — per-device attention
+state stays O((S/n)^2) for the dense ring block and O(S/n) for flash,
+while the loss trajectory must match the single-device dense reference
+exactly. This is the end-to-end artifact behind SURVEY.md §5's
+"long-context is a new design area" row: the sequence never
+materializes unsharded anywhere in the train step.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pbs_tpu.models import init_params, make_train_step
+from pbs_tpu.models.transformer import TransformerConfig
+from pbs_tpu.parallel import batch_sharding, make_mesh, make_sharded_train
+
+SEQ = 2048
+
+TINY_LONG = dict(
+    vocab=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=128, max_seq=SEQ, dtype=jnp.float32,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices")
+
+
+def _dense_losses(tokens, steps=2):
+    cfg = TransformerConfig(**TINY_LONG, attn_impl="xla")
+    init_opt, step = make_train_step(cfg, learning_rate=1e-2,
+                                     full_seq=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    state = (params, init_opt(params), 0)
+    step = jax.jit(step)
+    losses = []
+    for _ in range(steps):
+        state, m = step(state, tokens)
+        losses.append(float(m["loss"]))
+    return losses
+
+
+@pytest.fixture(scope="module")
+def tokens():
+    return jax.random.randint(
+        jax.random.PRNGKey(3), (4, SEQ), 0, 128, jnp.int32)
+
+
+@pytest.fixture(scope="module")
+def dense_losses(tokens):
+    return _dense_losses(tokens)
+
+
+@pytest.mark.parametrize("attn_impl,ring_block,mesh_axes", [
+    ("ring", "flash", {"dp": 2, "sp": 4}),
+    # ulysses needs Hkv (2) divisible by sp -> sp=2.
+    ("ulysses", "dense", {"dp": 4, "sp": 2}),
+])
+def test_long_context_training_parity(tokens, dense_losses, attn_impl,
+                                      ring_block, mesh_axes):
+    cfg = TransformerConfig(**TINY_LONG, attn_impl=attn_impl,
+                            ring_block=ring_block)
+    mesh = make_mesh(mesh_axes)
+    state, step = make_sharded_train(cfg, mesh, learning_rate=1e-2)
+    toks = jax.device_put(tokens, batch_sharding(mesh))
+    losses = []
+    for _ in range(len(dense_losses)):
+        state, m = step(state, toks)
+        losses.append(float(m["loss"]))
+    assert losses == pytest.approx(dense_losses, rel=2e-4)
+    assert losses[-1] < losses[0]
