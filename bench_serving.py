@@ -61,7 +61,7 @@ def main() -> int:
         "metric": "serving_prefill_ms",
         "value": round(ttfts[len(ttfts) // 2], 1),
         "unit": "ms",
-        "p90_ms": round(ttfts[-1], 1),
+        "p90_ms": round(ttfts[int(len(ttfts) * 0.9) - 1], 1),
         "batch": batch,
         "prompt_len": prompt_len,
     }), flush=True)

@@ -93,6 +93,111 @@ def test_arinc653_rejects_empty_schedule():
         part.scheduler.set_schedule([])
 
 
+def test_arinc653_rejects_unknown_job():
+    """Reference validates domain handles at set time."""
+    part, be, jobs = setup("arinc653", [("p1", SchedParams(), 10)])
+    with pytest.raises(ValueError, match="unknown job"):
+        part.scheduler.set_schedule([("ghost", 1_000)])
+
+
+def test_arinc653_default_schedule_covers_admitted_jobs():
+    """Until an operator table is set, each admitted job has one equal
+    default window (boot-default analog)."""
+    part, be, jobs = setup(
+        "arinc653",
+        [("p1", SchedParams(), 2_000), ("p2", SchedParams(), 2_000)],
+    )
+    slots = [s["job"] for s in part.scheduler.dump_settings()["slots"]]
+    assert slots == ["p1", "p2"]
+    part.run(until_ns=200_000_000)
+    assert dev_time(jobs["p1"]) > 0 and dev_time(jobs["p2"]) > 0
+
+
+def test_arinc653_schedule_applies_at_frame_boundary():
+    """set_schedule mid-frame: the running frame completes under the
+    old table; the new one is 'pending' until the boundary."""
+    part, be, jobs = setup(
+        "arinc653",
+        [("p1", SchedParams(tslice_us=100), 100_000),
+         ("p2", SchedParams(tslice_us=100), 100_000)],
+    )
+    part.scheduler.set_schedule([("p1", 2_000), ("p2", 2_000)])
+    part.run(until_ns=1_000_000)  # frame underway
+    part.scheduler.set_schedule([("p2", 3_000), (None, 1_000)])
+    assert part.scheduler.pending is not None  # not applied mid-frame
+    d = part.scheduler.dump_settings()
+    assert [s["job"] for s in d["slots"]] == ["p1", "p2"]
+    part.run(until_ns=20_000_000)  # several frames later
+    d = part.scheduler.dump_settings()
+    assert [s["job"] for s in d["slots"]] == ["p2", "<idle>"]
+    assert part.scheduler.pending is None
+
+
+def test_arinc653_overrun_debited_from_own_windows():
+    """A job whose step (5 ms) dwarfs its window (1 ms) overruns every
+    dispatch; the spill is repaid from its OWN later windows, so the
+    well-behaved neighbor's long-run share still follows the table."""
+    be = SimBackend()
+    part = Partition("t", source=be, scheduler="arinc653")
+    be.register("fat", SimProfile.steady(step_time_ns=5_000_000))
+    be.register("fit", SimProfile.steady(step_time_ns=100_000))
+    fat = Job("fat", params=SchedParams(tslice_us=100), max_steps=100_000)
+    fat.contexts[0].avg_step_ns = 5_000_000.0
+    fit = Job("fit", params=SchedParams(tslice_us=100), max_steps=100_000)
+    fit.contexts[0].avg_step_ns = 100_000.0
+    part.add_job(fat)
+    part.add_job(fit)
+    part.scheduler.set_schedule([("fat", 1_000), ("fit", 1_000)])
+    part.run(until_ns=1_000_000_000)
+    t_fat, t_fit = dev_time(fat), dev_time(fit)
+    # Table says 50/50; without the debit the 5 ms steps would take ~98%.
+    ratio = t_fat / max(t_fit, 1)
+    assert 0.6 < ratio < 1.7, f"expected ~1 (table share), got {ratio:.2f}"
+    assert part.scheduler.dump_settings()["overrun_ns"]["fat"] >= 0
+
+
+def test_arinc653_debt_not_forgiven_without_dispatch():
+    """Review regression: a window where the debtor is blocked must not
+    settle the debt — only a real dispatch does."""
+    part, be, jobs = setup("arinc653", [("p1", SchedParams(), 100_000)])
+    part.scheduler.set_schedule([("p1", 1_000)])
+    sched = part.scheduler
+    sched.overrun_ns["p1"] = 500_000  # 500 us debt < 1000 us window
+    jobs["p1"].contexts[0].state = type(jobs["p1"].contexts[0].state).BLOCKED
+    ex = part.executors[0]
+    d = sched.do_schedule(ex, part.clock.now_ns())
+    assert d.ctx is None
+    assert sched.overrun_ns["p1"] == 500_000  # untouched
+
+
+def test_arinc653_window_repays_debt_once():
+    """Review regression: many do_schedule calls inside one window must
+    repay at most one window's worth of debt."""
+    part, be, jobs = setup("arinc653", [("p1", SchedParams(), 100_000)])
+    part.scheduler.set_schedule([("p1", 1_000)])
+    sched = part.scheduler
+    sched.overrun_ns["p1"] = 5_000_000  # 5 ms debt >> 1 ms window
+    ex = part.executors[0]
+    now = part.clock.now_ns()
+    for _ in range(4):  # same window, repeated polling
+        d = sched.do_schedule(ex, now)
+        assert d.ctx is None
+    assert sched.overrun_ns["p1"] == 4_000_000  # exactly one window
+
+
+def test_arinc653_removed_job_slots_idle():
+    part, be, jobs = setup(
+        "arinc653",
+        [("p1", SchedParams(), 50), ("p2", SchedParams(), 100_000)],
+    )
+    part.scheduler.set_schedule([("p1", 1_000), ("p2", 1_000)])
+    part.run(until_ns=50_000_000)
+    part.remove_job(jobs["p1"])
+    part.run(until_ns=100_000_000)  # must not crash; p1 slots idle
+    d = part.scheduler.dump_settings()
+    assert [s["job"] for s in d["slots"]] == ["<idle>", "p2"]
+
+
 def test_atc_policy_applies_global_min():
     """Two jobs with very different contention: the atc law applies the
     *minimum* suggested quantum to every job (atc:462-501)."""
