@@ -20,6 +20,7 @@ from pbs_tpu.models.moe import (
     moe_forward_with_cache,
     moe_loss,
 )
+from pbs_tpu.models.speculative import make_speculative_generate
 from pbs_tpu.models.transformer import (
     TransformerConfig,
     forward,
@@ -47,6 +48,7 @@ __all__ = [
     "make_moe_train_step",
     "moe_forward_with_cache",
     "make_serve_step",
+    "make_speculative_generate",
     "make_train_step",
     "moe_forward",
     "moe_loss",

@@ -1,0 +1,127 @@
+"""Speculative decoding: draft-model propose-k, target verify-in-one.
+
+Serving capability with no reference analog (the reference predates
+LLM serving entirely — SURVEY.md §0); the TPU-first design constraint
+is the same one the rest of the serving stack obeys: **static shapes
+everywhere**. Each speculation round does a fixed amount of work —
+k draft decode steps plus ONE target forward over k+1 tokens — and
+advances a *traced* number of tokens (accepted prefix + bonus), so the
+whole generate loop is a single compiled ``lax.while_loop`` with two
+XLA programs (draft step, target verify) regardless of acceptance.
+
+Greedy (temperature=0) semantics, and therefore **token-exact**: the
+output is bit-identical to plain greedy decoding of the target model —
+pinned by test. Acceptance across a batch is synchronized at the
+batch-min (rows that verified further simply re-propose the same
+deterministic tokens next round), which keeps the KV caches' scalar
+``pos`` shared across rows — the price of static shapes, paid in
+re-verification rather than in per-row bookkeeping.
+
+Cache rollback is position arithmetic: ``pos`` is authoritative, the
+slab tail past it is both masked in cached attention and overwritten
+by later writes (``generate._cached_attention``), so "undo the
+unaccepted tokens" is ``cache["pos"] = p`` — no data movement.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from pbs_tpu.models.generate import forward_with_cache, init_cache, prefill
+from pbs_tpu.models.transformer import TransformerConfig
+
+
+def make_speculative_generate(
+    cfg: TransformerConfig,
+    draft_cfg: TransformerConfig,
+    max_new_tokens: int,
+    k: int = 4,
+):
+    """Returns ``spec_generate(params, draft_params, prompt) ->
+    (toks (B, max_new_tokens), stats)`` — greedy, token-exact vs the
+    target's own greedy decode. ``stats``: rounds, proposed, accepted
+    (device scalars; acceptance_rate = accepted / proposed).
+    """
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if cfg.vocab != draft_cfg.vocab:
+        raise ValueError(
+            f"draft vocab {draft_cfg.vocab} != target vocab {cfg.vocab}")
+
+    def spec_generate(params: dict, draft_params: dict,
+                      prompt: jax.Array):
+        B, P = prompt.shape
+        # Room for the last round to overshoot by up to k+1 tokens.
+        max_len = P + max_new_tokens + k + 1
+        tcache = init_cache(cfg, B, max_len=max_len)
+        dcache = init_cache(draft_cfg, B, max_len=max_len)
+
+        tlogits, tcache = prefill(cfg, params, prompt, tcache)
+        _dlogits, dcache = prefill(draft_cfg, draft_params, prompt, dcache)
+        first = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # (B,)
+
+        out = jnp.zeros((B, max_new_tokens + k + 1), jnp.int32)
+        out = out.at[:, 0].set(first)
+
+        def round_body(carry):
+            out, n_out, cur, tcache, dcache, rounds, proposed, accepted = carry
+            p0 = tcache["pos"]
+
+            # Draft proposes k tokens (consuming cur..t_{k-1}).
+            def dstep(c, _):
+                tok, dc = c
+                logits, dc = forward_with_cache(
+                    draft_cfg, draft_params, tok[:, None], dc)
+                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                return (nxt, dc), nxt
+
+            (last, dcache), props = jax.lax.scan(
+                dstep, (cur, dcache), None, length=k)
+            t = props.T  # (B, k): t_1..t_k
+            # Ingest t_k too so the draft has KV through position p0+k
+            # whatever the acceptance (its logits are discarded).
+            _, dcache = forward_with_cache(
+                draft_cfg, draft_params, last[:, None], dcache)
+
+            # Target verifies all k+1 positions in one forward.
+            x = jnp.concatenate([cur[:, None], t], axis=1)  # (B, k+1)
+            logits, tcache = forward_with_cache(cfg, params, x, tcache)
+            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, k+1)
+
+            # Per-row accepted-prefix length; lockstep at the batch min.
+            match = (t == g[:, :k]).astype(jnp.int32)
+            m_row = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # (B,)
+            m = jnp.min(m_row)
+            bonus = jnp.take(g, m, axis=1)  # (B,): g_m per row
+
+            # Emit t_1..t_m then the bonus; the static-width window may
+            # carry junk past m+1 — the next round's write (or the
+            # final slice) covers it.
+            round_toks = jnp.concatenate(
+                [t, jnp.zeros((B, 1), jnp.int32)], axis=1)
+            round_toks = jax.lax.dynamic_update_slice(
+                round_toks, bonus[:, None], (0, m))
+            out = jax.lax.dynamic_update_slice(out, round_toks, (0, n_out))
+
+            # Roll both caches back to the accepted frontier.
+            tcache = dict(tcache, pos=p0 + m + 1)
+            dcache = dict(dcache, pos=p0 + m + 1)
+            return (out, n_out + m + 1, bonus, tcache, dcache,
+                    rounds + 1, proposed + k, accepted + m)
+
+        def cond(carry):
+            return carry[1] < max_new_tokens
+
+        zero = jnp.zeros((), jnp.int32)
+        carry = (out, jnp.ones((), jnp.int32), first, tcache, dcache,
+                 zero, zero, zero)
+        out, n_out, _, _, _, rounds, proposed, accepted = (
+            jax.lax.while_loop(cond, round_body, carry))
+        stats = {"rounds": rounds, "proposed": proposed,
+                 "accepted": accepted}
+        return out[:, :max_new_tokens], stats
+
+    return spec_generate

@@ -62,11 +62,13 @@ class Arinc653Scheduler(Scheduler):
         # or a real clock polling) must not repay the debt repeatedly.
         self._repaid: set[tuple[int, int]] = set()
         if schedule:
-            self.set_schedule(schedule)
+            # Constructed before any job is admitted: defer name checks.
+            self.set_schedule(schedule, require_jobs=False)
 
     # -- table management ---------------------------------------------------
 
-    def _validate(self, entries) -> list[tuple[str | None, int]]:
+    def _validate(self, entries,
+                  require_jobs: bool = True) -> list[tuple[str | None, int]]:
         if not entries:
             raise ValueError("schedule must have at least one entry")
         known = {j.name for j in self.partition.jobs}
@@ -74,20 +76,25 @@ class Arinc653Scheduler(Scheduler):
             if dur <= 0:
                 raise ValueError(
                     f"schedule entry {name!r} needs a positive duration")
-            if name is not None and name not in known:
+            if require_jobs and name is not None and name not in known:
                 raise ValueError(
                     f"schedule names unknown job {name!r} (admitted: "
                     f"{sorted(known)})")
         return list(entries)
 
-    def set_schedule(self, entries: list[tuple[str | None, int]]) -> None:
+    def set_schedule(self, entries: list[tuple[str | None, int]],
+                     require_jobs: bool = True) -> None:
         """arin653_sched_set analog: validate now, apply at the next
-        major-frame boundary (the running frame completes first)."""
-        entries = self._validate(entries)
+        major-frame boundary (the running frame completes first).
+        ``require_jobs=False`` (the constructor path, where no job is
+        admitted yet) skips name validation — windows naming absent
+        jobs simply idle until the job arrives."""
+        entries = self._validate(entries, require_jobs)
         self.explicit = True
         if self.frame_start_ns is None or not self.schedule:
             self.schedule = entries
             self.slot_stats = {i: SlotStats() for i in range(len(entries))}
+            self._repaid.clear()
         else:
             self.pending = entries
 
@@ -110,6 +117,9 @@ class Arinc653Scheduler(Scheduler):
         self.schedule = entries
         self.slot_stats = {i: SlotStats() for i in range(len(entries))}
         self.frame_start_ns = None
+        # The frame epoch restarts: stale (frame, slot) keys would
+        # alias the new epoch's windows and block their repayment.
+        self._repaid.clear()
 
     def job_added(self, job) -> None:
         self.overrun_ns.setdefault(job.name, 0)
@@ -171,6 +181,14 @@ class Arinc653Scheduler(Scheduler):
         stats = self.slot_stats.setdefault(slot, SlotStats())
         window_key = (self.frame_count, slot)
         if name is not None:
+            if window_key in self._repaid:
+                # This window already took the repayment path: it stays
+                # idle for its remainder — a later poll must not turn a
+                # repaid window into a dispatch (that would both run
+                # the debtor and forgive its residual debt).
+                stats.idle += 1
+                self._arm(now_ns + remaining_ns)
+                return Decision(None, 0)
             debt = self.overrun_ns.get(name, 0)
             if debt >= remaining_ns:
                 # Whole window consumed repaying a previous overrun:

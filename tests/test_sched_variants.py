@@ -185,6 +185,37 @@ def test_arinc653_window_repays_debt_once():
     assert sched.overrun_ns["p1"] == 4_000_000  # exactly one window
 
 
+def test_arinc653_repaid_window_stays_idle():
+    """Review regression: after a window takes the repayment path, a
+    later poll in the SAME window must not dispatch the debtor (which
+    would both run it and forgive the residual debt)."""
+    part, be, jobs = setup("arinc653", [("p1", SchedParams(), 100_000)])
+    part.scheduler.set_schedule([("p1", 1_000)])
+    sched = part.scheduler
+    sched.overrun_ns["p1"] = 1_500_000  # 1.5 ms debt, 1 ms window
+    ex = part.executors[0]
+    now = part.clock.now_ns()
+    d = sched.do_schedule(ex, now)
+    assert d.ctx is None and sched.overrun_ns["p1"] == 500_000
+    d = sched.do_schedule(ex, now)  # re-poll inside the repaid window
+    assert d.ctx is None
+    assert sched.overrun_ns["p1"] == 500_000  # residual debt intact
+
+
+def test_arinc653_constructor_schedule_accepted():
+    """schedule= at construction predates any admitted job; names are
+    deferred-validated (absent jobs idle until admitted)."""
+    be = SimBackend()
+    part = Partition("t", source=be, scheduler="arinc653",
+                     sched_params={"schedule": [("later", 1_000)]})
+    be.register("later", SimProfile.steady(step_time_ns=100_000))
+    job = Job("later", params=SchedParams(), max_steps=100)
+    job.contexts[0].avg_step_ns = 100_000.0
+    part.add_job(job)
+    part.run(until_ns=100_000_000)
+    assert job.steps_retired() == 100
+
+
 def test_arinc653_removed_job_slots_idle():
     part, be, jobs = setup(
         "arinc653",

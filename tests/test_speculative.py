@@ -1,0 +1,74 @@
+"""Speculative decoding: token-exactness, acceptance accounting, and
+the self-draft degenerate case."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pbs_tpu.models import init_params
+from pbs_tpu.models.generate import make_generate
+from pbs_tpu.models.speculative import make_speculative_generate
+from pbs_tpu.models.transformer import TransformerConfig
+
+TGT = dict(vocab=128, d_model=64, n_layers=3, n_heads=4, n_kv_heads=2,
+           d_ff=128, max_seq=256, dtype=jnp.float32)
+DFT = dict(vocab=128, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2,
+           d_ff=64, max_seq=256, dtype=jnp.float32)
+
+MAX_NEW = 12
+K = 3
+
+
+@pytest.fixture(scope="module")
+def models():
+    cfg = TransformerConfig(**TGT)
+    dcfg = TransformerConfig(**DFT)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    dparams = init_params(dcfg, jax.random.PRNGKey(1))
+    return cfg, dcfg, params, dparams
+
+
+@pytest.fixture(scope="module")
+def prompt():
+    return jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, 128,
+                              jnp.int32)
+
+
+def test_speculative_token_exact(models, prompt):
+    """Spec decode == the target's own greedy decode, bit for bit —
+    the correctness contract of (greedy) speculative decoding."""
+    cfg, dcfg, params, dparams = models
+    ref = jax.jit(make_generate(cfg, max_new_tokens=MAX_NEW,
+                                temperature=0.0))(
+        params, prompt, jax.random.PRNGKey(9))
+    spec = jax.jit(make_speculative_generate(cfg, dcfg, MAX_NEW, k=K))
+    toks, stats = spec(params, dparams, prompt)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+    assert int(stats["proposed"]) == K * int(stats["rounds"])
+    assert 0 <= int(stats["accepted"]) <= int(stats["proposed"])
+
+
+def test_speculative_self_draft_accepts_everything(models, prompt):
+    """Draft == target: every proposal verifies, so the loop finishes
+    in the minimum number of rounds with 100% acceptance."""
+    cfg, _, params, _ = models
+    spec = jax.jit(make_speculative_generate(cfg, cfg, MAX_NEW, k=K))
+    toks, stats = spec(params, params, prompt)
+    ref = jax.jit(make_generate(cfg, max_new_tokens=MAX_NEW,
+                                temperature=0.0))(
+        params, prompt, jax.random.PRNGKey(9))
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+    assert int(stats["accepted"]) == int(stats["proposed"])
+    # 1 prefill token + rounds * (k+1) must just cover MAX_NEW.
+    rounds = int(stats["rounds"])
+    assert 1 + (rounds - 1) * (K + 1) < MAX_NEW <= 1 + rounds * (K + 1)
+
+
+def test_speculative_rejects_bad_args(models):
+    cfg, dcfg, *_ = models
+    with pytest.raises(ValueError, match="k must"):
+        make_speculative_generate(cfg, dcfg, 8, k=0)
+    with pytest.raises(ValueError, match="vocab"):
+        make_speculative_generate(
+            cfg, TransformerConfig(**{**DFT, "vocab": 64}), 8)
