@@ -20,6 +20,7 @@ from pbs_tpu.models.moe import (
     moe_forward_with_cache,
     moe_loss,
 )
+from pbs_tpu.models.quant import quantize_weights, quantized_nbytes
 from pbs_tpu.models.speculative import make_speculative_generate
 from pbs_tpu.models.transformer import (
     TransformerConfig,
@@ -54,4 +55,6 @@ __all__ = [
     "moe_loss",
     "next_token_loss",
     "prefill",
+    "quantize_weights",
+    "quantized_nbytes",
 ]

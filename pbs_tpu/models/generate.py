@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pbs_tpu.models.quant import embed_rows, wload
 from pbs_tpu.models.transformer import (
     TransformerConfig,
     apply_rope,
@@ -81,7 +82,9 @@ def _forward_with_cache_impl(cfg: TransformerConfig, params: dict,
     nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     start = cache["pos"]
 
-    x = constrain(params["embed"].astype(dt)[tokens])
+    # wload/embed_rows accept plain bf16/fp32 weights or int8
+    # {"q","s"} leaves (models.quant weight-only serving quantization).
+    x = constrain(embed_rows(params["embed"], tokens, dt))
     cos_full, sin_full = rope_tables(cfg, T)
     cos = jax.lax.dynamic_slice_in_dim(cos_full, start, S)
     sin = jax.lax.dynamic_slice_in_dim(sin_full, start, S)
@@ -90,19 +93,19 @@ def _forward_with_cache_impl(cfg: TransformerConfig, params: dict,
         x, extra = carry
         lp, ck, cv = layer
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = (h @ lp["wq"].astype(dt)).reshape(B, S, nh, hd)
-        k = (h @ lp["wk"].astype(dt)).reshape(B, S, nkv, hd)
-        v = (h @ lp["wv"].astype(dt)).reshape(B, S, nkv, hd)
+        q = (h @ wload(lp["wq"], dt)).reshape(B, S, nh, hd)
+        k = (h @ wload(lp["wk"], dt)).reshape(B, S, nkv, hd)
+        v = (h @ wload(lp["wv"], dt)).reshape(B, S, nkv, hd)
         q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
         ck = jax.lax.dynamic_update_slice_in_dim(ck, k, start, axis=1)
         cv = jax.lax.dynamic_update_slice_in_dim(cv, v, start, axis=1)
         attn = _cached_attention(q, ck, cv, start, cfg)
-        x = constrain(x + attn.reshape(B, S, nh * hd) @ lp["wo"].astype(dt))
+        x = constrain(x + attn.reshape(B, S, nh * hd) @ wload(lp["wo"], dt))
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         if mlp_fn is None:
-            gate = jax.nn.silu(h @ lp["w1"].astype(dt))
-            up = h @ lp["w3"].astype(dt)
-            y = (gate * up) @ lp["w2"].astype(dt)
+            gate = jax.nn.silu(h @ wload(lp["w1"], dt))
+            up = h @ wload(lp["w3"], dt)
+            y = (gate * up) @ wload(lp["w2"], dt)
             e = jnp.zeros((), jnp.float32)
         else:
             y, e = mlp_fn(lp, h)
@@ -113,7 +116,7 @@ def _forward_with_cache_impl(cfg: TransformerConfig, params: dict,
     (x, extra), (new_k, new_v) = jax.lax.scan(
         body, (x, zero), (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["head"].astype(dt)).astype(jnp.float32)
+    logits = (x @ wload(params["head"], dt)).astype(jnp.float32)
     new_cache = {"k": new_k, "v": new_v, "pos": start + S}
     return logits, new_cache, extra
 

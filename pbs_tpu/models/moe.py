@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pbs_tpu.models.quant import wload
 from pbs_tpu.models.transformer import (
     TransformerConfig,
     apply_rope,
@@ -182,10 +183,10 @@ def moe_mlp(cfg: MoEConfig, x: jax.Array, lp: dict, constrain_ec):
 
     ein = jnp.einsum("gtec,gtd->egcd", dispatch.astype(dt), xg)
     ein = constrain_ec(ein.reshape(cfg.n_experts, G * Cg, d))
-    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", ein, lp["we1"].astype(dt)))
-    up = jnp.einsum("ecd,edf->ecf", ein, lp["we3"].astype(dt))
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", ein, wload(lp["we1"], dt)))
+    up = jnp.einsum("ecd,edf->ecf", ein, wload(lp["we3"], dt))
     eout = jnp.einsum("ecf,efd->ecd", constrain_ec(gate * up),
-                      lp["we2"].astype(dt))
+                      wload(lp["we2"], dt))
     eout = constrain_ec(eout).reshape(cfg.n_experts, G, Cg, d)
     y = jnp.einsum("gtec,egcd->gtd", combine.astype(dt), eout)
     return y.reshape(B, S, d), jnp.mean(aux), jnp.mean(drop)

@@ -1,0 +1,79 @@
+"""Weight-only int8 quantization for serving tenants.
+
+No reference analog (pre-LLM artifact); the TPU-native motivation is
+the framework's own memory economy: serving tenants are priced by HBM
+residency (``runtime/memory.py`` admission accounts, ``sharing.py``
+shared weights), and weight-only int8 halves a bf16 tenant's bill
+while keeping the KV cache and activations untouched.
+
+Scheme: symmetric per-output-channel scales on every >=2-D weight
+(norm vectors stay fp32). A quantized leaf is ``{"q": int8, "s":
+fp32}``; the serving forwards dequantize at use via :func:`wload` —
+``q.astype(dt) * s`` — which XLA fuses into the consuming matmul's
+operand load, so the HBM-resident copy stays int8. Pytree shape is
+preserved (stacked layer leaves quantize along the last axis), so
+quantized params flow through the same ``lax.scan`` layer stack as
+fp params — one forward implementation serves both.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["quantize_weights", "quantized_nbytes", "wload", "embed_rows"]
+
+
+def _quantize_leaf(w: jax.Array) -> dict:
+    """Symmetric per-output-channel int8: scale over the last axis."""
+    amax = jnp.max(jnp.abs(w), axis=-2, keepdims=True)  # reduce d_in
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": scale.astype(jnp.float32)}
+
+
+def quantize_weights(params: dict) -> dict:
+    """Quantize a transformer/MoE param tree (the layout of
+    ``models.transformer.init_params`` / ``models.moe``) for the
+    *cached/serving* forwards: the embed and head matrices, plus every
+    stacked layer matrix (ndim >= 3 — ``(L, d_in, d_out)`` dense,
+    ``(L, E, d, f)`` experts). Stacked norm vectors ``(L, d)``, the
+    final norm, and the MoE ``router`` pass through — norms because
+    per-channel scaling across layers is meaningless, the router
+    because routing decisions are disproportionately sensitive to
+    weight noise (and it is tiny)."""
+    out = dict(params)
+    out["embed"] = _quantize_leaf(params["embed"])
+    out["head"] = _quantize_leaf(params["head"])
+    out["layers"] = {
+        k: (_quantize_leaf(v) if v.ndim >= 3 and k != "router" else v)
+        for k, v in params["layers"].items()
+    }
+    return out
+
+
+def wload(w, dt):
+    """Weight access used by the serving forwards: dequantize a
+    ``{"q", "s"}`` leaf (int8 stays HBM-resident; the dequant fuses
+    into the consumer), or cast a plain array."""
+    if isinstance(w, dict):
+        return w["q"].astype(dt) * w["s"].astype(dt)
+    return w.astype(dt)
+
+
+def embed_rows(w, tokens, dt):
+    """Embedding gather that never dequantizes the whole table:
+    gather int8 rows first, then scale by the per-column scales."""
+    if isinstance(w, dict):
+        return w["q"][tokens].astype(dt) * w["s"][0].astype(dt)
+    return w.astype(dt)[tokens]
+
+
+def quantized_nbytes(params: dict) -> int:
+    """Device-resident bytes of a (possibly quantized) param tree —
+    what the admission account should charge. Delegates to the same
+    accounting the memory manager uses (``runtime.memory.nbytes_of``),
+    so the serving bill and the admission bill cannot drift."""
+    from pbs_tpu.runtime.memory import nbytes_of
+
+    return nbytes_of(params)

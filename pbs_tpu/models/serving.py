@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pbs_tpu.models.quant import embed_rows, wload
 from pbs_tpu.models.generate import _sample
 from pbs_tpu.models.transformer import (
     TransformerConfig,
@@ -79,7 +80,7 @@ def _slot_forward(cfg: TransformerConfig, params: dict, tokens: jax.Array,
     nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     group = nh // nkv
 
-    x = params["embed"].astype(dt)[tokens]
+    x = embed_rows(params["embed"], tokens, dt)
     cos_full, sin_full = rope_tables(cfg, T)
     # absolute position of every (row, s) element: (B, S)
     abs_pos = row_pos[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
@@ -90,9 +91,9 @@ def _slot_forward(cfg: TransformerConfig, params: dict, tokens: jax.Array,
     def body(x, layer):
         lp, ck, cv = layer  # ck/cv: (B, T, nkv, hd)
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = (h @ lp["wq"].astype(dt)).reshape(B, S, nh, hd)
-        k = (h @ lp["wk"].astype(dt)).reshape(B, S, nkv, hd)
-        v = (h @ lp["wv"].astype(dt)).reshape(B, S, nkv, hd)
+        q = (h @ wload(lp["wq"], dt)).reshape(B, S, nh, hd)
+        k = (h @ wload(lp["wk"], dt)).reshape(B, S, nkv, hd)
+        v = (h @ wload(lp["wv"], dt)).reshape(B, S, nkv, hd)
         q = _rope_rows(q, cos, sin)
         k = _rope_rows(k, cos, sin)
         # Each row writes S CONTIGUOUS entries at its own cursor: a
@@ -118,17 +119,17 @@ def _slot_forward(cfg: TransformerConfig, params: dict, tokens: jax.Array,
             scores.astype(jnp.float32), axis=-1).astype(dt)
         attn = jnp.einsum("bngqk,bnkh->bngqh", probs, vt)
         attn = attn.transpose(0, 3, 1, 2, 4).reshape(B, S, nh * hd)
-        x = x + attn @ lp["wo"].astype(dt)
+        x = x + attn @ wload(lp["wo"], dt)
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(h @ lp["w1"].astype(dt))
-        up = h @ lp["w3"].astype(dt)
-        x = x + (gate * up) @ lp["w2"].astype(dt)
+        gate = jax.nn.silu(h @ wload(lp["w1"], dt))
+        up = h @ wload(lp["w3"], dt)
+        x = x + (gate * up) @ wload(lp["w2"], dt)
         return x, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["head"].astype(dt)).astype(jnp.float32)
+    logits = (x @ wload(params["head"], dt)).astype(jnp.float32)
     return logits, {"k": new_k, "v": new_v, "pos": cache["pos"]}
 
 
@@ -179,6 +180,14 @@ class ContinuousBatcher:
                 raise ValueError(
                     f"serving mesh needs a 'tp' axis; got "
                     f"{mesh.axis_names}")
+            if isinstance(params.get("embed"), dict):
+                # shard_params maps fp-shaped specs over the tree; the
+                # {"q","s"} leaves would mismatch opaquely — reject
+                # until quantized sharding specs exist.
+                raise ValueError(
+                    "int8-quantized params are not supported with a "
+                    "tp serving mesh yet; serve quantized trees "
+                    "single-device (mesh=None)")
             if cfg.n_kv_heads % mesh.shape["tp"]:
                 raise ValueError(
                     f"n_kv_heads={cfg.n_kv_heads} not divisible by "
