@@ -1,0 +1,135 @@
+"""On-chip validation suite: compiled Pallas kernels + measured paths.
+
+Lives OUTSIDE tests/ on purpose: tests/conftest.py pins the process to
+a virtual CPU platform before the first backend touch (the right thing
+for CI), while this suite requires the real chip. Run it with the chip
+free (ONE client at a time — see docs/PERF.md):
+
+    python -m pytest tpu_tests/ -q
+
+Every test skips cleanly off-TPU, so the suite is safe to invoke
+anywhere; on the chip it proves what interpreter-mode CI cannot — the
+kernels compile through the Mosaic TPU lowering and agree with the
+XLA reference numerically.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+# Skip BEFORE the first backend touch when the environment explicitly
+# pins a non-TPU platform: jax.devices() initializes every registered
+# plugin (including an ambient TPU plugin that can hang when the chip
+# is held — the round-1 dryrun lesson), so the env check must come
+# first.
+_plat = os.environ.get("JAX_PLATFORMS", "")
+if _plat and "tpu" not in _plat and "axon" not in _plat:
+    pytest.skip(f"JAX_PLATFORMS={_plat!r} pins a non-TPU platform",
+                allow_module_level=True)
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+if jax.devices()[0].platform != "tpu":  # pragma: no cover
+    pytest.skip("needs a real TPU chip", allow_module_level=True)
+
+
+def dense_attention(q, k, v, causal=True):
+    B, S, H, hd = q.shape
+    group = H // k.shape[2]
+    kr = jnp.repeat(k, group, axis=2)
+    vr = jnp.repeat(v, group, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   kr.astype(jnp.float32)) / np.sqrt(hd)
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+        s = jnp.where((cols <= rows)[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, vr.astype(jnp.float32))
+
+
+def qkv(B=2, S=512, H=8, Hkv=4, hd=128, seed=0, dtype=jnp.bfloat16):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return (jax.random.normal(ks[0], (B, S, H, hd), dtype),
+            jax.random.normal(ks[1], (B, S, Hkv, hd), dtype),
+            jax.random.normal(ks[2], (B, S, Hkv, hd), dtype))
+
+
+def test_flash_forward_compiled():
+    from pbs_tpu.ops.attention import flash_attention
+
+    q, k, v = qkv()
+    out = flash_attention(q, k, v, causal=True)  # interpret=False on TPU
+    ref = dense_attention(q, k, v)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
+    assert err < 0.05, err  # bf16 inputs
+
+
+def test_flash_forward_ragged_compiled():
+    from pbs_tpu.ops.attention import flash_attention
+
+    q, k, v = qkv(S=511)  # in-wrapper padding through the TPU lowering
+    out = flash_attention(q, k, v, causal=True)
+    ref = dense_attention(q, k, v)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
+    assert err < 0.05, err
+
+
+def test_flash_backward_compiled():
+    """The custom-VJP backward kernels (dq pass, GQA dk/dv pass)
+    through the Mosaic lowering — the one thing CPU CI cannot prove."""
+    from pbs_tpu.ops.attention import flash_attention
+
+    q, k, v = qkv(B=1, S=512, H=4, Hkv=2)
+    w = jax.random.normal(jax.random.PRNGKey(7), q.shape, q.dtype)
+
+    def lf(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True).astype(
+            jnp.float32) * w.astype(jnp.float32))
+
+    def ld(q, k, v):
+        return jnp.sum(dense_attention(q, k, v) * w.astype(jnp.float32))
+
+    gf = jax.jit(jax.grad(lf, argnums=(0, 1, 2)))(q, k, v)
+    gd = jax.jit(jax.grad(ld, argnums=(0, 1, 2)))(q, k, v)
+    for name, a, b in zip("qkv", gf, gd):
+        a32, b32 = a.astype(jnp.float32), b.astype(jnp.float32)
+        rel = float(jnp.max(jnp.abs(a32 - b32))) / (
+            float(jnp.max(jnp.abs(b32))) + 1e-9)
+        assert rel < 0.05, (name, rel)
+
+
+def test_instrumented_matmul_compiled():
+    from pbs_tpu.ops.matmul import instrumented_matmul, scale_stats
+
+    a = jax.random.normal(jax.random.PRNGKey(0), (512, 512), jnp.bfloat16)
+    b = jax.random.normal(jax.random.PRNGKey(1), (512, 512), jnp.bfloat16)
+    out, raw = instrumented_matmul(a, b, block_m=256, block_n=256,
+                                   block_k=256)
+    ref = (a.astype(jnp.float32) @ b.astype(jnp.float32))
+    err = float(jnp.max(jnp.abs(out - ref))) / float(jnp.max(jnp.abs(ref)))
+    assert err < 0.05, err
+    st = scale_stats(np.asarray(raw), 256, 256, 256)
+    assert st.mxu_tiles == 8  # (512/256)^3
+    assert st.flops == 8 * 2 * 256 ** 3
+
+
+def test_pallas_train_step_compiled():
+    """attn_impl='pallas' through a full fwd+bwd+AdamW train step on
+    the chip (tiny model, one step)."""
+    import dataclasses
+
+    from __graft_entry__ import _flagship_cfg
+    from pbs_tpu.models import init_params, make_train_step
+
+    cfg = dataclasses.replace(
+        _flagship_cfg(tiny=True), attn_impl="pallas", dtype=jnp.bfloat16)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    init_opt, step = make_train_step(cfg, learning_rate=1e-3)
+    state = (params, jax.jit(init_opt)(params), 0)
+    toks = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 128), 0, cfg.vocab, jnp.int32)
+    state, m = jax.jit(step)(state, toks)
+    assert np.isfinite(float(m["loss"]))
