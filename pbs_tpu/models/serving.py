@@ -34,7 +34,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any
 
 import jax
@@ -156,7 +156,7 @@ class ContinuousBatcher:
                  n_slots: int = 4, prompt_bucket: int = 64,
                  max_len: int | None = None, temperature: float = 0.0,
                  eos_id: int | None = None, seed: int = 0,
-                 mesh=None):
+                 mesh=None, prefix_cache_size: int = 0):
         self.cfg = cfg
         self.n_slots = n_slots
         self.bucket = prompt_bucket
@@ -224,13 +224,33 @@ class ContinuousBatcher:
         self.steps = 0
         self.tokens_emitted = 0
         self.requests_completed = 0
+        # Exact-prompt prefix cache (system-prompt reuse): LRU of
+        # {prompt bytes -> prompt-window KV + last-position logits}.
+        # Entries are DEVICE arrays — storing the lazy slot slice
+        # costs bounded HBM instead of a synchronous device-to-host
+        # copy on every miss (which would inflate every unique
+        # prompt's TTFT). A hit installs the KV into the slot and
+        # samples the first token from the cached logits — zero
+        # prefill compute. 0 = off.
+        if prefix_cache_size and mesh is not None:
+            raise ValueError(
+                "prefix_cache_size is not supported with a serving "
+                "mesh yet (cached windows would need resharding); "
+                "serve prefix-cached tenants single-device")
+        self.prefix_cache_size = prefix_cache_size
+        self._prefix_cache: "OrderedDict[bytes, dict]" = OrderedDict()
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefill_count = 0  # real prefill dispatches (cache misses)
 
         cfg_ = cfg
 
         @jax.jit
         def _prefill(params, cache, slot, prompt, plen, key):
             """Write one request's prompt into ``slot`` and sample its
-            first token. prompt: (bucket,) padded; plen: real length."""
+            first token. prompt: (bucket,) padded; plen: real length.
+            Also returns the last-position logits (for the prefix
+            cache)."""
             # gather the slot's slabs as a B=1 view
             sub = {
                 "k": jax.lax.dynamic_slice_in_dim(cache["k"], slot, 1,
@@ -242,7 +262,8 @@ class ContinuousBatcher:
             logits, sub = _slot_forward(
                 cfg_, params, prompt[None, :], sub, jnp.zeros((1,),
                                                              jnp.int32))
-            first = _sample(logits[0, plen - 1][None, :], key,
+            last_logits = logits[0, plen - 1]
+            first = _sample(last_logits[None, :], key,
                             self.temperature)[0]
             cache = dict(cache)
             cache["k"] = jax.lax.dynamic_update_slice_in_dim(
@@ -250,7 +271,19 @@ class ContinuousBatcher:
             cache["v"] = jax.lax.dynamic_update_slice_in_dim(
                 cache["v"], sub["v"], slot, axis=1)
             cache["pos"] = cache["pos"].at[slot].set(plen)
-            return first, cache
+            return first, last_logits, cache
+
+        @jax.jit
+        def _install(cache, slot, kwin, vwin, plen):
+            """Prefix-cache hit: write the cached prompt-window KV
+            (L, 1, bucket, nkv, hd) into ``slot``; no forward at all."""
+            cache = dict(cache)
+            cache["k"] = jax.lax.dynamic_update_slice(
+                cache["k"], kwin, (0, slot, 0, 0, 0))
+            cache["v"] = jax.lax.dynamic_update_slice(
+                cache["v"], vwin, (0, slot, 0, 0, 0))
+            cache["pos"] = cache["pos"].at[slot].set(plen)
+            return cache
 
         @jax.jit
         def _decode(params, cache, last_tok, active, key):
@@ -267,6 +300,7 @@ class ContinuousBatcher:
             return nxt, new_cache
 
         self._prefill_fn = _prefill
+        self._install_fn = _install
         self._decode_fn = _decode
         # Warm both programs NOW: compilation belongs to engine
         # construction, not to the first unlucky request's TTFT — a
@@ -275,6 +309,12 @@ class ContinuousBatcher:
         wk = jax.random.PRNGKey(0)
         _prefill(self.params, self.cache, 0,
                  jnp.zeros((self.bucket,), jnp.int32), 1, wk)
+        if prefix_cache_size:
+            _install(self.cache, 0, jnp.zeros(
+                (cfg.n_layers, 1, self.bucket, cfg.n_kv_heads,
+                 cfg.head_dim), cfg.dtype), jnp.zeros(
+                (cfg.n_layers, 1, self.bucket, cfg.n_kv_heads,
+                 cfg.head_dim), cfg.dtype), 1)
         _decode(self.params, self.cache,
                 jnp.zeros((n_slots,), jnp.int32),
                 jnp.zeros((n_slots,), bool), wk)  # results discarded:
@@ -309,10 +349,38 @@ class ContinuousBatcher:
             padded = np.zeros(self.bucket, np.int32)
             padded[:len(prompt)] = prompt
             self._key, sub = jax.random.split(self._key)
-            first, self.cache = self._prefill_fn(
-                self.params, self.cache, slot, jnp.asarray(padded),
-                len(prompt), sub)
-            first = int(first)
+            pkey = prompt.tobytes()
+            ent = (self._prefix_cache.get(pkey)
+                   if self.prefix_cache_size else None)
+            if ent is not None:
+                # Hit: install cached KV, sample from cached logits —
+                # the prompt forward is skipped entirely.
+                self._prefix_cache.move_to_end(pkey)
+                self.prefix_hits += 1
+                self.cache = self._install_fn(
+                    self.cache, slot, ent["k"], ent["v"],
+                    int(ent["plen"]))
+                first = int(_sample(
+                    ent["logits"][None, :], sub, self.temperature)[0])
+            else:
+                first, last_logits, self.cache = self._prefill_fn(
+                    self.params, self.cache, slot, jnp.asarray(padded),
+                    len(prompt), sub)
+                first = int(first)
+                self.prefill_count += 1
+                if self.prefix_cache_size:
+                    self.prefix_misses += 1
+                    # Device arrays: lazy slices, no host sync here.
+                    self._prefix_cache[pkey] = {
+                        "k": self.cache["k"][:, slot:slot + 1,
+                                             :self.bucket],
+                        "v": self.cache["v"][:, slot:slot + 1,
+                                             :self.bucket],
+                        "logits": last_logits,
+                        "plen": len(prompt),
+                    }
+                    while len(self._prefix_cache) > self.prefix_cache_size:
+                        self._prefix_cache.popitem(last=False)
             self.slot_req[slot] = rid
             self.slot_tokens[slot] = [first]
             self.slot_prompt_len[slot] = len(prompt)
@@ -406,6 +474,8 @@ class ContinuousBatcher:
             "ttft_p99_s": round(self._pct(self._ttfts, 0.99), 6),
             "latency_p50_s": round(self._pct(self._latencies, 0.50), 6),
             "latency_p99_s": round(self._pct(self._latencies, 0.99), 6),
+            "prefix_hits": self.prefix_hits,
+            "prefix_misses": self.prefix_misses,
         }
 
 

@@ -199,3 +199,60 @@ def test_job_shaped_serve_step(model):
     # the TOKENS metric is exact goodput: no double count on
     # completion, no undercount on admission (review finding)
     assert metric_total == 3
+
+
+def test_prefix_cache_token_exact_and_skips_prefill():
+    """Exact-prompt prefix cache: a repeated prompt produces the
+    identical greedy completion while dispatching zero prefill
+    forwards (the KV window installs from host RAM)."""
+    cfg = TransformerConfig(**TINY)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = ContinuousBatcher(cfg, params, n_slots=2, prompt_bucket=8,
+                            max_len=32, prefix_cache_size=4)
+    prompt = [5, 7, 11]
+
+    def run_one():
+        rid = eng.submit(prompt, max_new_tokens=4)
+        out = []
+        while not out:
+            out = [c for c in eng.step() if c.request_id == rid]
+        return out[0].tokens
+
+    t1 = run_one()
+    assert eng.prefill_count == 1 and eng.prefix_hits == 0
+    t2 = run_one()
+    assert t2 == t1  # token-exact from the cached window
+    assert eng.prefill_count == 1  # no second prefill dispatch
+    assert eng.prefix_hits == 1
+    assert eng.stats()["prefix_hits"] == 1
+
+
+def test_prefix_cache_lru_eviction():
+    cfg = TransformerConfig(**TINY)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = ContinuousBatcher(cfg, params, n_slots=1, prompt_bucket=8,
+                            max_len=32, prefix_cache_size=1)
+
+    def run(prompt):
+        rid = eng.submit(prompt, max_new_tokens=2)
+        while eng.has_work():
+            eng.step()
+
+    run([1, 2])
+    run([3, 4])      # evicts [1, 2]
+    run([1, 2])      # miss again
+    assert eng.prefix_hits == 0 and eng.prefill_count == 3
+    run([1, 2])      # now a hit
+    assert eng.prefix_hits == 1 and eng.prefill_count == 3
+
+
+def test_prefix_cache_off_by_default():
+    cfg = TransformerConfig(**TINY)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = ContinuousBatcher(cfg, params, n_slots=1, prompt_bucket=8,
+                            max_len=32)
+    for _ in range(2):
+        eng.submit([1, 2], max_new_tokens=2)
+        while eng.has_work():
+            eng.step()
+    assert eng.prefix_hits == 0 and eng.prefill_count == 2

@@ -7,10 +7,12 @@ free (ONE client at a time — see docs/PERF.md):
 
     python -m pytest tpu_tests/ -q
 
-Every test skips cleanly off-TPU, so the suite is safe to invoke
-anywhere; on the chip it proves what interpreter-mode CI cannot — the
-kernels compile through the Mosaic TPU lowering and agree with the
-XLA reference numerically.
+The suite is OPT-IN (``PBST_TPU_TESTS=1``) because the ambient TPU
+plugin hangs — it does not raise — when the chip is held by another
+client, so an unconditional probe could wedge any pytest invocation.
+On the chip it proves what interpreter-mode CI cannot — the kernels
+compile through the Mosaic TPU lowering and agree with the XLA
+reference numerically.
 """
 
 import os
@@ -18,11 +20,17 @@ import os
 import numpy as np
 import pytest
 
-# Skip BEFORE the first backend touch when the environment explicitly
-# pins a non-TPU platform: jax.devices() initializes every registered
-# plugin (including an ambient TPU plugin that can hang when the chip
-# is held — the round-1 dryrun lesson), so the env check must come
-# first.
+# Opt-in ONLY: initializing the backend here is unavoidable, and the
+# ambient TPU plugin HANGS (not raises) when the chip is absent or
+# held by another client (the round-1 dryrun lesson) — so the suite
+# must never probe on its own. Run it deliberately, chip free:
+#
+#     PBST_TPU_TESTS=1 python -m pytest tpu_tests/ -q
+if os.environ.get("PBST_TPU_TESTS", "") not in ("1", "true"):
+    pytest.skip(
+        "on-chip suite is opt-in: set PBST_TPU_TESTS=1 with the TPU "
+        "free (backend init can hang, not fail, when the chip is held)",
+        allow_module_level=True)
 _plat = os.environ.get("JAX_PLATFORMS", "")
 if _plat and "tpu" not in _plat and "axon" not in _plat:
     pytest.skip(f"JAX_PLATFORMS={_plat!r} pins a non-TPU platform",
