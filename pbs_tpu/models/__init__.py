@@ -21,7 +21,10 @@ from pbs_tpu.models.moe import (
     moe_loss,
 )
 from pbs_tpu.models.quant import quantize_weights, quantized_nbytes
-from pbs_tpu.models.speculative import make_speculative_generate
+from pbs_tpu.models.speculative import (
+    make_speculative_generate,
+    make_speculative_serve_step,
+)
 from pbs_tpu.models.transformer import (
     TransformerConfig,
     forward,
@@ -50,6 +53,7 @@ __all__ = [
     "moe_forward_with_cache",
     "make_serve_step",
     "make_speculative_generate",
+    "make_speculative_serve_step",
     "make_train_step",
     "moe_forward",
     "moe_loss",

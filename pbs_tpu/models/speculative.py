@@ -125,3 +125,32 @@ def make_speculative_generate(
         return out[:, :max_new_tokens], stats
 
     return spec_generate
+
+
+def make_speculative_serve_step(
+    cfg: TransformerConfig,
+    draft_cfg: TransformerConfig,
+    max_new_tokens: int,
+    k: int = 4,
+):
+    """A Job-shaped speculative batch-inference loop (the spec-decode
+    sibling of ``generate.make_serve_step``): ``state`` is
+    (params, draft_params, requests_served); each step serves one
+    prompt batch. Step metrics feed the telemetry ledger —
+    ``tokens`` (Counter.TOKENS) and ``spec_proposed``
+    (Counter.SPEC_PROPOSED), so ``pbst top``-class monitors can read
+    the speculation efficiency of a serving tenant exactly like any
+    other PMC-style rate."""
+    spec = make_speculative_generate(cfg, draft_cfg, max_new_tokens, k)
+
+    def serve_step(state, prompts: jax.Array):
+        params, draft_params, served = state
+        toks, stats = spec(params, draft_params, prompts)
+        ntok = toks.shape[0] * toks.shape[1]
+        metrics = {
+            "tokens": jnp.asarray(ntok, jnp.int32),
+            "spec_proposed": stats["proposed"],
+        }
+        return (params, draft_params, served + 1), metrics
+
+    return serve_step

@@ -71,8 +71,10 @@ class Counter(enum.IntEnum):
     SCHED_COUNT = 15
     # Tokens processed (throughput numerator for LLM workloads).
     TOKENS = 16
-    # Reserved.
-    RESERVED_17 = 17
+    # Draft tokens proposed by speculative decoding; TOKENS /
+    # SPEC_PROPOSED is the monitor-visible speculation efficiency
+    # (emitted tokens per draft proposal — higher is better).
+    SPEC_PROPOSED = 17
 
 
 #: Events dumped by the 'z' console key analog (sched_credit.c:1944-1977).

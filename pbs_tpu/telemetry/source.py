@@ -251,6 +251,7 @@ class TpuBackend:
         ("collective_wait_ns", Counter.COLLECTIVE_WAIT_NS),
         ("gang_skew_ns", Counter.GANG_SKEW_NS),
         ("tokens", Counter.TOKENS),
+        ("spec_proposed", Counter.SPEC_PROPOSED),
     )
 
     def measured(self, job_name: str):

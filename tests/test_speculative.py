@@ -65,6 +65,39 @@ def test_speculative_self_draft_accepts_everything(models, prompt):
     assert 1 + (rounds - 1) * (K + 1) < MAX_NEW <= 1 + rounds * (K + 1)
 
 
+def test_speculative_serve_job_telemetry(models, prompt):
+    """The spec-decode serving tenant under the real scheduler: TOKENS
+    and SPEC_PROPOSED land in the telemetry ledger, so a monitor reads
+    the speculation efficiency like any other PMC-style rate."""
+    from pbs_tpu.models.speculative import make_speculative_serve_step
+    from pbs_tpu.runtime import Job, Partition, SchedParams
+    from pbs_tpu.telemetry import Counter
+    from pbs_tpu.telemetry.source import TpuBackend
+    from pbs_tpu.utils.clock import MonotonicClock
+
+    cfg, dcfg, params, dparams = models
+    serve_step = make_speculative_serve_step(cfg, dcfg, MAX_NEW, k=K)
+    jit_serve = jax.jit(serve_step)
+
+    be = TpuBackend(clock=MonotonicClock())
+    part = Partition("spec", source=be, scheduler="credit")
+    job = part.add_job(Job(
+        "spec_serve",
+        step_fn=lambda s: jit_serve(s, prompt),
+        state=(params, dparams, 0),
+        params=SchedParams(weight=256),
+        max_steps=3,
+    ))
+    part.run()
+    ctr = job.contexts[0].counters
+    assert int(ctr[Counter.TOKENS]) == 3 * prompt.shape[0] * MAX_NEW
+    assert int(ctr[Counter.SPEC_PROPOSED]) > 0
+    # Efficiency: tokens per proposal is bounded by (k+1)/k and must
+    # beat the degenerate floor of one per round.
+    eff = int(ctr[Counter.TOKENS]) / int(ctr[Counter.SPEC_PROPOSED])
+    assert 0 < eff <= (K + 1) / K + 1e-6
+
+
 def test_speculative_rejects_bad_args(models):
     cfg, dcfg, *_ = models
     with pytest.raises(ValueError, match="k must"):
