@@ -28,7 +28,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from pbs_tpu.models.generate import forward_with_cache, init_cache, prefill
+from pbs_tpu.models.generate import forward_with_cache, init_cache
 from pbs_tpu.models.transformer import TransformerConfig
 
 
@@ -37,11 +37,29 @@ def make_speculative_generate(
     draft_cfg: TransformerConfig,
     max_new_tokens: int,
     k: int = 4,
+    target_fwd=None,
+    draft_fwd=None,
 ):
     """Returns ``spec_generate(params, draft_params, prompt) ->
     (toks (B, max_new_tokens), stats)`` — greedy, token-exact vs the
     target's own greedy decode. ``stats``: rounds, proposed, accepted
     (device scalars; acceptance_rate = accepted / proposed).
+
+    ``target_fwd``/``draft_fwd`` generalize over model families:
+    ``fwd(params, tokens, cache) -> (logits, cache[, extra])`` — the
+    dense cached forward is the default; pass
+    ``moe_forward_with_cache`` (via a closure binding its config) to
+    speculate into an MoE target. Both families share the KV-cache
+    layout (MoE changes the FFN, not attention), so ``init_cache``
+    covers both.
+
+    MoE caveat: token-exactness vs the plain decode loop requires the
+    router to be **dropless** for these batch shapes (capacity ample
+    for B·(k+1) tokens). Capacity dropping makes MoE logits depend on
+    which tokens share the forward, so a k+1-token verify can route —
+    and therefore score — differently than one-token-at-a-time decode;
+    with zero drops, routing is per-token and the exactness proof
+    carries over unchanged (pinned by test).
     """
     if k < 1:
         raise ValueError(f"k must be >= 1, got {k}")
@@ -51,6 +69,17 @@ def make_speculative_generate(
         raise ValueError(
             f"draft vocab {draft_cfg.vocab} != target vocab {cfg.vocab}")
 
+    if target_fwd is None:
+        def target_fwd(params, tokens, cache):  # noqa: F811
+            return forward_with_cache(cfg, params, tokens, cache)
+    if draft_fwd is None:
+        def draft_fwd(params, tokens, cache):  # noqa: F811
+            return forward_with_cache(draft_cfg, params, tokens, cache)
+
+    def _call(fwd, params, tokens, cache):
+        out = fwd(params, tokens, cache)
+        return out[0], out[1]  # tolerate (logits, cache, extra)
+
     def spec_generate(params: dict, draft_params: dict,
                       prompt: jax.Array):
         B, P = prompt.shape
@@ -59,8 +88,9 @@ def make_speculative_generate(
         tcache = init_cache(cfg, B, max_len=max_len)
         dcache = init_cache(draft_cfg, B, max_len=max_len)
 
-        tlogits, tcache = prefill(cfg, params, prompt, tcache)
-        _dlogits, dcache = prefill(draft_cfg, draft_params, prompt, dcache)
+        tlogits, tcache = _call(target_fwd, params, prompt, tcache)
+        tlogits = tlogits[:, -1, :]
+        _dl, dcache = _call(draft_fwd, draft_params, prompt, dcache)
         first = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # (B,)
 
         out = jnp.zeros((B, max_new_tokens + k + 1), jnp.int32)
@@ -73,8 +103,8 @@ def make_speculative_generate(
             # Draft proposes k tokens (consuming cur..t_{k-1}).
             def dstep(c, _):
                 tok, dc = c
-                logits, dc = forward_with_cache(
-                    draft_cfg, draft_params, tok[:, None], dc)
+                logits, dc = _call(draft_fwd, draft_params,
+                                   tok[:, None], dc)
                 nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
                 return (nxt, dc), nxt
 
@@ -83,12 +113,12 @@ def make_speculative_generate(
             t = props.T  # (B, k): t_1..t_k
             # Ingest t_k too so the draft has KV through position p0+k
             # whatever the acceptance (its logits are discarded).
-            _, dcache = forward_with_cache(
-                draft_cfg, draft_params, last[:, None], dcache)
+            _, dcache = _call(draft_fwd, draft_params,
+                              last[:, None], dcache)
 
             # Target verifies all k+1 positions in one forward.
             x = jnp.concatenate([cur[:, None], t], axis=1)  # (B, k+1)
-            logits, tcache = forward_with_cache(cfg, params, x, tcache)
+            logits, tcache = _call(target_fwd, params, x, tcache)
             g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, k+1)
 
             # Per-row accepted-prefix length; lockstep at the batch min.

@@ -98,6 +98,44 @@ def test_speculative_serve_job_telemetry(models, prompt):
     assert 0 < eff <= (K + 1) / K + 1e-6
 
 
+def test_speculative_moe_target_token_exact():
+    """Cross-family speculation: a dense draft proposing into an MoE
+    target must reproduce the MoE model's own greedy decode exactly.
+    Exactness requires a DROPLESS router (ample capacity): with
+    capacity dropping, MoE logits depend on which tokens share the
+    forward, so the k+1-token verify routes differently than
+    one-at-a-time decode — the module docstring documents the caveat;
+    this test pins the dropless guarantee."""
+    from pbs_tpu.models import (
+        MoEConfig,
+        init_moe_params,
+        make_moe_generate,
+        moe_forward_with_cache,
+    )
+
+    mcfg = MoEConfig(
+        vocab=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq=256, dtype=jnp.float32, n_experts=4, top_k=2,
+        capacity_factor=8.0)  # dropless at these batch shapes
+    dcfg = TransformerConfig(**DFT)
+    mp = init_moe_params(mcfg, jax.random.PRNGKey(0))
+    dp = init_params(dcfg, jax.random.PRNGKey(1))
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(2), (2, 16), 0, 128, jnp.int32)
+
+    def moe_fwd(params, tokens, cache):
+        return moe_forward_with_cache(mcfg, params, tokens, cache)
+
+    spec = jax.jit(make_speculative_generate(
+        mcfg, dcfg, MAX_NEW, k=K, target_fwd=moe_fwd))
+    toks, stats = spec(mp, dp, prompt)
+    ref, _drops = jax.jit(make_moe_generate(
+        mcfg, max_new_tokens=MAX_NEW, temperature=0.0))(
+        mp, prompt, jax.random.PRNGKey(9))
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+    assert int(stats["rounds"]) >= 1
+
+
 def test_speculative_rejects_bad_args(models):
     cfg, dcfg, *_ = models
     with pytest.raises(ValueError, match="k must"):
