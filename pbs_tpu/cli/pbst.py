@@ -111,9 +111,15 @@ def cmd_top(args) -> int:
 
 
 def cmd_trace(args) -> int:
-    from pbs_tpu.obs.trace import format_records
+    from pbs_tpu.obs.trace import chrome_trace, format_records
 
     recs = np.load(args.file)
+    if getattr(args, "chrome", None):
+        with open(args.chrome, "w") as f:
+            json.dump(chrome_trace(recs), f)
+        print(f"wrote {len(recs)} records to {args.chrome} "
+              "(chrome://tracing / Perfetto)")
+        return 0
     for line in format_records(recs):
         print(line)
     return 0
@@ -479,6 +485,47 @@ def cmd_demo(args) -> int:
     return 0
 
 
+def cmd_serve_demo(args) -> int:
+    """Continuous-batching serving demo on a tiny model (CPU-safe):
+    submits a request mix with repeated prompts, drains the engine,
+    prints the SLO/stats surface (incl. prefix-cache hits)."""
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    try:
+        jax.config.update("jax_platforms",
+                          os.environ["JAX_PLATFORMS"].split(",")[0])
+    except RuntimeError:
+        pass
+    import jax.numpy as jnp
+
+    from pbs_tpu.models import TransformerConfig, init_params
+    from pbs_tpu.models.serving import ContinuousBatcher
+
+    cfg = TransformerConfig(
+        vocab=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq=128, dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = ContinuousBatcher(cfg, params, n_slots=args.slots,
+                            prompt_bucket=16, max_len=64,
+                            prefix_cache_size=args.prefix_cache)
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, 128, size=5)) for _ in range(3)]
+    for i in range(args.requests):
+        eng.submit(prompts[i % len(prompts)], max_new_tokens=8)
+    done = []
+    while eng.has_work():
+        done += eng.step()
+    print(json.dumps({
+        "completions": len(done),
+        "sample_tokens": done[0].tokens if done else [],
+        **eng.stats(),
+    }, indent=1))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="pbst",
                                 description="PBS-T management CLI")
@@ -498,8 +545,17 @@ def main(argv=None) -> int:
     sp.add_argument("--clear", action="store_true")
     sp.set_defaults(fn=cmd_top)
 
+    sp = sub.add_parser(
+        "serve-demo", help="continuous-batching serving demo")
+    sp.add_argument("--requests", type=int, default=9)
+    sp.add_argument("--slots", type=int, default=2)
+    sp.add_argument("--prefix-cache", type=int, default=4)
+    sp.set_defaults(fn=cmd_serve_demo)
+
     sp = sub.add_parser("trace", help="format a trace dump (xentrace)")
     sp.add_argument("file")
+    sp.add_argument("--chrome", metavar="OUT.json",
+                    help="write Chrome trace-event JSON instead")
     sp.set_defaults(fn=cmd_trace)
 
     sp = sub.add_parser("store", help="store ops (xenstore)")

@@ -213,3 +213,45 @@ def format_records(recs: np.ndarray) -> list[str]:
         args = " ".join(str(int(x)) for x in r[2:])
         out.append(f"[{ts / 1e9:.6f}] {name} {args}")
     return out
+
+
+def chrome_trace(recs: np.ndarray, labels: dict[int, str] | None = None,
+                 pid: int = 0) -> dict:
+    """Convert drained records to the Chrome trace-event format (load
+    in chrome://tracing or Perfetto) — the graphical leg of the
+    xentrace_format analog. SCHED_PICK/SCHED_DESCHED pairs become
+    duration ('X') events on a per-context track (tid = ctx slot, dur
+    from the desched's device-true ran_ns); everything else becomes an
+    instant event on its slot's track. ``labels`` maps ctx slots to
+    display names (e.g. from the ledger sidecar meta)."""
+    labels = labels or {}
+    events: list[dict] = []
+    open_pick: dict[int, int] = {}  # slot -> pick ts
+    for r in recs:
+        ts, ev = int(r[0]), int(r[1])
+        a = [int(x) for x in r[2:]]
+        slot = a[0] if a else 0
+        try:
+            name = Ev(ev).name
+        except ValueError:
+            name = f"0x{ev:04x}"
+        if ev == Ev.SCHED_PICK:
+            open_pick[slot] = ts
+        elif ev == Ev.SCHED_DESCHED and slot in open_pick:
+            t0 = open_pick.pop(slot)
+            ran_ns = a[1] if len(a) > 1 else ts - t0
+            events.append({
+                "name": labels.get(slot, f"ctx{slot}"),
+                "ph": "X", "cat": "sched",
+                "ts": t0 / 1e3, "dur": max(ran_ns, 1) / 1e3,
+                "pid": pid, "tid": slot,
+                "args": {"ran_ns": ran_ns},
+            })
+        else:
+            events.append({
+                "name": name, "ph": "i", "s": "t",
+                "cat": name.split("_")[0].lower(),
+                "ts": ts / 1e3, "pid": pid, "tid": slot,
+                "args": {f"a{i}": v for i, v in enumerate(a)},
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

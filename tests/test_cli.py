@@ -64,6 +64,30 @@ def test_trace_cli(tmp_path, capsys):
     assert "SCHED_PICK" in capsys.readouterr().out
 
 
+def test_trace_chrome_export(tmp_path, capsys):
+    """pbst trace --chrome: PICK/DESCHED pairs become duration events
+    on per-context tracks; other events become instants."""
+    import json as _json
+
+    from pbs_tpu.obs import Ev, TraceBuffer
+
+    tb = TraceBuffer(capacity=16)
+    tb.emit(1_000_000, Ev.SCHED_PICK, 3, 100_000)
+    tb.emit(1_150_000, Ev.SCHED_DESCHED, 3, 140_000, 7)
+    tb.emit(1_200_000, Ev.SCHED_WAKE, 2, 1)
+    f = str(tmp_path / "trace.npy")
+    np.save(f, tb.consume())
+    out = str(tmp_path / "trace.chrome.json")
+    assert main(["trace", f, "--chrome", out]) == 0
+    doc = _json.load(open(out))
+    evs = doc["traceEvents"]
+    dur = [e for e in evs if e["ph"] == "X"]
+    inst = [e for e in evs if e["ph"] == "i"]
+    assert len(dur) == 1 and dur[0]["tid"] == 3
+    assert dur[0]["dur"] == pytest.approx(140_000 / 1e3)
+    assert len(inst) == 1 and inst[0]["name"] == "SCHED_WAKE"
+
+
 def test_ckpt_info_cli(tmp_path, capsys):
     from pbs_tpu.ckpt import save_checkpoint
 
@@ -112,3 +136,14 @@ def test_cli_live_agent_lifecycle(capsys):
     finally:
         a1.stop()
         a2.stop()
+
+
+def test_serve_demo_cli(capsys):
+    """pbst serve-demo: the batcher drains a request mix; repeated
+    prompts hit the prefix cache."""
+    import json as _json
+
+    assert main(["serve-demo", "--requests", "6"]) == 0
+    out = _json.loads(capsys.readouterr().out)
+    assert out["completions"] == 6
+    assert out["prefix_hits"] >= 3  # 3 distinct prompts, 6 requests
