@@ -2,6 +2,7 @@ from pbs_tpu.ckpt.checkpoint import (
     AsyncCheckpointer,
     Replicator,
     checkpoint_exists,
+    load_checkpoint,
     remove_checkpoint,
     restore_checkpoint,
     save_checkpoint,
@@ -11,6 +12,7 @@ __all__ = [
     "AsyncCheckpointer",
     "Replicator",
     "checkpoint_exists",
+    "load_checkpoint",
     "remove_checkpoint",
     "restore_checkpoint",
     "save_checkpoint",

@@ -43,13 +43,77 @@ def _flatten(state: Any):
     return leaves, treedef
 
 
+def _path_tokens(keypath) -> list | None:
+    """Serialize a jax keypath to JSON tokens, or None when the tree
+    contains nodes (custom pytrees) a template-free restore cannot
+    rebuild."""
+    import jax
+
+    toks: list = []
+    for k in keypath:
+        if isinstance(k, jax.tree_util.DictKey) and isinstance(k.key, str):
+            toks.append(["d", k.key])
+        elif isinstance(k, jax.tree_util.SequenceKey):
+            toks.append(["s", k.idx])
+        else:
+            return None
+    return toks or None  # a bare-leaf state has no path to rebuild
+
+
+def _plain_tree(node) -> bool:
+    """True when the state is rebuildable from key paths alone: nested
+    str-keyed dicts and LISTS of leaves. Tuples are excluded — jax
+    keypaths cannot distinguish tuple from list, so a round-trip would
+    silently change the pytree structure."""
+    if isinstance(node, dict):
+        return all(isinstance(k, str) and _plain_tree(v)
+                   for k, v in node.items())
+    if isinstance(node, list):
+        return all(_plain_tree(v) for v in node)
+    return not isinstance(node, tuple)
+
+
+def _insert(root, toks, value):
+    """Build nested dict/list structure along ``toks``."""
+    key = toks[0][1]
+    if len(toks) == 1:
+        if toks[0][0] == "d":
+            root[key] = value
+        else:
+            while len(root) <= key:
+                root.append(None)
+            root[key] = value
+        return
+    nxt_container: Any = {} if toks[1][0] == "d" else []
+    if toks[0][0] == "d":
+        child = root.setdefault(key, nxt_container)
+    else:
+        while len(root) <= key:
+            root.append(None)
+        if root[key] is None:
+            root[key] = nxt_container
+        child = root[key]
+    _insert(child, toks[1:], value)
+
+
 def save_checkpoint(path: str, state: Any, metadata: dict | None = None,
                     telemetry: np.ndarray | None = None) -> dict:
     """Atomically write ``state`` (any pytree of arrays/scalars) to
     ``path``. Returns the manifest."""
     import jax
 
-    leaves, treedef = _flatten(state)
+    import jax
+
+    # One traversal yields leaves, treedef, and key paths. Key paths
+    # enable template-free load_checkpoint for plain dict/list trees
+    # (param trees); custom pytree nodes, tuples, and bare-leaf states
+    # fall back to the template-based restore_checkpoint.
+    flat, treedef = jax.tree_util.tree_flatten_with_path(state)
+    leaves = [v for _, v in flat]
+    if _plain_tree(state):
+        paths = [_path_tokens(kp) for kp, _ in flat]
+    else:
+        paths = [None] * len(leaves)
     parent = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(parent, exist_ok=True)
     tmp = tempfile.mkdtemp(prefix=".ckpt_tmp_", dir=parent)
@@ -60,10 +124,11 @@ def save_checkpoint(path: str, state: Any, metadata: dict | None = None,
             arr = np.asarray(leaf)
             fname = f"leaf_{i:05d}.npy"
             np.save(os.path.join(tmp, fname), arr)
-            entries.append(
-                {"file": fname, "shape": list(arr.shape),
-                 "dtype": str(arr.dtype)}
-            )
+            entry = {"file": fname, "shape": list(arr.shape),
+                     "dtype": str(arr.dtype)}
+            if paths[i] is not None:
+                entry["path"] = paths[i]
+            entries.append(entry)
             total += arr.nbytes
         if telemetry is not None:
             np.save(os.path.join(tmp, "telemetry.npy"),
@@ -106,6 +171,28 @@ def save_checkpoint(path: str, state: Any, metadata: dict | None = None,
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
+
+
+def load_checkpoint(path: str) -> tuple[Any, dict]:
+    """Template-free restore for checkpoints whose state is a plain
+    dict/list tree (e.g. param trees): rebuilds the structure from the
+    recorded leaf key paths. Returns (state, metadata). Raises
+    ValueError for checkpoints without key paths (use
+    :func:`restore_checkpoint` with a template there)."""
+    with open(os.path.join(path, MANIFEST)) as f:
+        manifest = json.load(f)
+    entries = manifest["leaves"]
+    if not entries:
+        return {}, manifest.get("metadata", {})
+    if any("path" not in e for e in entries):
+        raise ValueError(
+            "checkpoint predates key-path manifests (or holds custom "
+            "pytree nodes); use restore_checkpoint(path, like=...)")
+    root: Any = {} if entries[0]["path"][0][0] == "d" else []
+    for e in entries:
+        arr = np.load(os.path.join(path, e["file"]))
+        _insert(root, e["path"], arr)
+    return root, manifest.get("metadata", {})
 
 
 def restore_checkpoint(path: str, like: Any) -> tuple[Any, dict]:

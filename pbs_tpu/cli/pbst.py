@@ -485,6 +485,39 @@ def cmd_demo(args) -> int:
     return 0
 
 
+def cmd_quantize(args) -> int:
+    """Offline int8 weight-only quantization of a param checkpoint:
+    reads a checkpoint holding a transformer/MoE param tree, writes a
+    new checkpoint with int8 {'q','s'} leaves (models.quant layout)
+    for the serving forwards, and prints the byte accounting."""
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from pbs_tpu.ckpt import load_checkpoint, save_checkpoint
+    from pbs_tpu.models.quant import quantize_weights, quantized_nbytes
+
+    state, meta = load_checkpoint(args.src)
+    params = None
+    if isinstance(state, dict):
+        params = state if "embed" in state else state.get("params")
+    if not isinstance(params, dict) or "embed" not in params:
+        print("pbst: checkpoint does not hold a param tree "
+              "(expected 'embed'/'layers'/... at the top level or "
+              "under 'params')", file=sys.stderr)
+        return 1
+    before = quantized_nbytes(params)
+    qp = quantize_weights(params)
+    after = quantized_nbytes(qp)
+    save_checkpoint(args.dst, qp, metadata={
+        **(meta or {}), "quantized": "int8-weight-only"})
+    print(json.dumps({
+        "src": args.src, "dst": args.dst,
+        "bytes_before": before, "bytes_after": after,
+        "ratio": round(after / max(before, 1), 4),
+    }))
+    return 0
+
+
 def cmd_serve_demo(args) -> int:
     """Continuous-batching serving demo on a tiny model (CPU-safe):
     submits a request mix with repeated prompts, drains the engine,
@@ -570,6 +603,12 @@ def main(argv=None) -> int:
     sp = sub.add_parser("ckpt-info", help="inspect a checkpoint")
     sp.add_argument("path")
     sp.set_defaults(fn=cmd_ckpt_info)
+
+    sp = sub.add_parser(
+        "quantize", help="int8 weight-only quantize a param checkpoint")
+    sp.add_argument("src")
+    sp.add_argument("dst")
+    sp.set_defaults(fn=cmd_quantize)
 
     sp = sub.add_parser("sched-credit", help="adjust job scheduling")
     sp.add_argument("-d", "--domain", required=True)

@@ -250,3 +250,36 @@ def test_event_auto_port_skips_bound():
     bus.bind(lambda p: None, port=64)
     p2 = bus.bind(lambda p: None)
     assert p2 != 64
+
+
+def test_load_checkpoint_template_free(tmp_path):
+    """Key-path manifests: plain dict/list trees reload without a
+    template; bare-leaf and tuple-bearing states fall back loudly to
+    restore_checkpoint (jax keypaths cannot tell tuple from list)."""
+    import numpy as np
+    import pytest
+
+    from pbs_tpu.ckpt import (
+        load_checkpoint,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    ok = str(tmp_path / "ok")
+    st = {"a": {"b": [np.ones(2), np.arange(3)]}, "c": np.int32(5)}
+    save_checkpoint(ok, st, metadata={"m": 1})
+    got, meta = load_checkpoint(ok)
+    np.testing.assert_array_equal(got["a"]["b"][1], np.arange(3))
+    assert got["c"] == 5 and meta["m"] == 1
+
+    bare = str(tmp_path / "bare")
+    save_checkpoint(bare, np.ones(3))
+    with pytest.raises(ValueError, match="restore_checkpoint"):
+        load_checkpoint(bare)
+
+    tup = str(tmp_path / "tup")
+    save_checkpoint(tup, {"x": (np.ones(2), np.zeros(2))})
+    with pytest.raises(ValueError, match="restore_checkpoint"):
+        load_checkpoint(tup)
+    got, _ = restore_checkpoint(tup, {"x": (np.zeros(2), np.zeros(2))})
+    assert isinstance(got["x"], tuple)

@@ -122,3 +122,29 @@ def test_quant_tp_mesh_rejected():
     with pytest.raises(ValueError, match="quantized"):
         ContinuousBatcher(CFG, qp, n_slots=2, prompt_bucket=8,
                           max_len=32, mesh=mesh)
+
+
+def test_quantize_cli_roundtrip(tmp_path):
+    """pbst quantize: checkpoint -> int8 checkpoint; the quantized tree
+    loads template-free and serves."""
+    import json as _json
+
+    from pbs_tpu.ckpt import load_checkpoint, save_checkpoint
+    from pbs_tpu.cli.pbst import main
+    from pbs_tpu.models import make_generate
+
+    params = _params()
+    src = str(tmp_path / "fp")
+    dst = str(tmp_path / "q8")
+    save_checkpoint(src, jax.tree.map(np.asarray, params),
+                    metadata={"job": "m"})
+    assert main(["quantize", src, dst]) == 0
+    qp, meta = load_checkpoint(dst)
+    assert meta["quantized"] == "int8-weight-only"
+    assert qp["layers"]["wq"]["q"].dtype == np.int8
+    # Serves: greedy decode runs from the loaded tree.
+    qp = jax.tree.map(jnp.asarray, qp)
+    gen = jax.jit(make_generate(CFG, max_new_tokens=4, temperature=0.0))
+    prompt = jnp.zeros((1, 8), jnp.int32)
+    toks = gen(qp, prompt, jax.random.PRNGKey(0))
+    assert toks.shape == (1, 4)
