@@ -93,9 +93,18 @@ def main() -> int:
     from __graft_entry__ import _flagship_cfg
 
     cfg_base = _flagship_cfg(tiny=tiny)
-    global SEQ, STEPS, BATCHES
+    global SEQ, STEPS, BATCHES, ATTN, REMAT
     if tiny:
         SEQ, STEPS, BATCHES = 128, 2, [2]
+    # Env-restricted grids for follow-up runs (e.g. the pallas column
+    # alone after a kernel fix, chip_queue.sh stage 3).
+    attn_env = os.environ.get("PBST_SWEEP_ATTN")
+    if attn_env:
+        ATTN = attn_env.split(",")
+        # flash attention frees the S^2 probs memory, so remat=none
+        # may compile where the xla column could not: keep it in.
+        REMAT = [r for r in REMAT if r[0] in ("none", "dots")]
+        BATCHES = [4, 6]
 
     results = []
     grid = list(itertools.product(REMAT, BATCHES, ATTN))

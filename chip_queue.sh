@@ -1,0 +1,32 @@
+#!/bin/bash
+# On-chip work queue: run when the TPU claim is free. ONE client at a
+# time; stages run sequentially and log to chip_logs/. Generous
+# timeouts only — killing a TPU client mid-compile wedges the claim
+# (docs/OPS.md "The chip").
+set -u
+cd "$(dirname "$0")"
+mkdir -p chip_logs
+TS=$(date +%H%M%S)
+log() { echo "[chip_queue $(date +%H:%M:%S)] $*" | tee -a "chip_logs/queue_$TS.log"; }
+
+log "stage 1: on-chip kernel validation (tpu_tests)"
+PBST_TPU_TESTS=1 timeout 1800 python -m pytest tpu_tests/ -q \
+    >"chip_logs/tpu_tests_$TS.log" 2>&1
+log "tpu_tests rc=$? (tail: $(tail -1 chip_logs/tpu_tests_$TS.log))"
+
+log "stage 2: serving benchmark"
+timeout 1500 python bench_serving.py \
+    >"chip_logs/serving_$TS.json" 2>"chip_logs/serving_$TS.err"
+log "bench_serving rc=$? ($(cat chip_logs/serving_$TS.json 2>/dev/null | tr '\n' ' '))"
+
+log "stage 3: pallas sweep points (dots x {4,6} x pallas)"
+PBST_SWEEP_ATTN=pallas timeout 2400 python bench_sweep.py \
+    >"chip_logs/sweep_pallas_$TS.jsonl" 2>"chip_logs/sweep_pallas_$TS.err"
+log "sweep rc=$? ($(tail -2 chip_logs/sweep_pallas_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
+
+log "stage 4: headline bench (final number, warm compile cache)"
+timeout 900 python bench.py \
+    >"chip_logs/bench_$TS.json" 2>"chip_logs/bench_$TS.err"
+log "bench rc=$? ($(cat chip_logs/bench_$TS.json 2>/dev/null))"
+
+log "queue complete"
