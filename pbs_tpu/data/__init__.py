@@ -1,9 +1,25 @@
-from pbs_tpu.data.tokens import TokenDataset, write_token_file
+from pbs_tpu.data.bytes import (
+    BOS,
+    EOS,
+    VOCAB,
+    corpus_from_file,
+    corpus_from_text,
+    decode_tokens,
+    encode_text,
+)
 from pbs_tpu.data.loader import Prefetcher, make_batch_source
+from pbs_tpu.data.tokens import TokenDataset, write_token_file
 
 __all__ = [
+    "BOS",
+    "EOS",
+    "VOCAB",
     "Prefetcher",
     "TokenDataset",
+    "corpus_from_file",
+    "corpus_from_text",
+    "decode_tokens",
+    "encode_text",
     "make_batch_source",
     "write_token_file",
 ]
