@@ -1,0 +1,84 @@
+"""End-to-end training from raw text on whatever device is present.
+
+    python examples/train_from_text.py [path/to/text.txt]
+
+Byte-level tokens (no external tokenizer), packed corpus, prefetched
+batches, jitted train step with remat, checkpoint at the end. Scale
+the config up on a real chip; this default runs in seconds on CPU.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# CPU by default even when the ambient env pins a TPU platform
+# (JAX_PLATFORMS=axon here); opt into the chip explicitly with
+# PBST_EXAMPLE_PLATFORM=axon when it is free.
+os.environ["JAX_PLATFORMS"] = os.environ.get(
+    "PBST_EXAMPLE_PLATFORM", "cpu")
+
+import tempfile
+
+import jax
+
+# The env var alone does not stop an ambient TPU plugin from
+# initializing (and hanging if the chip is held): pin via config too.
+try:
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+except RuntimeError:
+    pass
+import jax.numpy as jnp
+
+from pbs_tpu.ckpt import save_checkpoint
+from pbs_tpu.data import (
+    VOCAB,
+    Prefetcher,
+    TokenDataset,
+    corpus_from_file,
+    corpus_from_text,
+    make_batch_source,
+)
+from pbs_tpu.models import TransformerConfig, init_params, make_train_step
+
+BATCH, SEQ, STEPS = 4, 128, 30
+
+
+def main() -> int:
+    workdir = tempfile.mkdtemp(prefix="pbst_example_")
+    corpus = os.path.join(workdir, "corpus.tok")
+    if len(sys.argv) > 1:
+        n = corpus_from_file(corpus, sys.argv[1])
+    else:
+        n = corpus_from_text(
+            corpus, ["The credit scheduler multiplexes tenants over "
+                     "step quanta; telemetry feeds the slice. "] * 200)
+    print(f"corpus: {n} byte-tokens")
+
+    cfg = TransformerConfig(
+        vocab=VOCAB, d_model=128, n_layers=4, n_heads=8, n_kv_heads=4,
+        d_ff=256, max_seq=SEQ,
+        dtype=jnp.bfloat16 if jax.default_backend() == "tpu"
+        else jnp.float32,
+        remat=True, remat_policy="dots")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    init_opt, step = make_train_step(cfg, learning_rate=3e-3)
+    state = (params, jax.jit(init_opt)(params), 0)
+    step = jax.jit(step, donate_argnums=(0,))
+
+    ds = TokenDataset(corpus)
+    src = make_batch_source(ds, batch=BATCH, seq_len=SEQ, seed=0)
+    with Prefetcher(src, depth=2) as pf:
+        for i in range(STEPS):
+            state, m = step(state, jnp.asarray(next(pf)))
+            if i % 10 == 0 or i == STEPS - 1:
+                print(f"step {i:3d}  loss {float(m['loss']):.3f}")
+    ckpt = os.path.join(workdir, "ckpt")
+    save_checkpoint(ckpt, jax.device_get(state[0]),
+                    metadata={"steps": STEPS})
+    print(f"checkpoint: {ckpt}  (pbst ckpt-info / pbst quantize)")
+    ds.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
