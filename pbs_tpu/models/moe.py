@@ -193,7 +193,7 @@ def moe_mlp(cfg: MoEConfig, x: jax.Array, lp: dict, constrain_ec):
 
 
 def moe_layer_body(cfg: MoEConfig, x: jax.Array, lp: dict, cos, sin,
-                   constrain, constrain_ec):
+                   constrain, constrain_ec, mesh=None):
     B, S, _ = x.shape
     nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     dt = cfg.dtype
@@ -203,7 +203,9 @@ def moe_layer_body(cfg: MoEConfig, x: jax.Array, lp: dict, cos, sin,
     k = (h @ lp["wk"].astype(dt)).reshape(B, S, nkv, hd)
     v = (h @ lp["wv"].astype(dt)).reshape(B, S, nkv, hd)
     q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
-    attn = causal_attention(q, k, v, cfg).reshape(B, S, nh * hd)
+    # mesh threads the sequence-parallel impls (ring/ulysses) through,
+    # exactly like the dense flagship: long-context MoE is dp x ep x sp.
+    attn = causal_attention(q, k, v, cfg, mesh).reshape(B, S, nh * hd)
     x = constrain(x + attn @ lp["wo"].astype(dt))
 
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
@@ -213,7 +215,8 @@ def moe_layer_body(cfg: MoEConfig, x: jax.Array, lp: dict, cos, sin,
 
 
 def moe_forward(cfg: MoEConfig, params: dict, tokens: jax.Array,
-                constrain=lambda x: x, constrain_ec=lambda x: x):
+                constrain=lambda x: x, constrain_ec=lambda x: x,
+                mesh=None):
     """tokens (B, S) -> (logits (B, S, V) fp32, aux_loss, drop_frac)."""
     B, S = tokens.shape
     dt = cfg.dtype
@@ -221,7 +224,8 @@ def moe_forward(cfg: MoEConfig, params: dict, tokens: jax.Array,
     cos, sin = rope_tables(cfg, S)
 
     def body(x, lp, cos, sin):
-        return moe_layer_body(cfg, x, lp, cos, sin, constrain, constrain_ec)
+        return moe_layer_body(cfg, x, lp, cos, sin, constrain,
+                              constrain_ec, mesh)
 
     if cfg.remat:
         body = jax.checkpoint(body)
@@ -293,16 +297,28 @@ def make_moe_generate(cfg: MoEConfig, max_new_tokens: int,
 
 
 def moe_loss(cfg: MoEConfig, params: dict, tokens: jax.Array,
-             constrain=lambda x: x, constrain_ec=lambda x: x):
-    logits, aux, drop = moe_forward(
-        cfg, params, tokens[:, :-1], constrain, constrain_ec
-    )
-    lm = token_xent(logits, tokens[:, 1:])
+             constrain=lambda x: x, constrain_ec=lambda x: x,
+             mesh=None, full_seq: bool = False):
+    """``full_seq`` mirrors transformer.next_token_loss: forward over
+    all S tokens and drop the last logit, keeping the in-graph
+    sequence length divisible by an sp axis (and the routing groups
+    identical between the sharded and reference runs)."""
+    if full_seq:
+        logits, aux, drop = moe_forward(
+            cfg, params, tokens, constrain, constrain_ec, mesh
+        )
+        lm = token_xent(logits[:, :-1], tokens[:, 1:])
+    else:
+        logits, aux, drop = moe_forward(
+            cfg, params, tokens[:, :-1], constrain, constrain_ec, mesh
+        )
+        lm = token_xent(logits, tokens[:, 1:])
     return lm + cfg.aux_loss_weight * aux, (lm, aux, drop)
 
 
 def make_moe_train_step(cfg: MoEConfig, learning_rate: float = 3e-4,
-                        constrain=lambda x: x, constrain_ec=lambda x: x):
+                        constrain=lambda x: x, constrain_ec=lambda x: x,
+                        mesh=None, full_seq: bool = False):
     """Returns (init_opt_state, train_step); metrics include the router
     drop fraction — the batched in-graph contention hint (vcrd_op
     analog) the feedback policy consumes."""
@@ -316,7 +332,8 @@ def make_moe_train_step(cfg: MoEConfig, learning_rate: float = 3e-4,
     def train_step(state, tokens):
         params, opt_state, step = state
         (loss, (lm, aux, drop)), grads = jax.value_and_grad(
-            lambda p: moe_loss(cfg, p, tokens, constrain, constrain_ec),
+            lambda p: moe_loss(cfg, p, tokens, constrain, constrain_ec,
+                               mesh, full_seq),
             has_aux=True,
         )(params)
         updates, opt_state = tx.update(grads, opt_state, params)

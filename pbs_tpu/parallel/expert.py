@@ -54,7 +54,11 @@ def shard_moe_params(params: dict, mesh: Mesh, cfg: MoEConfig) -> dict:
 
 
 def moe_batch_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P("dp", None))
+    # One definition of "how token batches shard" for every family —
+    # a dense/MoE divergence here would be a silent parity break.
+    from pbs_tpu.parallel.sharding import batch_sharding
+
+    return batch_sharding(mesh)
 
 
 def expert_constrainer(mesh: Mesh | None):
@@ -75,7 +79,8 @@ def expert_constrainer(mesh: Mesh | None):
 def residual_constrainer(mesh: Mesh | None):
     if mesh is None or "dp" not in mesh.axis_names:
         return lambda x: x
-    spec = NamedSharding(mesh, P("dp", None, None))
+    seq = "sp" if "sp" in mesh.axis_names else None
+    spec = NamedSharding(mesh, P("dp", seq, None))
 
     def constrain(x):
         if x.ndim == 3:
@@ -91,13 +96,24 @@ def make_sharded_moe_train(
     learning_rate: float = 3e-4,
     key: jax.Array | None = None,
 ):
-    """Fully-sharded MoE train state + jitted step on a dp x ep mesh.
-    Opt-state layouts derive from the sharded params (propagation)."""
+    """Fully-sharded MoE train state + jitted step on a dp x ep mesh —
+    or dp x ep x sp for long-context MoE (cfg.attn_impl "ring" or
+    "ulysses": the sequence stays sharded through attention while the
+    expert all-to-all rides ep). Opt-state layouts derive from the
+    sharded params (propagation)."""
     key = key if key is not None else jax.random.PRNGKey(0)
+    seq_par = cfg.attn_impl in ("ring", "ulysses")
+    if seq_par and "sp" not in mesh.axis_names:
+        raise ValueError(
+            f"attn_impl={cfg.attn_impl!r} requires an 'sp' axis in the "
+            f"mesh; got axes {mesh.axis_names}"
+        )
     init_opt, train_step = make_moe_train_step(
         cfg, learning_rate,
         constrain=residual_constrainer(mesh),
         constrain_ec=expert_constrainer(mesh),
+        mesh=mesh if seq_par else None,
+        full_seq=seq_par,
     )
     params = shard_moe_params(init_moe_params(cfg, key), mesh, cfg)
     opt_state = jax.jit(init_opt)(params)
