@@ -1,0 +1,17 @@
+"""Chip-claim probe: init the axon TPU backend, run one tiny op, exit cleanly.
+
+Prints PROBE_OK on success. Run under generous supervision only
+(docs/OPS.md "The chip"): if this hangs, the claim is held elsewhere.
+"""
+import sys, time
+t0 = time.time()
+print(f"[probe +{time.time()-t0:5.1f}s] importing jax", flush=True)
+import jax
+print(f"[probe +{time.time()-t0:5.1f}s] jax imported, querying devices", flush=True)
+devs = jax.devices()
+print(f"[probe +{time.time()-t0:5.1f}s] devices: {devs}", flush=True)
+import jax.numpy as jnp
+x = jnp.ones((128, 128), dtype=jnp.bfloat16)
+y = (x @ x).block_until_ready()
+print(f"[probe +{time.time()-t0:5.1f}s] matmul ok, sum={float(y.sum())}", flush=True)
+print("PROBE_OK", flush=True)

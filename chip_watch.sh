@@ -1,0 +1,47 @@
+#!/bin/bash
+# Chip-claim watcher (round 3). Probes the TPU claim RARELY (>=25 min
+# apart, generous per-probe timeout — docs/OPS.md "The chip": frequent
+# short-timeout probes can re-wedge the claim), and the moment the
+# claim frees, runs the on-chip agenda. Time-aware: never starts work
+# that could still hold the chip when the driver's end-of-round
+# bench.py needs it.
+#
+# Usage: nohup ./chip_watch.sh <budget_seconds> &
+set -u
+cd "$(dirname "$0")"
+mkdir -p chip_logs
+BUDGET=${1:-36000}          # default 10h of watching
+START=$(date +%s)
+DEADLINE=$((START + BUDGET))
+FULL_QUEUE_S=7000           # worst-case chip_queue.sh wall time
+LOG="chip_logs/watch_$(date +%H%M%S).log"
+log() { echo "[watch $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+log "watching; budget=${BUDGET}s deadline=$(date -d @"$DEADLINE" +%H:%M:%S)"
+while :; do
+    NOW=$(date +%s)
+    if [ "$NOW" -ge "$DEADLINE" ]; then
+        log "deadline reached without a free claim; leaving chip alone"
+        exit 1
+    fi
+    log "probing claim (180s budget)"
+    timeout --signal=SIGTERM --kill-after=30 180 \
+        python chip_probe.py >"chip_logs/probe_last.log" 2>&1
+    rc=$?
+    if grep -q PROBE_OK chip_logs/probe_last.log; then
+        log "claim FREE (probe rc=$rc)"
+        REMAIN=$((DEADLINE - $(date +%s)))
+        if [ "$REMAIN" -ge "$FULL_QUEUE_S" ]; then
+            log "running full chip_queue.sh (${REMAIN}s remain)"
+            ./chip_queue.sh >>"$LOG" 2>&1
+            log "chip_queue done rc=$?"
+        else
+            log "only ${REMAIN}s remain: headline bench only (warms cache)"
+            python bench.py >"chip_logs/bench_late.json" 2>"chip_logs/bench_late.err"
+            log "late bench rc=$? ($(cat chip_logs/bench_late.json 2>/dev/null))"
+        fi
+        exit 0
+    fi
+    log "claim still held (rc=$rc, tail: $(tail -1 chip_logs/probe_last.log))"
+    sleep 1500
+done

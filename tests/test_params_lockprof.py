@@ -225,6 +225,10 @@ def test_cli_params_standalone_process():
     env = dict(os.environ)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    # The child must not inherit the ambient axon platform: a plain
+    # CLI invocation would otherwise initialize the real-TPU plugin
+    # (one-client rule — docs/OPS.md "The chip").
+    env["JAX_PLATFORMS"] = "cpu"
     out = subprocess.run(
         [sys.executable, "-c",
          "from pbs_tpu.cli.pbst import main; main(['params'])"],
