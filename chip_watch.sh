@@ -37,7 +37,12 @@ while :; do
             log "chip_queue done rc=$?"
         else
             log "only ${REMAIN}s remain: headline bench only (warms cache)"
-            python bench.py >"chip_logs/bench_late.json" 2>"chip_logs/bench_late.err"
+            # bench.py self-supervises (worker child under a 480s cap;
+            # the parent never imports JAX) — the outer cap is defense
+            # in depth sized well past any internal path, so it never
+            # kills a live TPU client mid-compile.
+            timeout --signal=SIGTERM --kill-after=60 1300 \
+                python bench.py >"chip_logs/bench_late.json" 2>"chip_logs/bench_late.err"
             log "late bench rc=$? ($(cat chip_logs/bench_late.json 2>/dev/null))"
         fi
         exit 0

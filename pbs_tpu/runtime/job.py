@@ -138,6 +138,15 @@ class Job:
         self.error: str | None = None
         # Scheduler-private per-job state hangs here (sched "domdata").
         self.sched_priv: Any = None
+        # Measured-telemetry override: profile every N-th invocation of
+        # THIS job regardless of the backend-wide default (None = use
+        # the backend's). Foreign tenants set this so they get measured
+        # phases without cooperating (the HVM vPMU analog).
+        self.profile_every: int | None = None
+        # (fn, args, kwargs) of a foreign callable adopted via
+        # Job.foreign — lets the backend harvest XLA cost analysis
+        # from the jit wrapper lazily, attributed to this job.
+        self._foreign_spec: tuple | None = None
         # Per-job console ring (the xl console analog): lifecycle
         # events land here; the workload writes via Job.log.
         from pbs_tpu.obs.console import Console
@@ -149,6 +158,55 @@ class Job:
         self.paged = None
         self.paged_bytes = 0
         self.paged_acct_bytes = 0
+
+    @classmethod
+    def foreign(
+        cls,
+        name: str,
+        fn: Callable[..., Any],
+        *call_args: Any,
+        params: "SchedParams | None" = None,
+        max_steps: int | None = None,
+        profile_every: int = 8,
+        **call_kwargs: Any,
+    ) -> "Job":
+        """Adopt an arbitrary jitted callable as a tenant — the HVM
+        vPMU analog.
+
+        The reference fully virtualizes the PMU for guests that know
+        nothing about the hypervisor: ``vpmu_core2.c`` saves/loads the
+        real counter MSRs around each vcpu switch and traps the guest's
+        own MSR accesses (``core2_vpmu_save``/``__core2_vpmu_load``,
+        ``xen-4.2.1/xen/arch/x86/hvm/vmx/vpmu_core2.c:267-518``), so a
+        non-paravirtualized HVM guest still yields measured telemetry.
+        Here the same claim: ``fn`` follows no framework protocol — any
+        signature, any return value, no metrics dict — yet the job gets
+        *measured* stall/collective phases, because the backend samples
+        the XLA profiler around its quanta (``telemetry/profiler.py``)
+        and harvests cost analysis from the jit wrapper, rather than
+        asking the workload to report.
+
+        Each step invokes ``fn(*call_args, **call_kwargs)`` and syncs
+        on its output; the arguments are fixed (a tenant that wants to
+        thread state through steps is by definition cooperating — use
+        the normal ``Job`` protocol).
+        """
+        job = cls(name, step_fn=None, state=None, params=params,
+                  max_steps=max_steps)
+
+        def step_fn(_state):
+            # Once the backend has harvested the AOT executable
+            # (telemetry.source._job_cost), dispatch through it: the
+            # jit wrapper's own call cache is separate from the AOT
+            # path, so calling ``fn`` again would compile a second
+            # time on a real chip (~20-40 s double-charged).
+            target = job.compiled if job.compiled is not None else fn
+            return target(*call_args, **call_kwargs)
+
+        job.step_fn = step_fn
+        job.profile_every = max(1, int(profile_every))
+        job._foreign_spec = (fn, call_args, call_kwargs)
+        return job
 
     def log(self, line: str) -> int:
         """Workload-side console write (the guest printk)."""

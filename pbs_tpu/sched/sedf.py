@@ -205,22 +205,26 @@ class SedfScheduler(Scheduler):
         """sedf_adjust_weights (sched_sedf.c:1294-1365): explicit
         reservations are projected onto WEIGHT_PERIOD and carved out;
         weighted jobs split the remainder in weight proportion."""
-        scs = [self._sc(c) for j in self.partition.jobs
-               if j is not exclude for c in j.contexts]
-        sumw = sum(sc.weight for sc in scs if sc.weight)
+        pairs = [(c, self._sc(c)) for j in self.partition.jobs
+                 if j is not exclude for c in j.contexts]
+        sumw = sum(sc.weight for _, sc in pairs if sc.weight)
         if not sumw:
             return
         sumt = sum(
             WEIGHT_PERIOD_US * sc.slice_orig_us // sc.period_orig_us
-            for sc in scs if not sc.weight)
+            for _, sc in pairs if not sc.weight)
         now = self.partition.clock.now_ns()
         free_us = max(0, WEIGHT_PERIOD_US - WEIGHT_SAFETY_US - sumt)
-        for sc in scs:
+        for ctx, sc in pairs:
             if not sc.weight:
                 continue
             sc.period_us = sc.period_orig_us = WEIGHT_PERIOD_US
             sc.slice_us = sc.slice_orig_us = sc.weight * free_us // sumw
-            if sc.deadline_ns <= now:
+            # Refresh deadlines only for contexts currently competing;
+            # a blocked context keeps deadline 0 / stale so its wake
+            # initializes the period there (same guard as
+            # set_reservation — avoids short-block misclassification).
+            if sc.deadline_ns <= now and ctx in self.contexts:
                 sc.deadline_ns = now + sc.period_us * US
                 sc.cputime_ns = 0
 

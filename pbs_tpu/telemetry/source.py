@@ -235,6 +235,20 @@ class TpuBackend:
         c = self._costs.get(job.name)
         if c is None:
             compiled = getattr(job, "compiled", None)
+            if compiled is None and getattr(job, "_foreign_spec", None):
+                # Foreign tenant (Job.foreign): harvest the executable
+                # from the jit wrapper without the workload's help —
+                # the MSR-interception analog (vpmu_core2.c:367-418
+                # reads the guest's counter MSRs; here we read the
+                # guest's XLA cost analysis). Attributed compile spend
+                # lands in the job's own COMPILE_* counters.
+                fn, a, k = job._foreign_spec
+                try:
+                    with self.compile_meter.attribute(job.name):
+                        compiled = fn.lower(*a, **k).compile()
+                    job.compiled = compiled
+                except Exception:
+                    compiled = None  # not a jit stage: profiler only
             c = cost_analysis_of(compiled) if compiled is not None else (0, 0)
             self._costs[job.name] = c
         return c
@@ -260,10 +274,18 @@ class TpuBackend:
         return self._measured.get(job_name)
 
     def _profile_due(self, job) -> bool:
-        if not self.profile_every or self.profiler is None:
+        # Per-job override first (foreign tenants carry their own
+        # sampling period so they get measured phases even when the
+        # backend-wide default is roofline-only).
+        every = getattr(job, "profile_every", None) or self.profile_every
+        if not every:
             return False
-        k = self._since_profile.get(job.name, self.profile_every)
-        due = k >= self.profile_every  # first invocation profiles
+        if self.profiler is None:
+            from pbs_tpu.telemetry.profiler import XlaQuantumProfiler
+
+            self.profiler = XlaQuantumProfiler()
+        k = self._since_profile.get(job.name, every)
+        due = k >= every  # first invocation profiles
         self._since_profile[job.name] = 1 if due else k + 1
         return due
 

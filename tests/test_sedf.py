@@ -246,6 +246,24 @@ def test_removed_job_frees_weighted_capacity():
     assert after > 2 * before, (before, after)
 
 
+def test_reweigh_leaves_blocked_contexts_unstamped():
+    """_reweigh (triggered by any set_weight) must not stamp a fresh
+    deadline on a blocked weighted tenant — its wake classifies the
+    unblock, same guard as set_reservation."""
+    part, be, jobs = setup([("w1", 100_000), ("w2", 100_000)])
+    part.scheduler.set_weight(jobs["w1"], 256)
+    part.scheduler.set_weight(jobs["w2"], 256)
+    part.timers.arm(5 * MS, lambda now: part.sleep_job(jobs["w1"]))
+    # While w1 sleeps, an unrelated adjust triggers _reweigh.
+    part.timers.arm(200 * MS, lambda now: part.scheduler.set_weight(
+        jobs["w2"], 512))
+    part.timers.arm(400 * MS, lambda now: part.wake_job(jobs["w1"]))
+    part.run(until_ns=1_000_000_000)
+    s = sc(jobs["w1"])
+    assert s.short_block_tot == 0, "reweigh-stamped deadline misread"
+    assert s.long_block_tot >= 1
+
+
 def test_newcomer_does_not_monopolize_slack():
     """A tenant joining after incumbents accumulated virtual time must
     not win every extra quantum until it 'catches up'."""
