@@ -97,7 +97,8 @@ def make_speculative_generate(
         out = out.at[:, 0].set(first)
 
         def round_body(carry):
-            out, n_out, cur, tcache, dcache, rounds, proposed, accepted = carry
+            (out, n_out, cur, tcache, dcache, rounds, proposed, accepted,
+             reverified_tot) = carry
             p0 = tcache["pos"]
 
             # Draft proposes k tokens (consuming cur..t_{k-1}).
@@ -126,6 +127,10 @@ def make_speculative_generate(
             m_row = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # (B,)
             m = jnp.min(m_row)
             bonus = jnp.take(g, m, axis=1)  # (B,): g_m per row
+            # Tokens rows verified past the batch-min: they will be
+            # re-proposed and re-verified next round — the lockstep
+            # tax the per-row variant eliminates.
+            reverified = jnp.sum(m_row - m)
 
             # Emit t_1..t_m then the bonus; the static-width window may
             # carry junk past m+1 — the next round's write (or the
@@ -140,18 +145,139 @@ def make_speculative_generate(
             tcache = dict(tcache, pos=p0 + m + 1)
             dcache = dict(dcache, pos=p0 + m + 1)
             return (out, n_out + m + 1, bonus, tcache, dcache,
-                    rounds + 1, proposed + k, accepted + m)
+                    rounds + 1, proposed + k, accepted + m,
+                    reverified_tot + reverified)
 
         def cond(carry):
             return carry[1] < max_new_tokens
 
         zero = jnp.zeros((), jnp.int32)
         carry = (out, jnp.ones((), jnp.int32), first, tcache, dcache,
-                 zero, zero, zero)
-        out, n_out, _, _, _, rounds, proposed, accepted = (
+                 zero, zero, zero, zero)
+        out, n_out, _, _, _, rounds, proposed, accepted, reverified = (
             jax.lax.while_loop(cond, round_body, carry))
         stats = {"rounds": rounds, "proposed": proposed,
-                 "accepted": accepted}
+                 "accepted": accepted, "reverified": reverified}
+        return out[:, :max_new_tokens], stats
+
+    return spec_generate
+
+
+def make_per_row_speculative_generate(
+    cfg: TransformerConfig,
+    draft_cfg: TransformerConfig,
+    max_new_tokens: int,
+    k: int = 4,
+):
+    """Per-row acceptance cursors: every row advances by ITS OWN
+    accepted prefix each round, instead of the batch minimum.
+
+    The lockstep variant (:func:`make_speculative_generate`) pays for
+    its shared scalar cache position by re-proposing — and
+    re-verifying — tokens that faster rows already verified (its
+    ``reverified`` stat). Here each row carries its own cache cursor,
+    built on the continuous batcher's per-slot machinery
+    (``serving._slot_forward``: per-row rope gather, vmapped
+    contiguous KV writes, per-row causal horizon), so re-verification
+    is structurally zero and the round count is governed by each row's
+    own acceptance, not the batch's worst.  Still greedy, still
+    token-exact per row, still static shapes: a finished row is frozen
+    by masking (advance 0), not by changing any shape.
+
+    Dense family only — MoE speculation stays on the lockstep variant
+    (its capacity semantics need batch-shaped forwards).
+    """
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if cfg.vocab != draft_cfg.vocab:
+        raise ValueError(
+            f"draft vocab {draft_cfg.vocab} != target vocab {cfg.vocab}")
+
+    from pbs_tpu.models.serving import _slot_forward, init_slot_cache
+
+    def spec_generate(params: dict, draft_params: dict,
+                      prompt: jax.Array):
+        B, P = prompt.shape
+        W = max_new_tokens + k + 1  # output window incl. overshoot
+        max_len = P + W
+        tcache = init_slot_cache(cfg, B, max_len)
+        dcache = init_slot_cache(draft_cfg, B, max_len)
+        zerop = jnp.zeros((B,), jnp.int32)
+
+        tlogits, tcache = _slot_forward(cfg, params, prompt, tcache, zerop)
+        _, dcache = _slot_forward(draft_cfg, draft_params, prompt,
+                                  dcache, zerop)
+        first = jnp.argmax(tlogits[:, -1, :], axis=-1).astype(jnp.int32)
+
+        out = jnp.zeros((B, W), jnp.int32)
+        out = out.at[:, 0].set(first)
+
+        write_rows = jax.vmap(
+            lambda row, new, s: jax.lax.dynamic_update_slice(row, new, (s,)))
+
+        def round_body(carry):
+            out, n_out, cur, pos, tcache, dcache, rounds, proposed, \
+                accepted = carry
+            active = n_out < max_new_tokens  # (B,) — frozen rows mask out
+
+            # Draft proposes k tokens per row from its own cursor.
+            def dstep(c, _):
+                tok, dc, dp = c
+                logits, dc = _slot_forward(draft_cfg, draft_params,
+                                           tok[:, None], dc, dp)
+                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                return (nxt, dc, dp + 1), nxt
+
+            (last, dcache, dp), props = jax.lax.scan(
+                dstep, (cur, dcache, pos), None, length=k)
+            t = props.T  # (B, k)
+            # Ingest t_k so the draft holds KV through pos+k whatever
+            # the acceptance (logits discarded; overwritten on rollback).
+            _, dcache = _slot_forward(draft_cfg, draft_params,
+                                      last[:, None], dcache, dp)
+
+            # Target verifies k+1 positions per row at its own cursor.
+            x = jnp.concatenate([cur[:, None], t], axis=1)  # (B, k+1)
+            logits, tcache = _slot_forward(cfg, params, x, tcache, pos)
+            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, k+1)
+
+            # Per-row accepted prefix — NO batch-min.
+            match = (t == g[:, :k]).astype(jnp.int32)
+            m_row = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # (B,)
+            bonus = jnp.take_along_axis(
+                g, m_row[:, None], axis=1)[:, 0]  # (B,)
+
+            # Emit t_1..t_{m_b} then the bonus at column m_b, per row.
+            cols = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+            round_toks = jnp.concatenate(
+                [t, jnp.zeros((B, 1), jnp.int32)], axis=1)
+            round_toks = jnp.where(cols == m_row[:, None],
+                                   bonus[:, None], round_toks)
+            out_new = write_rows(out, round_toks, n_out)
+            out = jnp.where(active[:, None], out_new, out)
+
+            # Frozen rows advance nothing: cursor, count, cur all hold.
+            adv = jnp.where(active, m_row + 1, 0)
+            pos = pos + adv
+            cur = jnp.where(active, bonus, cur)
+            n_act = jnp.sum(active.astype(jnp.int32))
+            return (out, n_out + adv, cur, pos, tcache, dcache,
+                    rounds + 1, proposed + k * n_act,
+                    accepted + jnp.sum(jnp.where(active, m_row, 0)))
+
+        def cond(carry):
+            return jnp.min(carry[1]) < max_new_tokens
+
+        zero = jnp.zeros((), jnp.int32)
+        carry = (out, jnp.ones((B,), jnp.int32), first, zerop + P,
+                 tcache, dcache, zero, zero, zero)
+        out, n_out, _, _, _, _, rounds, proposed, accepted = (
+            jax.lax.while_loop(cond, round_body, carry))
+        stats = {"rounds": rounds, "proposed": proposed,
+                 "accepted": accepted,
+                 "reverified": jnp.zeros((), jnp.int32)}
         return out[:, :max_new_tokens], stats
 
     return spec_generate
@@ -170,8 +296,11 @@ def make_speculative_serve_step(
     ``tokens`` (Counter.TOKENS) and ``spec_proposed``
     (Counter.SPEC_PROPOSED), so ``pbst top``-class monitors can read
     the speculation efficiency of a serving tenant exactly like any
-    other PMC-style rate."""
-    spec = make_speculative_generate(cfg, draft_cfg, max_new_tokens, k)
+    other PMC-style rate. Uses the per-row variant: serving batches
+    mix unrelated prompts, exactly where lockstep's batch-min
+    re-verification tax is worst."""
+    spec = make_per_row_speculative_generate(
+        cfg, draft_cfg, max_new_tokens, k)
 
     def serve_step(state, prompts: jax.Array):
         params, draft_params, served = state
