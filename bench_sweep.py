@@ -102,9 +102,10 @@ def main() -> int:
     if attn_env:
         ATTN = attn_env.split(",")
         # flash attention frees the S^2 probs memory, so remat=none
-        # may compile where the xla column could not: keep it in.
+        # and batch 8 may compile where the xla column could not
+        # (r02: batch 8 under remat("dots") failed) — keep them in:
+        # that unlock is the MFU-push hypothesis the sweep must test.
         REMAT = [r for r in REMAT if r[0] in ("none", "dots")]
-        BATCHES = [4, 6]
 
     results = []
     grid = list(itertools.product(REMAT, BATCHES, ATTN))

@@ -3,30 +3,45 @@
 # time; stages run sequentially and log to chip_logs/. Generous
 # timeouts only — killing a TPU client mid-compile wedges the claim
 # (docs/OPS.md "The chip").
+#
+# Stage order is evidence-priority: headline number first (the round's
+# make-or-break artifact + warm compile cache), then kernel
+# validation, then the serving / sweep / long-context agenda.
 set -u
 cd "$(dirname "$0")"
 mkdir -p chip_logs
 TS=$(date +%H%M%S)
 log() { echo "[chip_queue $(date +%H:%M:%S)] $*" | tee -a "chip_logs/queue_$TS.log"; }
 
-log "stage 1: on-chip kernel validation (tpu_tests)"
+log "stage 1: headline bench (self-supervised; outer cap is slack)"
+timeout --signal=SIGTERM --kill-after=60 1300 python bench.py \
+    >"chip_logs/bench_$TS.json" 2>"chip_logs/bench_$TS.err"
+log "bench rc=$? ($(cat chip_logs/bench_$TS.json 2>/dev/null))"
+
+log "stage 2: on-chip kernel validation (tpu_tests)"
 PBST_TPU_TESTS=1 timeout 1800 python -m pytest tpu_tests/ -q \
     >"chip_logs/tpu_tests_$TS.log" 2>&1
 log "tpu_tests rc=$? (tail: $(tail -1 chip_logs/tpu_tests_$TS.log))"
 
-log "stage 2: serving benchmark"
+log "stage 3: serving benchmark"
 timeout 1500 python bench_serving.py \
     >"chip_logs/serving_$TS.json" 2>"chip_logs/serving_$TS.err"
 log "bench_serving rc=$? ($(cat chip_logs/serving_$TS.json 2>/dev/null | tr '\n' ' '))"
 
-log "stage 3: pallas sweep points (dots x {4,6} x pallas)"
-PBST_SWEEP_ATTN=pallas timeout 2400 python bench_sweep.py \
+log "stage 4: pallas sweep (incl. batch-8 / remat-none MFU push points)"
+PBST_SWEEP_ATTN=pallas timeout --signal=SIGTERM --kill-after=60 3600 \
+    python bench_sweep.py \
     >"chip_logs/sweep_pallas_$TS.jsonl" 2>"chip_logs/sweep_pallas_$TS.err"
 log "sweep rc=$? ($(tail -2 chip_logs/sweep_pallas_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
 
-log "stage 4: headline bench (final number, warm compile cache)"
-timeout 900 python bench.py \
-    >"chip_logs/bench_$TS.json" 2>"chip_logs/bench_$TS.err"
-log "bench rc=$? ($(cat chip_logs/bench_$TS.json 2>/dev/null))"
+log "stage 5: long-context flash-vs-xla (S=4096/8192)"
+timeout 2400 python bench_longctx.py \
+    >"chip_logs/longctx_$TS.jsonl" 2>"chip_logs/longctx_$TS.err"
+log "longctx rc=$? ($(tail -3 chip_logs/longctx_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
+
+log "stage 6: headline bench re-run (warm cache, final number)"
+timeout --signal=SIGTERM --kill-after=60 1300 python bench.py \
+    >"chip_logs/bench_final_$TS.json" 2>"chip_logs/bench_final_$TS.err"
+log "final bench rc=$? ($(cat chip_logs/bench_final_$TS.json 2>/dev/null))"
 
 log "queue complete"

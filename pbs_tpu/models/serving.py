@@ -156,8 +156,14 @@ class ContinuousBatcher:
                  n_slots: int = 4, prompt_bucket: int = 64,
                  max_len: int | None = None, temperature: float = 0.0,
                  eos_id: int | None = None, seed: int = 0,
-                 mesh=None, prefix_cache_size: int = 0):
+                 mesh=None, prefix_cache_size: int = 0,
+                 clock=None):
         self.cfg = cfg
+        # Latency-stat clock: seconds, monotonic. Injectable so TTFT /
+        # completion latencies can be accounted in virtual time —
+        # deterministic SLO tests and replayable traces (the xentop
+        # analog reads the same stats either way).
+        self._now = clock or time.monotonic
         self.n_slots = n_slots
         self.bucket = prompt_bucket
         self.max_len = max_len or cfg.max_seq
@@ -336,7 +342,7 @@ class ContinuousBatcher:
         rid = next(self._ids)
         self.queue.append((rid, prompt, int(max_new_tokens)))
         self._submitted_step[rid] = self.steps
-        self._submitted_t[rid] = time.monotonic()
+        self._submitted_t[rid] = self._now()
         return rid
 
     # -- the engine tick --------------------------------------------------
@@ -387,7 +393,7 @@ class ContinuousBatcher:
             self.slot_remaining[slot] = max_new - 1
             self.slot_waited[slot] = (
                 self.steps - self._submitted_step.pop(rid, self.steps))
-            now = time.monotonic()
+            now = self._now()
             t_submit = self._submitted_t.pop(rid, now)
             self.slot_submit_t[slot] = t_submit
             self.slot_ttft[slot] = now - t_submit  # first token sampled
@@ -396,7 +402,7 @@ class ContinuousBatcher:
             self.tokens_emitted += 1
 
     def _retire(self, slot: int) -> Completion:
-        lat = time.monotonic() - float(self.slot_submit_t[slot])
+        lat = self._now() - float(self.slot_submit_t[slot])
         ttft = float(self.slot_ttft[slot])
         comp = Completion(
             request_id=self.slot_req[slot],

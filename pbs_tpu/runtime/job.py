@@ -201,7 +201,11 @@ class Job:
             # path, so calling ``fn`` again would compile a second
             # time on a real chip (~20-40 s double-charged).
             target = job.compiled if job.compiled is not None else fn
-            return target(*call_args, **call_kwargs)
+            # Pin the no-cooperation contract: wrap in (state, {}) so
+            # a foreign fn returning (output, some_dict) — an ordinary
+            # JAX (out, aux) shape — is never sniffed as the
+            # cooperative metrics protocol by the backend.
+            return target(*call_args, **call_kwargs), {}
 
         job.step_fn = step_fn
         job.profile_every = max(1, int(profile_every))

@@ -25,3 +25,35 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+# Build the native runtime from source before tests import it: the
+# committed .so must never drift silently from pbst_runtime.cc (tests
+# would prefer a stale binary and pass against code that no longer
+# exists). ~1 s when stale, no-op when fresh; build failure falls back
+# to whatever exists — native-gated tests then skip or exercise the
+# committed artifact, and the warning says so.
+import subprocess
+
+
+def _build_native() -> None:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    native = os.path.join(root, "native")
+    if not os.path.isdir(native):
+        return
+    try:
+        out = subprocess.run(
+            ["make", "-C", native], capture_output=True, text=True,
+            timeout=120)
+        if out.returncode != 0:
+            import warnings
+
+            warnings.warn(
+                "native build failed; tests run against the committed "
+                f".so: {out.stderr.strip()[:400]}", stacklevel=1)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        import warnings
+
+        warnings.warn(f"native build skipped: {e}", stacklevel=1)
+
+
+_build_native()

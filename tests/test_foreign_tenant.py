@@ -83,6 +83,22 @@ def test_foreign_job_cost_analysis_harvested():
     assert per_step > 2 * N**3  # at least one matmul's worth measured
 
 
+def test_foreign_tuple_return_not_sniffed_as_metrics():
+    """A foreign fn returning an ordinary (output, aux_dict) pair must
+    NOT have the dict reinterpreted as cooperative step metrics."""
+    @jax.jit
+    def fn(a):
+        return a * 2, {"tokens": jnp.sum(a)}
+
+    be = TpuBackend(profile_every=0)
+    part = Partition("p", source=be)
+    job = part.add_job(Job.foreign("t", fn, _x(), max_steps=2))
+    part.run()
+    assert job.steps_retired() == 2
+    # The 'tokens' key must not leak into the telemetry ledger.
+    assert int(job.contexts[0].counters[Counter.TOKENS]) == 0
+
+
 def test_foreign_job_without_jit_stage_still_runs():
     """A callable that is not a jit stage (no .lower) degrades
     gracefully: no cost analysis, but profiling still measures it."""

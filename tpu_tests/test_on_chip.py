@@ -124,6 +124,43 @@ def test_instrumented_matmul_compiled():
     assert st.flops == 8 * 2 * 256 ** 3
 
 
+def test_profiler_device_lane_parse_on_chip():
+    """The measured-telemetry path against a REAL chip trace (verdict
+    r2 weak #4: the parser was only ever validated on CPU thunk
+    events). Asserts device lanes are found and the compute/memory
+    phase signal separates an MXU-bound program from an HBM-bound one
+    on real device-lane timing."""
+    from pbs_tpu.telemetry.profiler import XlaQuantumProfiler
+
+    n = 1024
+    x = jnp.ones((n, n), jnp.bfloat16)
+
+    @jax.jit
+    def mm(a):
+        for _ in range(8):
+            a = (a @ a) / n
+        return a
+
+    @jax.jit
+    def ew(a):
+        for _ in range(60):
+            a = jnp.tanh(a) + 0.1
+        return a
+
+    mm(x).block_until_ready()  # compile outside the trace
+    ew(x).block_until_ready()
+    prof = XlaQuantumProfiler()
+    _, st_mm = prof.profile(lambda: mm(x).block_until_ready())
+    _, st_ew = prof.profile(lambda: ew(x).block_until_ready())
+    assert st_mm is not None and st_ew is not None, prof.last_error
+    # Real-chip traces must surface device lanes, not host thunks.
+    assert st_mm.source == "device", (st_mm.source, st_mm.top_ops)
+    assert st_mm.n_ops > 0 and st_ew.n_ops > 0
+    assert st_mm.compute_ns > 0, st_mm.top_ops
+    assert st_ew.stall_frac > st_mm.stall_frac + 0.2, (
+        st_mm.top_ops, st_ew.top_ops)
+
+
 def test_pallas_train_step_compiled():
     """attn_impl='pallas' through a full fwd+bwd+AdamW train step on
     the chip (tiny model, one step)."""
