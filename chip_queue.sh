@@ -39,6 +39,11 @@ timeout 2400 python bench_longctx.py \
     >"chip_logs/longctx_$TS.jsonl" 2>"chip_logs/longctx_$TS.err"
 log "longctx rc=$? ($(tail -3 chip_logs/longctx_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
 
+log "stage 5b: roofline decomposition (MFU accounting)"
+timeout --signal=SIGTERM --kill-after=60 1200 python bench_decompose.py \
+    >"chip_logs/decompose_$TS.jsonl" 2>"chip_logs/decompose_$TS.err"
+log "decompose rc=$? ($(tail -1 chip_logs/decompose_$TS.jsonl 2>/dev/null))"
+
 log "stage 6: headline bench re-run (warm cache, final number)"
 timeout --signal=SIGTERM --kill-after=60 1300 python bench.py \
     >"chip_logs/bench_final_$TS.json" 2>"chip_logs/bench_final_$TS.err"

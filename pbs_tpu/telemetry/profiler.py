@@ -55,26 +55,44 @@ _COLLECTIVE_PREFIXES = (
     "collective-permute", "collective-broadcast", "send", "recv",
     "send-done", "recv-done",
 )
-_COMPUTE_MARKS = ("dot", "conv", "einsum", "cholesky", "triangular-solve",
-                  "fft")
-_INFEED_MARKS = ("infeed", "outfeed", "copy-start", "copy-done")
+_COMPUTE_MARKS = ("dot", "convolution", "einsum", "cholesky",
+                  "triangular-solve", "fft")
+# Control-flow CONTAINERS: their event duration spans the whole body,
+# whose ops appear as their own events — counting the container would
+# double-bill every inner op into the memory bucket (a lax.scan train
+# loop showed up as one giant 'while' stall). Structural no-op events
+# are excluded with them.
+_CONTAINER_OPS = ("while", "conditional", "call", "tuple", "parameter",
+                  "get-tuple-element", "constant", "bitcast",
+                  "opt-barrier", "after-all")
 
 
 def classify_op(name: str, long_name: str = "") -> str | None:
     """Bucket one trace event: 'compute' | 'collective' | 'memory' |
-    None (not an HLO op — runtime/python frame)."""
+    None (not an HLO op — runtime/python frame, or a control-flow
+    container whose children are billed individually)."""
     if not _OP_RE.match(name):
         return None
     base = name
     for pre in ("wrapped_", "fused_"):
         if base.startswith(pre):
             base = base[len(pre):]
+    for pre in _CONTAINER_OPS:
+        if base == pre or base.startswith(pre + "."):
+            return None
     for pre in _COLLECTIVE_PREFIXES:
         if base == pre or base.startswith(pre + "."):
             return "collective"
-    hay = base + " " + long_name
-    if any(m in hay for m in _COMPUTE_MARKS):
-        return "compute"
+    # Exact-boundary matching on the op name ('dot_general.1',
+    # 'convolution.3'), NOT substrings — 'convert' must not hit 'conv'
+    # and bill dtype casts to the MXU bucket. Fusions are classified by
+    # their root in long_name ('fusion(dot(...))'), where the mark is
+    # anchored to a call-paren.
+    for m in _COMPUTE_MARKS:
+        if base == m or base.startswith((m + ".", m + "_", m + "-")):
+            return "compute"
+        if (m + "(") in long_name:
+            return "compute"
     return "memory"
 
 

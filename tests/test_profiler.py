@@ -52,6 +52,12 @@ def test_classify_op_buckets():
     assert classify_op("ParseArguments") is None
     assert classify_op("$profiler.py:246 trace") is None
     assert classify_op("end: dot_general.1") is None
+    # control-flow containers span their whole body (children are
+    # billed individually) — counting them double-bills the body
+    assert classify_op("while.246") is None
+    assert classify_op("conditional.3") is None
+    assert classify_op("get-tuple-element.17") is None
+    assert classify_op("opt-barrier.1") is None
 
 
 def test_parse_trace_events_sums_and_union():
