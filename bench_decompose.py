@@ -15,8 +15,10 @@ allows:
   compile time, so the none-point compiles or reports its failure
   without a wedge risk).
 - **attention_share**: analytic causal attention matmul FLOPs
-  (fwd+bwd ~ 12*L*S*d per token with the causal 1/2) over the
-  measured total.
+  (fwd+bwd ~ 12*L*S*d per token with the causal 1/2) over the 6N
+  dense convention (the same denominator bench.py's MFU uses), so
+  the share reads directly as "MFU points the 6N convention does
+  not credit".
 - **dispatch_overhead**: per-step time of a 1-step dispatch vs a
   10-step on-device lax.scan chunk — the tunnel/dispatch cost the
   scan amortizes.

@@ -98,6 +98,13 @@ def main() -> int:
         SEQ, STEPS, BATCHES = 128, 2, [2]
     # Env-restricted grids for follow-up runs (e.g. the pallas column
     # alone after a kernel fix, chip_queue.sh stage 3).
+    lc_env = os.environ.get("PBST_SWEEP_LOSS_CHUNKS")
+    if lc_env:
+        # Chunked cross-entropy: the (B, S, vocab) fp32 logits tensor
+        # never materializes — the hypothesis is that freeing ~0.8 GB
+        # of loss-tail activation unlocks the batch-8 points that
+        # failed to compile in r02.
+        cfg_base = dataclasses.replace(cfg_base, loss_chunks=int(lc_env))
     attn_env = os.environ.get("PBST_SWEEP_ATTN")
     if attn_env:
         ATTN = attn_env.split(",")
@@ -114,6 +121,8 @@ def main() -> int:
             continue  # interpreter-mode pallas is too slow to smoke
         try:
             r = run_point(cfg_base, rname, remat, policy, batch, attn)
+            if cfg_base.loss_chunks > 1:
+                r["loss_chunks"] = cfg_base.loss_chunks
         except Exception as e:  # noqa: BLE001 — a failing point (OOM,
             r = {"remat": rname, "batch": batch, "attn": attn,  # eg)
                  "error": f"{type(e).__name__}: {str(e)[:120]}"}

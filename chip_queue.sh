@@ -34,6 +34,12 @@ PBST_SWEEP_ATTN=pallas timeout --signal=SIGTERM --kill-after=60 3600 \
     >"chip_logs/sweep_pallas_$TS.jsonl" 2>"chip_logs/sweep_pallas_$TS.err"
 log "sweep rc=$? ($(tail -2 chip_logs/sweep_pallas_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
 
+log "stage 4c: chunked-CE sweep (does loss_chunks=8 unlock batch 8?)"
+PBST_SWEEP_LOSS_CHUNKS=8 PBST_SWEEP_ATTN=xla \
+    timeout --signal=SIGTERM --kill-after=60 1500 python bench_sweep.py \
+    >"chip_logs/sweep_lc8_$TS.jsonl" 2>"chip_logs/sweep_lc8_$TS.err"
+log "lc8 sweep rc=$? ($(tail -2 chip_logs/sweep_lc8_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
+
 log "stage 5: long-context flash-vs-xla (S=4096/8192)"
 timeout 2400 python bench_longctx.py \
     >"chip_logs/longctx_$TS.jsonl" 2>"chip_logs/longctx_$TS.err"

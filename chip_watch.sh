@@ -13,7 +13,7 @@ mkdir -p chip_logs
 BUDGET=${1:-36000}          # default 10h of watching
 START=$(date +%s)
 DEADLINE=$((START + BUDGET))
-FULL_QUEUE_S=13400          # worst-case chip_queue.sh wall time (7 stages)
+FULL_QUEUE_S=15000          # worst-case chip_queue.sh wall time (8 stages)
 LOG="chip_logs/watch_$(date +%H%M%S).log"
 log() { echo "[watch $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
 

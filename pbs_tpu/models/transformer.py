@@ -61,6 +61,13 @@ class TransformerConfig:
     # ("ring"/"ulysses"): "dense" (XLA einsum) or "flash" (Pallas
     # kernel — long chunks never materialize probabilities).
     ring_block: str = "dense"
+    # Chunked cross-entropy: compute the head matmul + softmax over
+    # n sequence chunks under jax.checkpoint, so the (B, S, vocab)
+    # logits tensor (fp32: ~0.8 GB at the flagship shape) never
+    # materializes — the loss tail's activation drops to O(S/n * V)
+    # for ~one extra head-matmul pass of recompute in the backward.
+    # 0/1 = off (materialized logits, the original path).
+    loss_chunks: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -232,9 +239,10 @@ def layer_body(cfg: TransformerConfig, x: jax.Array, lp: dict,
 # -- forward / loss ---------------------------------------------------------
 
 
-def forward(cfg: TransformerConfig, params: dict, tokens: jax.Array,
-            constrain=lambda x: x, mesh=None) -> jax.Array:
-    """tokens (B, S) int32 -> logits (B, S, vocab) fp32."""
+def forward_hidden(cfg: TransformerConfig, params: dict,
+                   tokens: jax.Array, constrain=lambda x: x,
+                   mesh=None) -> jax.Array:
+    """tokens (B, S) int32 -> final normed hidden (B, S, d_model)."""
     B, S = tokens.shape
     dt = cfg.dtype
     x = constrain(params["embed"].astype(dt)[tokens])
@@ -259,9 +267,15 @@ def forward(cfg: TransformerConfig, params: dict, tokens: jax.Array,
         return body(x, lp, cos, sin), None
 
     x, _ = jax.lax.scan(scan_fn, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["head"].astype(dt)).astype(jnp.float32)
-    return logits
+    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+
+def forward(cfg: TransformerConfig, params: dict, tokens: jax.Array,
+            constrain=lambda x: x, mesh=None) -> jax.Array:
+    """tokens (B, S) int32 -> logits (B, S, vocab) fp32."""
+    x = forward_hidden(cfg, params, tokens, constrain, mesh)
+    dt = cfg.dtype
+    return (x @ params["head"].astype(dt)).astype(jnp.float32)
 
 
 def token_xent(logits: jax.Array, targets: jax.Array) -> jax.Array:
@@ -270,6 +284,43 @@ def token_xent(logits: jax.Array, targets: jax.Array) -> jax.Array:
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
+
+
+def chunked_head_xent(cfg: TransformerConfig, x: jax.Array,
+                      head: jax.Array, targets: jax.Array,
+                      weights: jax.Array, n_chunks: int) -> jax.Array:
+    """Cross-entropy over the head WITHOUT materializing (B, S, vocab):
+    scan over S/n sequence chunks, each computing its logits slab,
+    fp32 log-softmax, and target gather, then discarding the slab.
+    ``jax.checkpoint`` on the chunk body makes the backward recompute
+    each slab in turn — peak loss-tail activation is O(S/n * vocab)
+    instead of O(S * vocab), for ~one extra head-matmul pass.
+
+    ``weights`` (B, S) float mask selects which positions count (the
+    causal shift leaves the last position targetless). Exact: same
+    fp32 reduction as the materialized path, so loss AND grads match
+    to numerical noise (pinned by test)."""
+    B, S, d = x.shape
+    if S % n_chunks:
+        raise ValueError(f"S={S} not divisible by loss_chunks={n_chunks}")
+    C = S // n_chunks
+    dt = cfg.dtype
+    # (n, B, C, ...) chunk-major so lax.scan walks the sequence.
+    xs = x.reshape(B, n_chunks, C, d).transpose(1, 0, 2, 3)
+    ts = targets.reshape(B, n_chunks, C).transpose(1, 0, 2)
+    ws = weights.reshape(B, n_chunks, C).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def chunk_nll(carry, xtw):
+        xc, tc, wc = xtw
+        logits = (xc @ head.astype(dt)).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, tc[..., None], axis=-1)[..., 0]
+        return carry - jnp.sum(ll * wc), None
+
+    total, _ = jax.lax.scan(chunk_nll, jnp.zeros((), jnp.float32),
+                            (xs, ts, ws))
+    return total / jnp.sum(weights)
 
 
 def default_optimizer(learning_rate: float):
@@ -289,6 +340,20 @@ def next_token_loss(cfg: TransformerConfig, params: dict,
     causal model, but keeps the in-graph sequence length divisible by
     the sp axis for ring attention (S-1 rarely divides the ring size).
     """
+    if cfg.loss_chunks > 1:
+        # Chunked loss tail: forward ALL S tokens to hidden (so the
+        # chunk count divides a power-of-two S, not S-1), then scan
+        # the head with the last position masked out — identical
+        # arithmetic to the materialized causal loss.
+        B, S = tokens.shape
+        x = forward_hidden(cfg, params, tokens, constrain, mesh)
+        targets = jnp.concatenate(
+            [tokens[:, 1:], jnp.zeros((B, 1), tokens.dtype)], axis=1)
+        weights = jnp.concatenate(
+            [jnp.ones((B, S - 1), jnp.float32),
+             jnp.zeros((B, 1), jnp.float32)], axis=1)
+        return chunked_head_xent(cfg, x, params["head"], targets,
+                                 weights, cfg.loss_chunks)
     if full_seq:
         logits = forward(cfg, params, tokens, constrain, mesh)
         return token_xent(logits[:, :-1], tokens[:, 1:])

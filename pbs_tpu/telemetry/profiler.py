@@ -56,7 +56,13 @@ _COLLECTIVE_PREFIXES = (
     "send-done", "recv-done",
 )
 _COMPUTE_MARKS = ("dot", "convolution", "einsum", "cholesky",
-                  "triangular-solve", "fft")
+                  "triangular-solve", "fft",
+                  # Pallas kernels lower to custom-calls (Mosaic on
+                  # TPU). In THIS framework every custom-call is a
+                  # compute kernel (flash attention, instrumented
+                  # matmul — ops/), so their time belongs to the MXU
+                  # bucket, not the stall proxy.
+                  "custom-call", "tpu_custom_call", "mosaic")
 # Control-flow CONTAINERS: their event duration spans the whole body,
 # whose ops appear as their own events — counting the container would
 # double-bill every inner op into the memory bucket (a lax.scan train

@@ -58,6 +58,11 @@ def test_classify_op_buckets():
     assert classify_op("conditional.3") is None
     assert classify_op("get-tuple-element.17") is None
     assert classify_op("opt-barrier.1") is None
+    # dtype casts are NOT compute ('convert' must not substring-match
+    # 'conv'); pallas kernels (custom-calls) ARE
+    assert classify_op("convert.5") == "memory"
+    assert classify_op("custom-call.2") == "compute"
+    assert classify_op("tpu_custom_call.1") == "compute"
 
 
 def test_parse_trace_events_sums_and_union():
