@@ -37,9 +37,11 @@ from pbs_tpu.models.transformer import (
     TransformerConfig,
     apply_rope,
     causal_attention,
+    chunked_head_xent,
     default_optimizer,
     rms_norm,
     rope_tables,
+    shift_targets_and_weights,
     token_xent,
 )
 
@@ -214,10 +216,10 @@ def moe_layer_body(cfg: MoEConfig, x: jax.Array, lp: dict, cos, sin,
     return x, aux, drop
 
 
-def moe_forward(cfg: MoEConfig, params: dict, tokens: jax.Array,
-                constrain=lambda x: x, constrain_ec=lambda x: x,
-                mesh=None):
-    """tokens (B, S) -> (logits (B, S, V) fp32, aux_loss, drop_frac)."""
+def moe_forward_hidden(cfg: MoEConfig, params: dict, tokens: jax.Array,
+                       constrain=lambda x: x, constrain_ec=lambda x: x,
+                       mesh=None):
+    """tokens (B, S) -> (final normed hidden (B, S, d), aux, drop)."""
     B, S = tokens.shape
     dt = cfg.dtype
     x = constrain(params["embed"].astype(dt)[tokens])
@@ -240,8 +242,17 @@ def moe_forward(cfg: MoEConfig, params: dict, tokens: jax.Array,
         scan_fn, (x, zero, zero), params["layers"]
     )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["head"].astype(dt)).astype(jnp.float32)
-    return logits, aux / cfg.n_layers, drop / cfg.n_layers
+    return x, aux / cfg.n_layers, drop / cfg.n_layers
+
+
+def moe_forward(cfg: MoEConfig, params: dict, tokens: jax.Array,
+                constrain=lambda x: x, constrain_ec=lambda x: x,
+                mesh=None):
+    """tokens (B, S) -> (logits (B, S, V) fp32, aux_loss, drop_frac)."""
+    x, aux, drop = moe_forward_hidden(cfg, params, tokens, constrain,
+                                      constrain_ec, mesh)
+    logits = (x @ params["head"].astype(cfg.dtype)).astype(jnp.float32)
+    return logits, aux, drop
 
 
 # -- serving (KV-cached autoregressive decode) ------------------------------
@@ -302,7 +313,20 @@ def moe_loss(cfg: MoEConfig, params: dict, tokens: jax.Array,
     """``full_seq`` mirrors transformer.next_token_loss: forward over
     all S tokens and drop the last logit, keeping the in-graph
     sequence length divisible by an sp axis (and the routing groups
-    identical between the sharded and reference runs)."""
+    identical between the sharded and reference runs).
+
+    ``cfg.loss_chunks > 1`` uses the chunked loss tail shared with the
+    dense family (``transformer.chunked_head_xent``): the (B, S, V)
+    logits never materialize — at MoE scale the vocab head is the same
+    memory hog it is dense."""
+    if cfg.loss_chunks > 1:
+        x, aux, drop = moe_forward_hidden(
+            cfg, params, tokens, constrain, constrain_ec, mesh
+        )
+        targets, weights = shift_targets_and_weights(tokens)
+        lm = chunked_head_xent(cfg, x, params["head"], targets, weights,
+                               cfg.loss_chunks)
+        return lm + cfg.aux_loss_weight * aux, (lm, aux, drop)
     if full_seq:
         logits, aux, drop = moe_forward(
             cfg, params, tokens, constrain, constrain_ec, mesh

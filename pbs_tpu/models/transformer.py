@@ -286,6 +286,21 @@ def token_xent(logits: jax.Array, targets: jax.Array) -> jax.Array:
     return -jnp.mean(ll)
 
 
+def shift_targets_and_weights(tokens: jax.Array):
+    """Causal-shift targets for a full-S forward: targets[b, s] =
+    tokens[b, s+1], with the (targetless) last position zero-padded
+    and masked out via the returned fp32 weights. The ONE copy of the
+    parity-critical masking both the dense and MoE chunked losses
+    use."""
+    B, S = tokens.shape
+    targets = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros((B, 1), tokens.dtype)], axis=1)
+    weights = jnp.concatenate(
+        [jnp.ones((B, S - 1), jnp.float32),
+         jnp.zeros((B, 1), jnp.float32)], axis=1)
+    return targets, weights
+
+
 def chunked_head_xent(cfg: TransformerConfig, x: jax.Array,
                       head: jax.Array, targets: jax.Array,
                       weights: jax.Array, n_chunks: int) -> jax.Array:
@@ -345,13 +360,8 @@ def next_token_loss(cfg: TransformerConfig, params: dict,
         # chunk count divides a power-of-two S, not S-1), then scan
         # the head with the last position masked out — identical
         # arithmetic to the materialized causal loss.
-        B, S = tokens.shape
         x = forward_hidden(cfg, params, tokens, constrain, mesh)
-        targets = jnp.concatenate(
-            [tokens[:, 1:], jnp.zeros((B, 1), tokens.dtype)], axis=1)
-        weights = jnp.concatenate(
-            [jnp.ones((B, S - 1), jnp.float32),
-             jnp.zeros((B, 1), jnp.float32)], axis=1)
+        targets, weights = shift_targets_and_weights(tokens)
         return chunked_head_xent(cfg, x, params["head"], targets,
                                  weights, cfg.loss_chunks)
     if full_seq:

@@ -57,12 +57,17 @@ _COLLECTIVE_PREFIXES = (
 )
 _COMPUTE_MARKS = ("dot", "convolution", "einsum", "cholesky",
                   "triangular-solve", "fft",
-                  # Pallas kernels lower to custom-calls (Mosaic on
-                  # TPU). In THIS framework every custom-call is a
-                  # compute kernel (flash attention, instrumented
-                  # matmul — ops/), so their time belongs to the MXU
-                  # bucket, not the stall proxy.
-                  "custom-call", "tpu_custom_call", "mosaic")
+                  # Pallas kernels lower through Mosaic; their events
+                  # surface either under the mosaic/tpu_custom_call
+                  # target or under the kernel function's own name
+                  # (ops/attention.py _fwd_kernel etc.). Bare
+                  # 'custom-call' is NOT compute — lax.top_k (the MoE
+                  # router) and host callbacks lower there too; those
+                  # are identified per-kernel via long_name below.
+                  "tpu_custom_call", "mosaic", "fwd_kernel",
+                  "bwd_dq_kernel", "bwd_dkv_kernel", "mm_kernel")
+# long_name markers that make a bare custom-call a compute kernel.
+_CUSTOM_CALL_COMPUTE = ("mosaic", "flash", "_kernel", "matmul")
 # Control-flow CONTAINERS: their event duration spans the whole body,
 # whose ops appear as their own events — counting the container would
 # double-bill every inner op into the memory bucket (a lax.scan train
@@ -98,6 +103,9 @@ def classify_op(name: str, long_name: str = "") -> str | None:
         if base == m or base.startswith((m + ".", m + "_", m + "-")):
             return "compute"
         if (m + "(") in long_name:
+            return "compute"
+    if base == "custom-call" or base.startswith("custom-call."):
+        if any(k in long_name for k in _CUSTOM_CALL_COMPUTE):
             return "compute"
     return "memory"
 

@@ -59,10 +59,17 @@ def test_classify_op_buckets():
     assert classify_op("get-tuple-element.17") is None
     assert classify_op("opt-barrier.1") is None
     # dtype casts are NOT compute ('convert' must not substring-match
-    # 'conv'); pallas kernels (custom-calls) ARE
+    # 'conv'); Pallas/Mosaic kernels ARE — but a bare custom-call is
+    # not (lax.top_k in the MoE router lowers there too)
     assert classify_op("convert.5") == "memory"
-    assert classify_op("custom-call.2") == "compute"
     assert classify_op("tpu_custom_call.1") == "compute"
+    assert classify_op("mosaic.3") == "compute"
+    assert classify_op("fwd_kernel.2") == "compute"
+    assert classify_op("custom-call.2") == "memory"  # e.g. router top_k
+    assert classify_op("custom-call.7",
+                       long_name="custom-call(mosaic ...)") == "compute"
+    assert classify_op("custom-call.8",
+                       long_name="flash_fwd kernel") == "compute"
 
 
 def test_parse_trace_events_sums_and_union():
