@@ -48,7 +48,7 @@ __all__ = [
 # HLO-ish op event names: lowercase op (optionally wrapped_/fused_),
 # optional ".N" suffix. Excludes runtime frames (CamelCase, '::',
 # spaces), python frames ('$file.py:123 fn') and 'end: op' markers.
-_OP_RE = re.compile(r"^(wrapped_|fused_)?[a-z][a-z0-9\-_]*(\.[0-9]+)?$")
+_OP_RE = re.compile(r"^_?(wrapped_|fused_)?[a-z][a-z0-9\-_]*(\.[0-9]+)?$")
 
 _COLLECTIVE_PREFIXES = (
     "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
@@ -84,7 +84,10 @@ def classify_op(name: str, long_name: str = "") -> str | None:
     container whose children are billed individually)."""
     if not _OP_RE.match(name):
         return None
-    base = name
+    # Our Pallas kernel fns are underscore-prefixed (_fwd_kernel,
+    # _mm_kernel — ops/); strip the prefix so the marks match however
+    # the event surfaces.
+    base = name.lstrip("_")
     for pre in ("wrapped_", "fused_"):
         if base.startswith(pre):
             base = base[len(pre):]

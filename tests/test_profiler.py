@@ -65,6 +65,8 @@ def test_classify_op_buckets():
     assert classify_op("tpu_custom_call.1") == "compute"
     assert classify_op("mosaic.3") == "compute"
     assert classify_op("fwd_kernel.2") == "compute"
+    assert classify_op("_fwd_kernel.2") == "compute"  # real spelling
+    assert classify_op("_mm_kernel") == "compute"
     assert classify_op("custom-call.2") == "memory"  # e.g. router top_k
     assert classify_op("custom-call.7",
                        long_name="custom-call(mosaic ...)") == "compute"
