@@ -90,9 +90,9 @@ def main() -> None:
     guest = Job.foreign("guest", guest_kernel, jnp.ones((n, n)), 0.5,
                         profile_every=2, max_steps=30)
 
-    be = TpuBackend(profile_every=0)  # only the foreign override samples
+    be = TpuBackend(profile_every=0)  # only the per-job overrides sample
     part = Partition("demo", source=be)
-    fb = FeedbackPolicy(part, tick_ns=1)
+    fb = FeedbackPolicy(part)  # default 1 ms metric tick
     for j in (train, serve, guest):
         part.add_job(j)
     part.run()

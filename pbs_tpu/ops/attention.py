@@ -37,8 +37,30 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+from pbs_tpu.utils.params import integer_param
+
+# Block-shape defaults, env-tunable so the on-chip sweep can explore
+# the VMEM/occupancy trade at long S without code edits (e.g.
+# PBST_FLASH_BLOCK_Q=256 PBST_FLASH_BLOCK_K=512 python bench_longctx.py).
+# Registered through the boot-param registry: a malformed value warns
+# and falls back instead of making the package unimportable.
+_block_q_param = integer_param("flash_block_q", 128)
+_block_k_param = integer_param("flash_block_k", 128)
+
+
+def _tile_checked(v: int, fallback: int, axis: str, mult: int) -> int:
+    # Mosaic block shapes need (sublane, lane) multiples of (8, 128);
+    # catch an off-tile knob HERE with the knob's name, not deep in
+    # the kernel lowering (on-chip debug cycles are expensive).
+    if v <= 0 or v % mult:
+        print(f"pbst: PBST_FLASH_BLOCK_{axis}={v} is not a positive "
+              f"multiple of {mult}; using {fallback}")
+        return fallback
+    return v
+
+
+DEFAULT_BLOCK_Q = _tile_checked(_block_q_param.value, 128, "Q", 8)
+DEFAULT_BLOCK_K = _tile_checked(_block_k_param.value, 128, "K", 128)
 NEG_INF = -1e30
 
 

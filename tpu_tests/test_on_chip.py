@@ -124,6 +124,36 @@ def test_instrumented_matmul_compiled():
     assert st.flops == 8 * 2 * 256 ** 3
 
 
+def test_flash_long_context_numerics():
+    """Flash at S=2048 (the long-context regime bench_longctx measures)
+    against the dense reference, on real silicon — online-softmax
+    accumulation error must stay bounded as the number of folded
+    k-blocks grows."""
+    from pbs_tpu.ops.attention import flash_attention
+
+    q, k, v = qkv(B=1, S=2048, H=8, Hkv=4, hd=128, seed=3)
+    out = jax.jit(flash_attention)(q, k, v)
+    ref = jax.jit(dense_attention)(q, k, v)
+    a = out.astype(jnp.float32)
+    rel = float(jnp.max(jnp.abs(a - ref))) / (
+        float(jnp.max(jnp.abs(ref))) + 1e-9)
+    assert rel < 0.05, rel
+
+
+def test_flash_block_shape_knobs():
+    """The env-tunable block shapes compile at non-default settings
+    (the sweep's tuning surface)."""
+    from pbs_tpu.ops.attention import flash_attention
+
+    q, k, v = qkv(B=1, S=1024, H=8, Hkv=4, hd=128, seed=4)
+    out = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, block_q=256, block_k=512))(q, k, v)
+    ref = jax.jit(dense_attention)(q, k, v)
+    rel = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref))) / (
+        float(jnp.max(jnp.abs(ref))) + 1e-9)
+    assert rel < 0.05, rel
+
+
 def test_profiler_device_lane_parse_on_chip():
     """The measured-telemetry path against a REAL chip trace (verdict
     r2 weak #4: the parser was only ever validated on CPU thunk
