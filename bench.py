@@ -28,7 +28,7 @@ import subprocess
 import sys
 import time
 
-PEAK_FLOPS = 197e12  # bf16 peak, TPU v5e
+from bench_common import PEAK_FLOPS  # bf16 peak, TPU v5e — one copy
 TARGET_MFU = 0.40
 
 WARMUP_CHUNKS = 2
@@ -65,16 +65,11 @@ def main() -> None:
     from __graft_entry__ import _flagship_cfg
 
     # Persistent compilation cache: the flagship step compiles once per
-    # machine instead of once per run (~15-25 s off a cold bench).
-    # Best-effort — a backend that cannot serialize executables just
-    # skips it.
-    try:
-        cache_dir = os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
-    except Exception as e:  # noqa: BLE001 — cache is an optimization
-        _mark(f"compilation cache unavailable: {e}")
+    # machine instead of once per run (~15-25 s off a cold bench);
+    # shared with every other chip-queue script (bench_common).
+    from bench_common import setup_compilation_cache
+
+    setup_compilation_cache(log=_mark)
 
     tiny = os.environ.get("PBST_BENCH_TINY", "").lower() in (
         "1", "true", "yes")

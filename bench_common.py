@@ -1,0 +1,31 @@
+"""Shared bits for the repo-root bench scripts.
+
+One copy of the per-chip peak constant and the persistent-compilation-
+cache setup: the chip queue runs five scripts against the same ~700M
+flagship, and without a shared cache each would pay the 20-40 s XLA
+compile again (chip minutes are the scarcest resource in this
+environment — docs/OPS.md "The chip").
+"""
+
+from __future__ import annotations
+
+import os
+
+PEAK_FLOPS = 197e12  # bf16 peak, TPU v5e
+
+
+def setup_compilation_cache(log=None) -> None:
+    """Point JAX at the repo-local persistent compile cache
+    (best-effort: a backend that cannot serialize executables just
+    skips it). Call after `import jax`, before the first compile.
+    ``log`` (optional callable) receives a one-line note on failure."""
+    import jax
+
+    try:
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception as e:  # noqa: BLE001 — cache is an optimization
+        if log is not None:
+            log(f"compilation cache unavailable: {e}")

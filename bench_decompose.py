@@ -37,7 +37,7 @@ import os
 import sys
 import time
 
-PEAK_FLOPS = 197e12
+from bench_common import PEAK_FLOPS
 
 
 def main() -> int:
@@ -50,6 +50,9 @@ def main() -> int:
     import jax.numpy as jnp
     from jax import lax
 
+    from bench_common import setup_compilation_cache
+
+    setup_compilation_cache()
     from __graft_entry__ import _flagship_cfg
     from pbs_tpu.models import init_params, make_train_step
     from pbs_tpu.telemetry.profiler import XlaQuantumProfiler

@@ -23,7 +23,7 @@ import os
 import sys
 import time
 
-PEAK_FLOPS = 197e12  # bf16, TPU v5e
+from bench_common import PEAK_FLOPS  # bf16, TPU v5e — one copy
 
 # (seq, batch): batch shrinks as S grows to hold tokens/step roughly
 # constant and fit HBM; global batch is the dp axis's job in training.
@@ -97,6 +97,9 @@ def main() -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    from bench_common import setup_compilation_cache
+
+    setup_compilation_cache()
     from __graft_entry__ import _flagship_cfg
 
     cfg_base = _flagship_cfg(tiny=tiny)

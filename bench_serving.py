@@ -28,6 +28,10 @@ def main() -> int:
     import jax
     import jax.numpy as jnp
 
+    from bench_common import setup_compilation_cache
+
+    setup_compilation_cache()
+
     from __graft_entry__ import _flagship_cfg
     from pbs_tpu.models import init_params
     from pbs_tpu.models.generate import init_cache, make_generate, prefill
