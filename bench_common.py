@@ -11,7 +11,9 @@ from __future__ import annotations
 
 import os
 
-PEAK_FLOPS = 197e12  # bf16 peak, TPU v5e
+# One constant: the library's telemetry seam is canonical; the
+# bench scripts re-export it so MFU numbers can never disagree.
+from pbs_tpu.telemetry.source import DEFAULT_PEAK_FLOPS as PEAK_FLOPS  # noqa: E402,F401
 
 
 def setup_compilation_cache(log=None) -> None:

@@ -7,7 +7,8 @@ from pbs_tpu.data.bytes import (
     decode_tokens,
     encode_text,
 )
-from pbs_tpu.data.loader import Prefetcher, make_batch_source
+from pbs_tpu.data.loader import (Prefetcher, ShardedBatchSource,
+                                  make_batch_source)
 from pbs_tpu.data.tokens import TokenDataset, write_token_file
 
 __all__ = [
@@ -15,6 +16,7 @@ __all__ = [
     "EOS",
     "VOCAB",
     "Prefetcher",
+    "ShardedBatchSource",
     "TokenDataset",
     "corpus_from_file",
     "corpus_from_text",

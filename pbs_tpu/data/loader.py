@@ -33,6 +33,80 @@ def make_batch_source(ds: TokenDataset, batch: int, seq_len: int,
     return source
 
 
+class ShardedBatchSource:
+    """Multi-host data-parallel sampling with a checkpointable cursor.
+
+    Every host derives the SAME per-step window schedule from
+    ``(seed, step)`` — no cross-host communication, the standard
+    multi-host recipe — and takes its own disjoint row slice of the
+    global batch: host h of n gets rows [h*B/n, (h+1)*B/n). The step
+    counter is the whole cursor, so checkpoint/resume is
+    ``state()``/``load_state()`` with one int — on restore every host
+    resumes the identical schedule position (the reference's analog:
+    migration records the exact phase cursor so a restored guest does
+    not replay I/O — SURVEY.md §5 checkpoint/resume).
+
+    Under a :class:`Prefetcher` the cursor counts *sourced* batches,
+    which run ``depth`` ahead of consumption — a checkpoint taken
+    mid-stream therefore skips the in-flight batches on restore
+    (deterministically; never replays), the right bias for training
+    data.
+    """
+
+    def __init__(self, ds: TokenDataset, global_batch: int, seq_len: int,
+                 host_id: int = 0, n_hosts: int = 1, seed: int = 0):
+        if not (0 <= host_id < n_hosts):
+            raise ValueError(f"host_id {host_id} outside [0, {n_hosts})")
+        if global_batch % n_hosts:
+            raise ValueError(
+                f"global_batch {global_batch} not divisible by "
+                f"n_hosts {n_hosts}")
+        self.ds = ds
+        self.global_batch = global_batch
+        self.per_host = global_batch // n_hosts
+        self.seq_len = seq_len
+        self.host_id = host_id
+        self.n_hosts = n_hosts
+        self.seed = seed
+        self.step = 0
+
+    def _starts(self, step: int) -> np.ndarray:
+        span = self.ds.n_tokens - self.seq_len + 1
+        if span <= 0:
+            raise ValueError("seq_len exceeds corpus")
+        rng = np.random.default_rng([self.seed, step])
+        all_starts = rng.integers(0, span, size=self.global_batch)
+        lo = self.host_id * self.per_host
+        return all_starts[lo:lo + self.per_host].astype(np.int64)
+
+    def __call__(self) -> np.ndarray:
+        """One (B/n_hosts, S) batch; advances the cursor."""
+        out = self.ds._gather(self._starts(self.step), self.seq_len)
+        self.step += 1
+        return out
+
+    # -- checkpointable cursor ------------------------------------------
+
+    def _schedule_id(self) -> dict:
+        # EVERYTHING that determines the draw: seed (stream),
+        # global_batch (draw size), seq_len (span), n_hosts (slicing).
+        return {"seed": self.seed, "global_batch": self.global_batch,
+                "seq_len": self.seq_len, "n_hosts": self.n_hosts}
+
+    def state(self) -> dict:
+        return dict(self._schedule_id(), step=self.step,
+                    host_id=self.host_id)
+
+    def load_state(self, state: dict) -> None:
+        mine = self._schedule_id()
+        theirs = {k: state.get(k) for k in mine}
+        if theirs != mine:
+            raise ValueError(
+                "checkpoint cursor belongs to a different data schedule "
+                f"({theirs} != {mine})")
+        self.step = int(state["step"])
+
+
 class Prefetcher:
     """Background batch pipeline with a bounded queue.
 
