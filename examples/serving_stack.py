@@ -73,6 +73,24 @@ def main() -> int:
     acc, prop = int(stats["accepted"]), int(stats["proposed"])
     print(f"speculative (self-draft ceiling): {int(stats['rounds'])} "
           f"rounds, acceptance {acc}/{prop} = {acc / max(prop, 1):.0%}")
+
+    # The two composed: speculative CONTINUOUS batching — draft
+    # propose-k + one-forward verify per engine tick, each slot
+    # advancing by its own acceptance; bit-identical to the plain
+    # engine, ~acceptance-rate fewer ticks.
+    from pbs_tpu.models import SpeculativeBatcher
+
+    seng = SpeculativeBatcher(CFG, params, CFG, params, k=4, n_slots=2,
+                              prompt_bucket=64, max_len=128)
+    for q in ("tell me a story", "what is a tpu?"):
+        seng.submit(encode_text(system + q, add_eos=False),
+                    max_new_tokens=12)
+    while seng.has_work():
+        seng.step()
+    sst = seng.stats()
+    print(f"speculative serving: {sst['completed']} requests in "
+          f"{sst['steps']} engine ticks, acceptance "
+          f"{sst['spec_acceptance']:.0%}")
     return 0
 
 

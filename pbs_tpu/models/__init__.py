@@ -9,6 +9,7 @@ from pbs_tpu.models.microstep import make_micro_train_step
 from pbs_tpu.models.serving import (
     Completion,
     ContinuousBatcher,
+    SpeculativeBatcher,
     make_continuous_serve_step,
 )
 from pbs_tpu.models.moe import (
@@ -37,6 +38,7 @@ from pbs_tpu.models.transformer import (
 __all__ = [
     "Completion",
     "ContinuousBatcher",
+    "SpeculativeBatcher",
     "MoEConfig",
     "TransformerConfig",
     "forward",

@@ -133,6 +133,29 @@ def _slot_forward(cfg: TransformerConfig, params: dict, tokens: jax.Array,
     return logits, {"k": new_k, "v": new_v, "pos": cache["pos"]}
 
 
+def ingest_slot_prompt(cfg: TransformerConfig, params: dict, cache: dict,
+                       slot, prompt: jax.Array, plen):
+    """The ONE copy of slot-prompt ingestion (trace-safe): gather the
+    slot's slabs as a B=1 view, forward the padded prompt from
+    position 0, write the slabs back (vmapped-DUS layout — load-bearing
+    for tp compiles, see _slot_forward), set the slot cursor. Returns
+    ``(last_logits (V,), cache)``; samplers layer on top."""
+    sub = {
+        "k": jax.lax.dynamic_slice_in_dim(cache["k"], slot, 1, axis=1),
+        "v": jax.lax.dynamic_slice_in_dim(cache["v"], slot, 1, axis=1),
+        "pos": jnp.zeros((1,), jnp.int32),
+    }
+    logits, sub = _slot_forward(cfg, params, prompt[None, :], sub,
+                                jnp.zeros((1,), jnp.int32))
+    cache = dict(cache)
+    cache["k"] = jax.lax.dynamic_update_slice_in_dim(
+        cache["k"], sub["k"], slot, axis=1)
+    cache["v"] = jax.lax.dynamic_update_slice_in_dim(
+        cache["v"], sub["v"], slot, axis=1)
+    cache["pos"] = cache["pos"].at[slot].set(plen)
+    return logits[0, plen - 1], cache
+
+
 @dataclasses.dataclass
 class Completion:
     request_id: int
@@ -230,6 +253,8 @@ class ContinuousBatcher:
         self.steps = 0
         self.tokens_emitted = 0
         self.requests_completed = 0
+        # This tick's admissions (subclass hook; see _admit).
+        self._admitted: list = []
         # Exact-prompt prefix cache (system-prompt reuse): LRU of
         # {prompt bytes -> prompt-window KV + last-position logits}.
         # Entries are DEVICE arrays — storing the lazy slot slice
@@ -257,26 +282,10 @@ class ContinuousBatcher:
             first token. prompt: (bucket,) padded; plen: real length.
             Also returns the last-position logits (for the prefix
             cache)."""
-            # gather the slot's slabs as a B=1 view
-            sub = {
-                "k": jax.lax.dynamic_slice_in_dim(cache["k"], slot, 1,
-                                                  axis=1),
-                "v": jax.lax.dynamic_slice_in_dim(cache["v"], slot, 1,
-                                                  axis=1),
-                "pos": jnp.zeros((1,), jnp.int32),
-            }
-            logits, sub = _slot_forward(
-                cfg_, params, prompt[None, :], sub, jnp.zeros((1,),
-                                                             jnp.int32))
-            last_logits = logits[0, plen - 1]
+            last_logits, cache = ingest_slot_prompt(
+                cfg_, params, cache, slot, prompt, plen)
             first = _sample(last_logits[None, :], key,
                             self.temperature)[0]
-            cache = dict(cache)
-            cache["k"] = jax.lax.dynamic_update_slice_in_dim(
-                cache["k"], sub["k"], slot, axis=1)
-            cache["v"] = jax.lax.dynamic_update_slice_in_dim(
-                cache["v"], sub["v"], slot, axis=1)
-            cache["pos"] = cache["pos"].at[slot].set(plen)
             return first, last_logits, cache
 
         @jax.jit
@@ -348,12 +357,18 @@ class ContinuousBatcher:
     # -- the engine tick --------------------------------------------------
 
     def _admit(self) -> None:
+        # (slot, padded_prompt, plen) of this tick's admissions — the
+        # hook subclasses use to mirror work per new tenant (the
+        # speculative engine draft-prefills the same prompt).
+        # Initialized in __init__ too, so it is safe to read pre-tick.
+        self._admitted = []
         for slot in range(self.n_slots):
             if self.active[slot] or not self.queue:
                 continue
             rid, prompt, max_new = self.queue.popleft()
             padded = np.zeros(self.bucket, np.int32)
             padded[:len(prompt)] = prompt
+            self._admitted.append((slot, padded, len(prompt)))
             self._key, sub = jax.random.split(self._key)
             pkey = prompt.tobytes()
             ent = (self._prefix_cache.get(pkey)
@@ -483,6 +498,173 @@ class ContinuousBatcher:
             "prefix_hits": self.prefix_hits,
             "prefix_misses": self.prefix_misses,
         }
+
+
+class SpeculativeBatcher(ContinuousBatcher):
+    """Continuous batching WITH speculative decoding: every engine
+    tick, a draft model proposes ``k`` tokens per slot and the target
+    verifies all ``k+1`` positions in ONE forward; each slot advances
+    by its own accepted prefix (the per-row cursors of
+    ``speculative.make_per_row_speculative_generate``, which this
+    engine shares its slot-cache machinery with).
+
+    Combines the two serving accelerations that matter: continuous
+    batching hides admission/retirement latency, speculation
+    multiplies decode throughput by the acceptance rate — per
+    engine tick a slot emits 1..k+1 tokens instead of exactly 1.
+    Greedy-only (``temperature=0``): acceptance is exact token match,
+    so outputs are bit-identical to the plain engine's (pinned by
+    test). Static shapes throughout: the tick runs a fixed
+    (n_slots, k) draft scan + one (n_slots, k+1) verify regardless of
+    acceptance; finished/inactive lanes ride along masked.
+
+    Truncation safety: a slot that hits EOS or its token budget
+    mid-window retires immediately, so the device cursor (which
+    advanced past the truncation) is never decoded from again — the
+    next tenant's prefill rewrites it.
+    """
+
+    def __init__(self, cfg: TransformerConfig, params: dict,
+                 draft_cfg: TransformerConfig, draft_params: dict,
+                 k: int = 4, **kw):
+        if kw.get("temperature", 0.0) != 0.0:
+            raise ValueError(
+                "SpeculativeBatcher is greedy-only (temperature=0): "
+                "exact-match acceptance is the correctness contract")
+        if kw.get("mesh") is not None or kw.get("prefix_cache_size"):
+            raise ValueError(
+                "speculative serving does not compose with a tp mesh "
+                "or the prefix cache yet")
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        if cfg.vocab != draft_cfg.vocab:
+            raise ValueError("draft vocab != target vocab")
+        super().__init__(cfg, params, **kw)
+        self.draft_cfg = draft_cfg
+        self.draft_params = draft_params
+        self.k = k
+        self.dcache = init_slot_cache(draft_cfg, self.n_slots,
+                                      self.max_len)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        dcfg_, cfg_, n_slots = draft_cfg, cfg, self.n_slots
+
+        @jax.jit
+        def _draft_prefill(dparams, dcache, slot, prompt, plen):
+            """Mirror of the target prefill for the draft cache: the
+            shared ingest, logits discarded (the target picks tokens)."""
+            _, dcache = ingest_slot_prompt(dcfg_, dparams, dcache, slot,
+                                           prompt, plen)
+            return dcache
+
+        kk = self.k
+
+        @jax.jit
+        def _spec_decode(params, dparams, tcache, dcache, cur, active):
+            """One speculation round across all slots at their own
+            cursors. Returns (toks (B, k+1), counts (B,), caches,
+            n_proposed, n_accepted)."""
+            pos = tcache["pos"]  # (B,), == dcache["pos"] by invariant
+
+            def dstep(c, _):
+                tok, dc, dp = c
+                logits, dc = _slot_forward(dcfg_, dparams, tok[:, None],
+                                           dc, dp)
+                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                return (nxt, dc, dp + 1), nxt
+
+            (last, dcache, dp), props = jax.lax.scan(
+                dstep, (cur, dcache, pos), None, length=kk)
+            t = props.T  # (B, k)
+            # Ingest t_k so draft KV reaches pos+k whatever acceptance.
+            _, dcache = _slot_forward(dcfg_, dparams, last[:, None],
+                                      dcache, dp)
+
+            x = jnp.concatenate([cur[:, None], t], axis=1)  # (B, k+1)
+            logits, tcache = _slot_forward(cfg_, params, x, tcache, pos)
+            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            from pbs_tpu.models.speculative import greedy_accept_window
+
+            toks, m_row = greedy_accept_window(t, g)
+            adv = jnp.where(active, m_row + 1, 0)
+            tcache = dict(tcache, pos=pos + adv)
+            dcache = dict(dcache, pos=pos + adv)
+            n_act = jnp.sum(active.astype(jnp.int32))
+            return (toks, adv, tcache, dcache, kk * n_act,
+                    jnp.sum(jnp.where(active, m_row, 0)))
+
+        self._draft_prefill_fn = _draft_prefill
+        self._spec_decode_fn = _spec_decode
+        # Warm both programs at construction (same SLO reasoning as
+        # the parent's warm-up).
+        _draft_prefill(self.draft_params, self.dcache, 0,
+                       jnp.zeros((self.bucket,), jnp.int32), 1)
+        _spec_decode(self.params, self.draft_params, self.cache,
+                     self.dcache, jnp.zeros((n_slots,), jnp.int32),
+                     jnp.zeros((n_slots,), bool))
+
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        # The verify window writes up to k+1 positions past the
+        # accepted frontier; reserve that slack in the slab.
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) + max_new_tokens + self.k + 1 > self.max_len:
+            raise ValueError(
+                "prompt + max_new_tokens + k + 1 exceeds max_len "
+                "(speculation needs overshoot room)")
+        return super().submit(prompt, max_new_tokens)
+
+    def step(self) -> list[Completion]:
+        self._admit()
+        for slot, padded, plen in self._admitted:
+            self.dcache = self._draft_prefill_fn(
+                self.draft_params, self.dcache, slot,
+                jnp.asarray(padded), plen)
+        done: list[Completion] = []
+        for slot in range(self.n_slots):
+            if self.active[slot] and (
+                    self.slot_remaining[slot] <= 0
+                    or (self.eos_id is not None
+                        and self.last_tok[slot] == self.eos_id)):
+                done.append(self._retire(slot))
+        if not self.active.any():
+            self.steps += 1
+            return done
+        toks, counts, self.cache, self.dcache, prop, acc = (
+            self._spec_decode_fn(
+                self.params, self.draft_params, self.cache, self.dcache,
+                jnp.asarray(self.last_tok), jnp.asarray(self.active)))
+        toks = np.asarray(toks)
+        counts = np.asarray(counts)
+        self.spec_proposed += int(prop)
+        self.spec_accepted += int(acc)
+        for slot in range(self.n_slots):
+            if not self.active[slot]:
+                continue
+            for j in range(int(counts[slot])):
+                tok = int(toks[slot, j])
+                self.slot_tokens[slot].append(tok)
+                self.last_tok[slot] = tok
+                self.slot_remaining[slot] -= 1
+                self.tokens_emitted += 1
+                if (self.slot_remaining[slot] <= 0
+                        or (self.eos_id is not None
+                            and tok == self.eos_id)):
+                    # Truncate mid-window: the device cursor is ahead,
+                    # but this slot retires NOW, so it is never decoded
+                    # from again.
+                    done.append(self._retire(slot))
+                    break
+        self.steps += 1
+        return done
+
+    def stats(self) -> dict:
+        st = super().stats()
+        st["spec_proposed"] = self.spec_proposed
+        st["spec_accepted"] = self.spec_accepted
+        st["spec_acceptance"] = round(
+            self.spec_accepted / self.spec_proposed, 4) \
+            if self.spec_proposed else 0.0
+        return st
 
 
 def make_continuous_serve_step(engine: ContinuousBatcher,
