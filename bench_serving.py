@@ -93,6 +93,46 @@ def main() -> int:
         "new_tokens": new_tokens,
         "device": str(jax.devices()[0]),
     }), flush=True)
+
+    # Continuous batching engines, plain vs speculative: tokens/s and
+    # engine ticks for the same request mix. Self-draft gives the
+    # acceptance CEILING (the draft is free to be wrong in deployment;
+    # here the point is the engine overhead at high acceptance).
+    from pbs_tpu.models import ContinuousBatcher, SpeculativeBatcher
+
+    n_slots = 2 if tiny else 8
+    eng_new = 8 if tiny else 64
+    bucket = 16 if tiny else 512
+    maxlen = bucket + eng_new + 8
+    prompts = [
+        list(range(1, 1 + (3 + i % 5))) for i in range(2 * n_slots)
+    ]
+    rows = {}
+    for name, eng in (
+        ("continuous", ContinuousBatcher(
+            cfg, params, n_slots=n_slots, prompt_bucket=bucket,
+            max_len=maxlen)),
+        ("spec_continuous", SpeculativeBatcher(
+            cfg, params, cfg, params, k=4, n_slots=n_slots,
+            prompt_bucket=bucket, max_len=maxlen)),
+    ):
+        for p in prompts:
+            eng.submit(p, max_new_tokens=eng_new)
+        t0 = time.perf_counter()
+        while eng.has_work():
+            eng.step()
+        dt = time.perf_counter() - t0
+        st = eng.stats()
+        rows[name] = {
+            "metric": f"serving_{name}_throughput",
+            "value": round(st["tokens_emitted"] / dt, 1),
+            "unit": "tokens/s",
+            "ticks": st["steps"],
+            "requests": st["completed"],
+        }
+        if "spec_acceptance" in st:
+            rows[name]["acceptance"] = st["spec_acceptance"]
+        print(json.dumps(rows[name]), flush=True)
     return 0
 
 

@@ -435,12 +435,13 @@ class ContinuousBatcher:
         self.active[slot] = False
         return comp
 
-    def step(self) -> list[Completion]:
-        """Admit waiting requests, decode one token for every active
-        slot, retire finished requests. Returns completions."""
+    def _pre_decode(self) -> tuple[list[Completion], bool]:
+        """The tick prologue every engine shares: admit waiting
+        requests, retire already-finished slots (prefill-only budgets,
+        EOS sampled at admission). Returns (completions, any_active);
+        when nothing is active the tick is already accounted."""
         self._admit()
         done: list[Completion] = []
-        # retire prefill-only requests (max_new_tokens == 1) and EOS
         for slot in range(self.n_slots):
             if self.active[slot] and (
                     self.slot_remaining[slot] <= 0
@@ -449,6 +450,26 @@ class ContinuousBatcher:
                 done.append(self._retire(slot))
         if not self.active.any():
             self.steps += 1
+            return done, False
+        return done, True
+
+    def _emit(self, slot: int, tok: int) -> bool:
+        """Book one decoded token into ``slot``; True if the slot just
+        finished (budget or EOS) — the ONE copy of the retire
+        condition both engines' emit loops use."""
+        self.slot_tokens[slot].append(tok)
+        self.last_tok[slot] = tok
+        self.slot_remaining[slot] -= 1
+        self.tokens_emitted += 1
+        return bool(
+            self.slot_remaining[slot] <= 0
+            or (self.eos_id is not None and tok == self.eos_id))
+
+    def step(self) -> list[Completion]:
+        """Admit waiting requests, decode one token for every active
+        slot, retire finished requests. Returns completions."""
+        done, any_active = self._pre_decode()
+        if not any_active:
             return done
         self._key, sub = jax.random.split(self._key)
         nxt, self.cache = self._decode_fn(
@@ -458,13 +479,7 @@ class ContinuousBatcher:
         for slot in range(self.n_slots):
             if not self.active[slot]:
                 continue
-            tok = int(nxt[slot])
-            self.slot_tokens[slot].append(tok)
-            self.last_tok[slot] = tok
-            self.slot_remaining[slot] -= 1
-            self.tokens_emitted += 1
-            if (self.slot_remaining[slot] <= 0
-                    or (self.eos_id is not None and tok == self.eos_id)):
+            if self._emit(slot, int(nxt[slot])):
                 done.append(self._retire(slot))
         self.steps += 1
         return done
@@ -585,7 +600,7 @@ class SpeculativeBatcher(ContinuousBatcher):
             g = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             from pbs_tpu.models.speculative import greedy_accept_window
 
-            toks, m_row = greedy_accept_window(t, g)
+            toks, m_row, _bonus = greedy_accept_window(t, g)
             adv = jnp.where(active, m_row + 1, 0)
             tcache = dict(tcache, pos=pos + adv)
             dcache = dict(dcache, pos=pos + adv)
@@ -614,20 +629,12 @@ class SpeculativeBatcher(ContinuousBatcher):
         return super().submit(prompt, max_new_tokens)
 
     def step(self) -> list[Completion]:
-        self._admit()
+        done, any_active = self._pre_decode()
         for slot, padded, plen in self._admitted:
             self.dcache = self._draft_prefill_fn(
                 self.draft_params, self.dcache, slot,
                 jnp.asarray(padded), plen)
-        done: list[Completion] = []
-        for slot in range(self.n_slots):
-            if self.active[slot] and (
-                    self.slot_remaining[slot] <= 0
-                    or (self.eos_id is not None
-                        and self.last_tok[slot] == self.eos_id)):
-                done.append(self._retire(slot))
-        if not self.active.any():
-            self.steps += 1
+        if not any_active:
             return done
         toks, counts, self.cache, self.dcache, prop, acc = (
             self._spec_decode_fn(
@@ -641,14 +648,7 @@ class SpeculativeBatcher(ContinuousBatcher):
             if not self.active[slot]:
                 continue
             for j in range(int(counts[slot])):
-                tok = int(toks[slot, j])
-                self.slot_tokens[slot].append(tok)
-                self.last_tok[slot] = tok
-                self.slot_remaining[slot] -= 1
-                self.tokens_emitted += 1
-                if (self.slot_remaining[slot] <= 0
-                        or (self.eos_id is not None
-                            and tok == self.eos_id)):
+                if self._emit(slot, int(toks[slot, j])):
                     # Truncate mid-window: the device cursor is ahead,
                     # but this slot retires NOW, so it is never decoded
                     # from again.

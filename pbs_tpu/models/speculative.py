@@ -168,10 +168,11 @@ def greedy_accept_window(t: jax.Array, g: jax.Array):
     per-row generator and the speculative serving engine.
 
     ``t`` (B, k): draft proposals; ``g`` (B, k+1): target argmax over
-    the verify window. Returns ``(toks (B, k+1), m_row (B,))`` where
-    row b of ``toks`` holds its accepted prefix t_1..t_{m_b} with the
-    bonus token g_{m_b} packed at column m_b (columns past m_b carry
-    junk the caller's cursor arithmetic never reads)."""
+    the verify window. Returns ``(toks (B, k+1), m_row (B,),
+    bonus (B,))`` where row b of ``toks`` holds its accepted prefix
+    t_1..t_{m_b} with the bonus token g_{m_b} packed at column m_b
+    (columns past m_b carry junk the caller's cursor arithmetic never
+    reads)."""
     B, k = t.shape
     match = (t == g[:, :k]).astype(jnp.int32)
     m_row = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # (B,)
@@ -179,7 +180,7 @@ def greedy_accept_window(t: jax.Array, g: jax.Array):
     cols = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
     toks = jnp.concatenate([t, jnp.zeros((B, 1), jnp.int32)], axis=1)
     toks = jnp.where(cols == m_row[:, None], bonus[:, None], toks)
-    return toks, m_row
+    return toks, m_row, bonus
 
 
 def make_per_row_speculative_generate(
@@ -262,9 +263,7 @@ def make_per_row_speculative_generate(
             x = jnp.concatenate([cur[:, None], t], axis=1)  # (B, k+1)
             logits, tcache = _slot_forward(cfg, params, x, tcache, pos)
             g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, k+1)
-            round_toks, m_row = greedy_accept_window(t, g)
-            bonus = jnp.take_along_axis(
-                g, m_row[:, None], axis=1)[:, 0]  # (B,)
+            round_toks, m_row, bonus = greedy_accept_window(t, g)
             out_new = write_rows(out, round_toks, n_out)
             out = jnp.where(active[:, None], out_new, out)
 
