@@ -107,7 +107,6 @@ def main() -> int:
     prompts = [
         list(range(1, 1 + (3 + i % 5))) for i in range(2 * n_slots)
     ]
-    rows = {}
     for name, eng in (
         ("continuous", ContinuousBatcher(
             cfg, params, n_slots=n_slots, prompt_bucket=bucket,
@@ -123,7 +122,7 @@ def main() -> int:
             eng.step()
         dt = time.perf_counter() - t0
         st = eng.stats()
-        rows[name] = {
+        row = {
             "metric": f"serving_{name}_throughput",
             "value": round(st["tokens_emitted"] / dt, 1),
             "unit": "tokens/s",
@@ -131,8 +130,8 @@ def main() -> int:
             "requests": st["completed"],
         }
         if "spec_acceptance" in st:
-            rows[name]["acceptance"] = st["spec_acceptance"]
-        print(json.dumps(rows[name]), flush=True)
+            row["acceptance"] = st["spec_acceptance"]
+        print(json.dumps(row), flush=True)
     return 0
 
 

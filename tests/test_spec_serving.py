@@ -111,3 +111,21 @@ def test_spec_serving_guards(models):
                               prompt_bucket=8, max_len=32)
     with pytest.raises(ValueError, match="overshoot"):
         spec.submit([1, 2, 3], max_new_tokens=29)  # 3+29+4 > 32
+
+
+def test_spec_serving_int8_target(models):
+    """The deployment shape: big int8-quantized target + small fp
+    draft. Exactness holds vs the plain engine on the SAME quantized
+    target (acceptance compares the quantized target's own argmax)."""
+    from pbs_tpu.models.quant import quantize_weights
+
+    params, dparams = models
+    qparams = quantize_weights(params)
+    plain = ContinuousBatcher(CFG, qparams, n_slots=2, prompt_bucket=8,
+                              max_len=64)
+    spec = SpeculativeBatcher(CFG, qparams, CFG, dparams, k=3,
+                              n_slots=2, prompt_bucket=8, max_len=64)
+    for eng in (plain, spec):
+        for p in PROMPTS:
+            eng.submit(p, max_new_tokens=8)
+    assert drain(plain) == drain(spec)
