@@ -129,3 +129,39 @@ def test_spec_serving_int8_target(models):
         for p in PROMPTS:
             eng.submit(p, max_new_tokens=8)
     assert drain(plain) == drain(spec)
+
+
+@pytest.mark.parametrize("seed", [11, 23])
+def test_spec_serving_randomized_exactness(models, seed):
+    """Seeded fuzz: random prompts, budgets, submission timing, and an
+    EOS drawn from the vocab — spec and plain engines must agree
+    request-for-request under any interleaving."""
+    params, dparams = models
+    rng = np.random.default_rng(seed)
+    reqs = [(list(rng.integers(1, CFG.vocab, rng.integers(1, 8))),
+             int(rng.integers(1, 12))) for _ in range(7)]
+    eos = int(rng.integers(1, CFG.vocab))
+    outs = []
+    for make in (
+        lambda: ContinuousBatcher(CFG, params, n_slots=2,
+                                  prompt_bucket=8, max_len=64,
+                                  eos_id=eos),
+        lambda: SpeculativeBatcher(CFG, params, CFG, dparams, k=3,
+                                   n_slots=2, prompt_bucket=8,
+                                   max_len=64, eos_id=eos),
+    ):
+        eng = make()
+        got = {}
+        pending = list(reqs)
+        ticks = 0
+        while (pending or eng.has_work()) and ticks < 400:
+            # staggered arrivals: a request lands every other tick
+            if pending and ticks % 2 == 0:
+                p, n = pending.pop(0)
+                eng.submit(p, max_new_tokens=n)
+            for c in eng.step():
+                got[c.request_id] = c.tokens
+            ticks += 1
+        assert not pending and not eng.has_work()
+        outs.append(got)
+    assert outs[0] == outs[1]
