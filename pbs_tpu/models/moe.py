@@ -271,12 +271,9 @@ def moe_forward_with_cache(cfg: MoEConfig, params: dict,
     router silently degrades quality."""
     from pbs_tpu.models.generate import _forward_with_cache_impl
 
-    def mlp_fn(lp, h):
-        y, _aux, drop = moe_mlp(cfg, h, lp, constrain_ec)
-        return y, drop
-
     logits, new_cache, drop_sum = _forward_with_cache_impl(
-        cfg, params, tokens, cache, constrain, mlp_fn=mlp_fn)
+        cfg, params, tokens, cache, constrain,
+        mlp_fn=moe_slot_mlp(cfg, constrain_ec))
     return logits, new_cache, drop_sum / cfg.n_layers
 
 
@@ -305,6 +302,23 @@ def make_moe_generate(cfg: MoEConfig, max_new_tokens: int,
         return toks, (drop0 * P + dsum) / total_tokens
 
     return generate
+
+
+def moe_slot_mlp(cfg: MoEConfig, constrain_ec=lambda x: x):
+    """The MoE FFN block in the serving ``mlp_fn`` contract —
+    ``(lp, h) -> (y, drop_frac)`` — shared by the lockstep cache path
+    (``moe_forward_with_cache``) and the continuous-batching engines
+    (``ContinuousBatcher(..., mlp_fn=moe_slot_mlp(cfg))``, where the
+    drop fraction surfaces as ``stats()['mlp_extra_mean']``). The
+    router sees each forward's tokens as its groups — dropless
+    capacity (ample ``capacity_factor``) keeps engine decode routing
+    identical to the lockstep path; a nonzero drop telemetry means
+    co-resident lanes are competing for expert slots."""
+    def mlp(lp, h):
+        y, _aux, drop = moe_mlp(cfg, h, lp, constrain_ec)
+        return y, drop
+
+    return mlp
 
 
 def moe_loss(cfg: MoEConfig, params: dict, tokens: jax.Array,

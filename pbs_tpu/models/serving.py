@@ -69,11 +69,22 @@ def init_slot_cache(cfg: TransformerConfig, n_slots: int,
 
 
 def _slot_forward(cfg: TransformerConfig, params: dict, tokens: jax.Array,
-                  cache: dict, row_pos: jax.Array) -> tuple[jax.Array, dict]:
+                  cache: dict, row_pos: jax.Array,
+                  mlp_fn=None) -> tuple[jax.Array, dict]:
     """Forward (B, S) tokens where row b sits at absolute position
     ``row_pos[b]`` (S static; per-row cursors). Writes K/V at
     ``row_pos[b] + s``; row b's query s attends cols <= row_pos[b]+s.
-    Returns (logits (B, S, vocab) fp32, updated cache slabs)."""
+    Returns (logits (B, S, vocab) fp32, updated cache slabs).
+
+    ``mlp_fn(lp, h) -> (y, extra)`` swaps the FFN block — the SAME
+    contract as ``generate._forward_with_cache_impl``, so the MoE
+    closure serves both paths. ``extra`` is the FFN's auxiliary scalar
+    (MoE: drop fraction) SUMMED over layers — callers divide by
+    ``cfg.n_layers``, exactly as generate's impl callers do. Caveat the
+    MoE caller owns: routing shares expert capacity across every
+    co-resident lane of the forward (slots, bucket padding, garbage
+    lanes), so engine decode only matches the lockstep path under
+    DROPLESS capacity — watch the returned drop telemetry."""
     B, S = tokens.shape
     T = cache["k"].shape[2]
     dt = cfg.dtype
@@ -88,7 +99,8 @@ def _slot_forward(cfg: TransformerConfig, params: dict, tokens: jax.Array,
     cos = cos_full[abs_pos]  # (B, S, half)
     sin = sin_full[abs_pos]
 
-    def body(x, layer):
+    def body(carry, layer):
+        x, extra = carry
         lp, ck, cv = layer  # ck/cv: (B, T, nkv, hd)
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         q = (h @ wload(lp["wq"], dt)).reshape(B, S, nh, hd)
@@ -121,20 +133,26 @@ def _slot_forward(cfg: TransformerConfig, params: dict, tokens: jax.Array,
         attn = attn.transpose(0, 3, 1, 2, 4).reshape(B, S, nh * hd)
         x = x + attn @ wload(lp["wo"], dt)
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(h @ wload(lp["w1"], dt))
-        up = h @ wload(lp["w3"], dt)
-        x = x + (gate * up) @ wload(lp["w2"], dt)
-        return x, (ck, cv)
+        if mlp_fn is None:
+            gate = jax.nn.silu(h @ wload(lp["w1"], dt))
+            up = h @ wload(lp["w3"], dt)
+            y = (gate * up) @ wload(lp["w2"], dt)
+            e = jnp.zeros((), jnp.float32)
+        else:
+            y, e = mlp_fn(lp, h)
+        x = x + y
+        return (x, extra + e), (ck, cv)
 
-    x, (new_k, new_v) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"]))
+    zero = jnp.zeros((), jnp.float32)
+    (x, extra), (new_k, new_v) = jax.lax.scan(
+        body, (x, zero), (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ wload(params["head"], dt)).astype(jnp.float32)
-    return logits, {"k": new_k, "v": new_v, "pos": cache["pos"]}
+    return logits, {"k": new_k, "v": new_v, "pos": cache["pos"]}, extra
 
 
 def ingest_slot_prompt(cfg: TransformerConfig, params: dict, cache: dict,
-                       slot, prompt: jax.Array, plen):
+                       slot, prompt: jax.Array, plen, mlp_fn=None):
     """The ONE copy of slot-prompt ingestion (trace-safe): gather the
     slot's slabs as a B=1 view, forward the padded prompt from
     position 0, write the slabs back (vmapped-DUS layout — load-bearing
@@ -145,15 +163,16 @@ def ingest_slot_prompt(cfg: TransformerConfig, params: dict, cache: dict,
         "v": jax.lax.dynamic_slice_in_dim(cache["v"], slot, 1, axis=1),
         "pos": jnp.zeros((1,), jnp.int32),
     }
-    logits, sub = _slot_forward(cfg, params, prompt[None, :], sub,
-                                jnp.zeros((1,), jnp.int32))
+    logits, sub, extra = _slot_forward(
+        cfg, params, prompt[None, :], sub, jnp.zeros((1,), jnp.int32),
+        mlp_fn=mlp_fn)
     cache = dict(cache)
     cache["k"] = jax.lax.dynamic_update_slice_in_dim(
         cache["k"], sub["k"], slot, axis=1)
     cache["v"] = jax.lax.dynamic_update_slice_in_dim(
         cache["v"], sub["v"], slot, axis=1)
     cache["pos"] = cache["pos"].at[slot].set(plen)
-    return logits[0, plen - 1], cache
+    return logits[0, plen - 1], cache, extra
 
 
 @dataclasses.dataclass
@@ -180,13 +199,16 @@ class ContinuousBatcher:
                  max_len: int | None = None, temperature: float = 0.0,
                  eos_id: int | None = None, seed: int = 0,
                  mesh=None, prefix_cache_size: int = 0,
-                 clock=None):
+                 clock=None, mlp_fn=None):
         self.cfg = cfg
         # Latency-stat clock: seconds, monotonic. Injectable so TTFT /
         # completion latencies can be accounted in virtual time —
         # deterministic SLO tests and replayable traces (the xentop
         # analog reads the same stats either way).
         self._now = clock or time.monotonic
+        # FFN swap (same seam as generate._forward_with_cache_impl):
+        # the MoE family serves through this engine via moe_slot_mlp.
+        self.mlp_fn = mlp_fn
         self.n_slots = n_slots
         self.bucket = prompt_bucket
         self.max_len = max_len or cfg.max_seq
@@ -195,6 +217,11 @@ class ContinuousBatcher:
         self.temperature = temperature
         self.eos_id = eos_id
         self.mesh = mesh
+        if mesh is not None and mlp_fn is not None:
+            raise ValueError(
+                "a custom mlp_fn (MoE serving) is not supported with a "
+                "tp serving mesh yet: param_specs only covers the dense "
+                "layer tree")
         cache = init_slot_cache(cfg, n_slots, self.max_len)
         if mesh is not None:
             # Tensor-parallel serving by PLACEMENT (the GSPMD recipe):
@@ -255,6 +282,11 @@ class ContinuousBatcher:
         self.requests_completed = 0
         # This tick's admissions (subclass hook; see _admit).
         self._admitted: list = []
+        # FFN auxiliary telemetry (MoE: drop fraction), averaged over
+        # forwards — the capacity-starvation signal the lockstep MoE
+        # serving path reports, preserved through the engine.
+        self._mlp_extra_sum = 0.0
+        self._mlp_extra_n = 0
         # Exact-prompt prefix cache (system-prompt reuse): LRU of
         # {prompt bytes -> prompt-window KV + last-position logits}.
         # Entries are DEVICE arrays — storing the lazy slot slice
@@ -282,11 +314,12 @@ class ContinuousBatcher:
             first token. prompt: (bucket,) padded; plen: real length.
             Also returns the last-position logits (for the prefix
             cache)."""
-            last_logits, cache = ingest_slot_prompt(
-                cfg_, params, cache, slot, prompt, plen)
+            last_logits, cache, extra = ingest_slot_prompt(
+                cfg_, params, cache, slot, prompt, plen,
+                mlp_fn=self.mlp_fn)
             first = _sample(last_logits[None, :], key,
                             self.temperature)[0]
-            return first, last_logits, cache
+            return first, last_logits, cache, extra
 
         @jax.jit
         def _install(cache, slot, kwin, vwin, plen):
@@ -303,8 +336,9 @@ class ContinuousBatcher:
         @jax.jit
         def _decode(params, cache, last_tok, active, key):
             """One token for every slot; inactive lanes masked."""
-            logits, new_cache = _slot_forward(
-                cfg_, params, last_tok[:, None], cache, cache["pos"])
+            logits, new_cache, extra = _slot_forward(
+                cfg_, params, last_tok[:, None], cache, cache["pos"],
+                mlp_fn=self.mlp_fn)
             keys = jax.random.split(key, self.n_slots)
             nxt = jax.vmap(
                 lambda lg, k: _sample(lg[None, :], k,
@@ -312,7 +346,7 @@ class ContinuousBatcher:
             )(logits[:, 0, :], keys)
             nxt = jnp.where(active, nxt, 0)
             new_cache["pos"] = cache["pos"] + active.astype(jnp.int32)
-            return nxt, new_cache
+            return nxt, new_cache, extra
 
         self._prefill_fn = _prefill
         self._install_fn = _install
@@ -384,10 +418,13 @@ class ContinuousBatcher:
                 first = int(_sample(
                     ent["logits"][None, :], sub, self.temperature)[0])
             else:
-                first, last_logits, self.cache = self._prefill_fn(
-                    self.params, self.cache, slot, jnp.asarray(padded),
-                    len(prompt), sub)
+                first, last_logits, self.cache, extra = \
+                    self._prefill_fn(
+                        self.params, self.cache, slot,
+                        jnp.asarray(padded), len(prompt), sub)
                 first = int(first)
+                self._mlp_extra_sum += float(extra) / self.cfg.n_layers
+                self._mlp_extra_n += 1
                 self.prefill_count += 1
                 if self.prefix_cache_size:
                     self.prefix_misses += 1
@@ -472,9 +509,11 @@ class ContinuousBatcher:
         if not any_active:
             return done
         self._key, sub = jax.random.split(self._key)
-        nxt, self.cache = self._decode_fn(
+        nxt, self.cache, extra = self._decode_fn(
             self.params, self.cache, jnp.asarray(self.last_tok),
             jnp.asarray(self.active), sub)
+        self._mlp_extra_sum += float(extra) / self.cfg.n_layers
+        self._mlp_extra_n += 1
         nxt = np.asarray(nxt)
         for slot in range(self.n_slots):
             if not self.active[slot]:
@@ -512,6 +551,12 @@ class ContinuousBatcher:
             "latency_p99_s": round(self._pct(self._latencies, 0.99), 6),
             "prefix_hits": self.prefix_hits,
             "prefix_misses": self.prefix_misses,
+            # FFN auxiliary mean (MoE: drop fraction; 0 for dense) —
+            # nonzero under capacity starvation means engine routing
+            # has diverged from the dropless/lockstep contract.
+            "mlp_extra_mean": round(
+                self._mlp_extra_sum / self._mlp_extra_n, 6)
+            if self._mlp_extra_n else 0.0,
         }
 
 
@@ -541,7 +586,7 @@ class SpeculativeBatcher(ContinuousBatcher):
 
     def __init__(self, cfg: TransformerConfig, params: dict,
                  draft_cfg: TransformerConfig, draft_params: dict,
-                 k: int = 4, **kw):
+                 k: int = 4, draft_mlp_fn=None, **kw):
         if kw.get("temperature", 0.0) != 0.0:
             raise ValueError(
                 "SpeculativeBatcher is greedy-only (temperature=0): "
@@ -557,20 +602,26 @@ class SpeculativeBatcher(ContinuousBatcher):
         super().__init__(cfg, params, **kw)
         self.draft_cfg = draft_cfg
         self.draft_params = draft_params
+        self.draft_mlp_fn = draft_mlp_fn
         self.k = k
         self.dcache = init_slot_cache(draft_cfg, self.n_slots,
                                       self.max_len)
         self.spec_proposed = 0
         self.spec_accepted = 0
+        # Draft-side FFN telemetry (a starved MoE draft collapses
+        # acceptance silently; this is its alarm).
+        self._draft_extra_sum = 0.0
+        self._draft_extra_n = 0
         dcfg_, cfg_, n_slots = draft_cfg, cfg, self.n_slots
 
         @jax.jit
         def _draft_prefill(dparams, dcache, slot, prompt, plen):
             """Mirror of the target prefill for the draft cache: the
             shared ingest, logits discarded (the target picks tokens)."""
-            _, dcache = ingest_slot_prompt(dcfg_, dparams, dcache, slot,
-                                           prompt, plen)
-            return dcache
+            _, dcache, extra = ingest_slot_prompt(
+                dcfg_, dparams, dcache, slot, prompt, plen,
+                mlp_fn=self.draft_mlp_fn)
+            return dcache, extra
 
         kk = self.k
 
@@ -582,21 +633,26 @@ class SpeculativeBatcher(ContinuousBatcher):
             pos = tcache["pos"]  # (B,), == dcache["pos"] by invariant
 
             def dstep(c, _):
-                tok, dc, dp = c
-                logits, dc = _slot_forward(dcfg_, dparams, tok[:, None],
-                                           dc, dp)
+                tok, dc, dp, de = c
+                logits, dc, e = _slot_forward(
+                    dcfg_, dparams, tok[:, None], dc, dp,
+                    mlp_fn=self.draft_mlp_fn)
                 nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-                return (nxt, dc, dp + 1), nxt
+                return (nxt, dc, dp + 1, de + e), nxt
 
-            (last, dcache, dp), props = jax.lax.scan(
-                dstep, (cur, dcache, pos), None, length=kk)
+            zero_e = jnp.zeros((), jnp.float32)
+            (last, dcache, dp, d_extra), props = jax.lax.scan(
+                dstep, (cur, dcache, pos, zero_e), None, length=kk)
             t = props.T  # (B, k)
             # Ingest t_k so draft KV reaches pos+k whatever acceptance.
-            _, dcache = _slot_forward(dcfg_, dparams, last[:, None],
-                                      dcache, dp)
+            _, dcache, e2 = _slot_forward(
+                dcfg_, dparams, last[:, None], dcache, dp,
+                mlp_fn=self.draft_mlp_fn)
+            d_extra = d_extra + e2
 
             x = jnp.concatenate([cur[:, None], t], axis=1)  # (B, k+1)
-            logits, tcache = _slot_forward(cfg_, params, x, tcache, pos)
+            logits, tcache, extra = _slot_forward(
+                cfg_, params, x, tcache, pos, mlp_fn=self.mlp_fn)
             g = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             from pbs_tpu.models.speculative import greedy_accept_window
 
@@ -606,7 +662,7 @@ class SpeculativeBatcher(ContinuousBatcher):
             dcache = dict(dcache, pos=pos + adv)
             n_act = jnp.sum(active.astype(jnp.int32))
             return (toks, adv, tcache, dcache, kk * n_act,
-                    jnp.sum(jnp.where(active, m_row, 0)))
+                    jnp.sum(jnp.where(active, m_row, 0)), extra, d_extra)
 
         self._draft_prefill_fn = _draft_prefill
         self._spec_decode_fn = _spec_decode
@@ -631,15 +687,26 @@ class SpeculativeBatcher(ContinuousBatcher):
     def step(self) -> list[Completion]:
         done, any_active = self._pre_decode()
         for slot, padded, plen in self._admitted:
-            self.dcache = self._draft_prefill_fn(
+            self.dcache, d_extra = self._draft_prefill_fn(
                 self.draft_params, self.dcache, slot,
                 jnp.asarray(padded), plen)
+            self._draft_extra_sum += \
+                float(d_extra) / self.draft_cfg.n_layers
+            self._draft_extra_n += 1
         if not any_active:
             return done
-        toks, counts, self.cache, self.dcache, prop, acc = (
+        (toks, counts, self.cache, self.dcache, prop, acc, extra,
+         d_extra) = (
             self._spec_decode_fn(
                 self.params, self.draft_params, self.cache, self.dcache,
                 jnp.asarray(self.last_tok), jnp.asarray(self.active)))
+        self._mlp_extra_sum += float(extra) / self.cfg.n_layers
+        self._mlp_extra_n += 1
+        # kk+1 draft forwards per tick, each a per-layer sum.
+        self._draft_extra_sum += (float(d_extra)
+                                  / (self.draft_cfg.n_layers
+                                     * (self.k + 1)))
+        self._draft_extra_n += 1
         toks = np.asarray(toks)
         counts = np.asarray(counts)
         self.spec_proposed += int(prop)
@@ -664,6 +731,9 @@ class SpeculativeBatcher(ContinuousBatcher):
         st["spec_acceptance"] = round(
             self.spec_accepted / self.spec_proposed, 4) \
             if self.spec_proposed else 0.0
+        st["draft_mlp_extra_mean"] = round(
+            self._draft_extra_sum / self._draft_extra_n, 6) \
+            if self._draft_extra_n else 0.0
         return st
 
 

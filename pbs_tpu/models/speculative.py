@@ -226,9 +226,10 @@ def make_per_row_speculative_generate(
         dcache = init_slot_cache(draft_cfg, B, max_len)
         zerop = jnp.zeros((B,), jnp.int32)
 
-        tlogits, tcache = _slot_forward(cfg, params, prompt, tcache, zerop)
-        _, dcache = _slot_forward(draft_cfg, draft_params, prompt,
-                                  dcache, zerop)
+        tlogits, tcache, _e = _slot_forward(cfg, params, prompt, tcache,
+                                            zerop)
+        _, dcache, _e = _slot_forward(draft_cfg, draft_params, prompt,
+                                      dcache, zerop)
         first = jnp.argmax(tlogits[:, -1, :], axis=-1).astype(jnp.int32)
 
         out = jnp.zeros((B, W), jnp.int32)
@@ -245,8 +246,8 @@ def make_per_row_speculative_generate(
             # Draft proposes k tokens per row from its own cursor.
             def dstep(c, _):
                 tok, dc, dp = c
-                logits, dc = _slot_forward(draft_cfg, draft_params,
-                                           tok[:, None], dc, dp)
+                logits, dc, _ = _slot_forward(draft_cfg, draft_params,
+                                              tok[:, None], dc, dp)
                 nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
                 return (nxt, dc, dp + 1), nxt
 
@@ -255,13 +256,14 @@ def make_per_row_speculative_generate(
             t = props.T  # (B, k)
             # Ingest t_k so the draft holds KV through pos+k whatever
             # the acceptance (logits discarded; overwritten on rollback).
-            _, dcache = _slot_forward(draft_cfg, draft_params,
-                                      last[:, None], dcache, dp)
+            _, dcache, _e2 = _slot_forward(draft_cfg, draft_params,
+                                           last[:, None], dcache, dp)
 
             # Target verifies k+1 positions per row at its own cursor;
             # per-row accepted prefix — NO batch-min.
             x = jnp.concatenate([cur[:, None], t], axis=1)  # (B, k+1)
-            logits, tcache = _slot_forward(cfg, params, x, tcache, pos)
+            logits, tcache, _e3 = _slot_forward(cfg, params, x, tcache,
+                                                pos)
             g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, k+1)
             round_toks, m_row, bonus = greedy_accept_window(t, g)
             out_new = write_rows(out, round_toks, n_out)
