@@ -80,3 +80,57 @@ def test_train_and_serve_multiplexed_by_credit(tiny_world):
     # the feedback policy observed both tenants
     names = {row["job"] for row in fb.dump()}
     assert names == {"train", "serve"}
+
+
+def test_speculative_engine_as_scheduled_tenant(tiny_world):
+    """The full serving stack as a scheduler tenant: a SpeculativeBatcher
+    wrapped by make_continuous_serve_step co-scheduled against a real
+    train loop — engine ticks are the BOOSTed tenant's quanta, spec
+    throughput lands in the TOKENS ledger."""
+    from pbs_tpu.models import (
+        SpeculativeBatcher,
+        make_continuous_serve_step,
+    )
+
+    cfg, params, init_opt, train_step, _ = tiny_world
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab, jnp.int32)
+
+    be = TpuBackend(clock=MonotonicClock())
+    part = Partition("colo-spec", source=be, scheduler="credit")
+
+    jit_train = jax.jit(train_step)
+    train = part.add_job(Job(
+        "train",
+        step_fn=lambda s: jit_train(s, tokens),
+        state=(params, jax.jit(init_opt)(params), 0),
+        params=SchedParams(weight=512, boost_on_wake=False),
+        max_steps=25,
+    ))
+
+    eng = SpeculativeBatcher(cfg, params, cfg, params, k=3, n_slots=2,
+                             prompt_bucket=8, max_len=64)
+    reqs = iter([([1, 2, 3], 6), ([4, 5], 6), ([6, 7, 8], 6)])
+
+    def feed(step):
+        try:
+            return [next(reqs)]
+        except StopIteration:
+            return []
+
+    serve_step = make_continuous_serve_step(eng, next_requests=feed)
+    serve = part.add_job(Job(
+        "svc",
+        step_fn=serve_step,
+        state={"step": 0, "completed": 0},
+        params=SchedParams(weight=256, boost_on_wake=True),
+        max_steps=25,
+    ))
+
+    part.run(max_rounds=400)
+    assert train.steps_retired() == 25
+    assert eng.stats()["completed"] == 3
+    assert eng.stats()["spec_acceptance"] == 1.0  # self-draft
+    # Spec throughput is exact goodput in the tenant's TOKENS ledger.
+    assert int(serve.contexts[0].counters[Counter.TOKENS]) == \
+        eng.stats()["tokens_emitted"]
