@@ -91,6 +91,25 @@ def main() -> int:
     print(f"speculative serving: {sst['completed']} requests in "
           f"{sst['steps']} engine ticks, acceptance "
           f"{sst['spec_acceptance']:.0%}")
+
+    # The second model family through the SAME engine: MoE serving via
+    # the shared FFN seam, with router drop telemetry in the stats.
+    from pbs_tpu.models import MoEConfig, init_moe_params
+    from pbs_tpu.models.moe import moe_slot_mlp
+
+    mcfg = MoEConfig(vocab=CFG.vocab, d_model=64, n_layers=2, n_heads=4,
+                     n_kv_heads=2, d_ff=96, max_seq=128,
+                     dtype=CFG.dtype, n_experts=4, top_k=2,
+                     capacity_factor=4.0)
+    mparams = init_moe_params(mcfg, jax.random.PRNGKey(3))
+    meng = ContinuousBatcher(mcfg, mparams, n_slots=2, prompt_bucket=64,
+                             max_len=128, mlp_fn=moe_slot_mlp(mcfg))
+    meng.submit(encode_text(system, add_eos=False), max_new_tokens=8)
+    while meng.has_work():
+        meng.step()
+    mst = meng.stats()
+    print(f"MoE serving: {mst['completed']} request, router drop "
+          f"telemetry {mst['mlp_extra_mean']:.3f} (dropless)")
     return 0
 
 
