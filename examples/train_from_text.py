@@ -36,7 +36,7 @@ from pbs_tpu.data import (
     TokenDataset,
     corpus_from_file,
     corpus_from_text,
-    make_batch_source,
+    ShardedBatchSource,
 )
 from pbs_tpu.models import TransformerConfig, init_params, make_train_step
 
@@ -66,15 +66,24 @@ def main() -> int:
     step = jax.jit(step, donate_argnums=(0,))
 
     ds = TokenDataset(corpus)
-    src = make_batch_source(ds, batch=BATCH, seq_len=SEQ, seed=0)
+    # ShardedBatchSource: on a multi-host pod each host would pass its
+    # own host_id/n_hosts and draw its disjoint slice of one global
+    # schedule; the cursor rides the checkpoint so a restore resumes
+    # the exact data position on every host.
+    src = ShardedBatchSource(ds, global_batch=BATCH, seq_len=SEQ,
+                             host_id=0, n_hosts=1, seed=0)
     with Prefetcher(src, depth=2) as pf:
         for i in range(STEPS):
             state, m = step(state, jnp.asarray(next(pf)))
             if i % 10 == 0 or i == STEPS - 1:
                 print(f"step {i:3d}  loss {float(m['loss']):.3f}")
     ckpt = os.path.join(workdir, "ckpt")
+    # Cursor from the CONSUMED count (one batch per step), not the
+    # producer counter: the prefetcher sources ahead by a thread-
+    # timing-dependent amount, which would desync hosts on restore.
+    cursor = dict(src.state(), step=STEPS)
     save_checkpoint(ckpt, jax.device_get(state[0]),
-                    metadata={"steps": STEPS})
+                    metadata={"steps": STEPS, "data_cursor": cursor})
     print(f"checkpoint: {ckpt}  (pbst ckpt-info / pbst quantize)")
     ds.close()
     return 0

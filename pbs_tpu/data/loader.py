@@ -47,10 +47,12 @@ class ShardedBatchSource:
     not replay I/O — SURVEY.md §5 checkpoint/resume).
 
     Under a :class:`Prefetcher` the cursor counts *sourced* batches,
-    which run ``depth`` ahead of consumption — a checkpoint taken
-    mid-stream therefore skips the in-flight batches on restore
-    (deterministically; never replays), the right bias for training
-    data.
+    which run ``depth`` ahead of consumption by a thread-timing-
+    dependent amount. Single-host that merely skips in-flight batches
+    on restore (never replays — the right bias for training data);
+    MULTI-host it would desync the hosts' shared schedule, so derive
+    the checkpointed cursor from the CONSUMED count instead:
+    ``dict(src.state(), step=consumed_steps)``.
     """
 
     def __init__(self, ds: TokenDataset, global_batch: int, seq_len: int,
