@@ -105,11 +105,12 @@ def main() -> None:
         return st, losses[-1]
 
     chunk = jax.jit(run_chunk, donate_argnums=(0,))
-    _mark("compiling + warming up train chunk")
+    _mark("compiling train chunk")
 
-    for _ in range(WARMUP_CHUNKS):
+    for i in range(WARMUP_CHUNKS):
         state, loss = chunk(state, tokens)
-    float(loss)  # host fetch: hard sync
+        float(loss)  # host fetch: hard sync per chunk so a stalled
+        _mark(f"warmup chunk {i} done")  # execution is attributable
     _mark("warmup done; timing")
 
     t0 = time.perf_counter()
@@ -148,54 +149,72 @@ def main() -> None:
 
 
 def _supervise() -> None:
-    """Run the benchmark in a child with a hard timeout; the parent has
-    no JAX state so it can neither hang nor crash, and always emits the
-    one JSON line (the child's on success, an error payload otherwise)."""
+    """Run the benchmark in a child with a deadline; the parent has no
+    JAX state so it can neither hang nor crash, and always emits the
+    one JSON line (the child's on success, an error payload otherwise).
+
+    Wedge rule (docs/OPS.md "The chip", round-3 postmortem): a TPU
+    client that is killed while holding the claim — mid-compile OR
+    mid-execution — wedges the claim for hours.  So on deadline the
+    supervisor ORPHANS the worker (prints the error JSON and exits,
+    leaving the child to finish or block harmlessly); it never sends a
+    signal.  The stdout pipe is spilled to a file so an orphan cannot
+    block on a full pipe after the parent exits."""
     import tempfile
 
     last_err = "unknown"
     for attempt in range(2):
-        # Child stderr goes to a FILE, not a pipe: on a timeout the
-        # stage markers written so far survive, so the error says how
-        # far the worker got before the chip wedged (round-2 lesson).
+        # Child stdio goes to FILES, not pipes: on a deadline the stage
+        # markers written so far survive (the error says how far the
+        # worker got), and the orphaned child can keep writing.
         with tempfile.NamedTemporaryFile(
                 mode="w+", suffix=".bench.log", delete=False) as errf:
             errpath = errf.name
-        try:
-            with open(errpath, "r+") as ef:
-                try:
-                    proc = subprocess.run(
-                        [sys.executable, os.path.abspath(__file__),
-                         "--worker"],
-                        stdout=subprocess.PIPE,
-                        stderr=ef,
-                        timeout=ATTEMPT_TIMEOUT_S,
-                        cwd=os.path.dirname(os.path.abspath(__file__)),
-                    )
-                except subprocess.TimeoutExpired:
-                    ef.seek(0)
-                    marks = [ln.strip() for ln in ef.read().splitlines()
-                             if ln.startswith("[bench ")]
-                    stage = marks[-1] if marks else "<no stage reached>"
-                    last_err = (
-                        f"timeout after {ATTEMPT_TIMEOUT_S:.0f}s; last "
-                        f"stage: {stage} (TPU backend hang — chip absent "
-                        "or held by another process?)"
-                    )
-                    # No retry after a full-budget hang: a second 480 s
-                    # attempt would overrun any plausible external kill
-                    # budget and lose the JSON line entirely (the
-                    # round-1 rc=124 outcome).
-                    break
-                ef.seek(0)
-                err_text = ef.read()
-        finally:
+        with tempfile.NamedTemporaryFile(
+                mode="w+", suffix=".bench.out", delete=False) as outf:
+            outpath = outf.name
+        timed_out = False
+        with open(errpath, "r+") as ef, open(outpath, "r+") as of:
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--worker"],
+                stdout=of,
+                stderr=ef,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
             try:
-                os.unlink(errpath)
+                # wait() never signals the child, so the no-kill
+                # invariant holds on timeout.
+                proc.wait(timeout=ATTEMPT_TIMEOUT_S)
+            except subprocess.TimeoutExpired:
+                timed_out = True
+            ef.seek(0)
+            err_text = ef.read()
+            of.seek(0)
+            out = of.read()
+            if timed_out:
+                marks = [ln.strip() for ln in err_text.splitlines()
+                         if ln.startswith("[bench ")]
+                stage = marks[-1] if marks else "<no stage reached>"
+                last_err = (
+                    f"deadline after {ATTEMPT_TIMEOUT_S:.0f}s; last "
+                    f"stage: {stage} (worker left running unkilled — "
+                    f"pid {proc.pid}, stdout={outpath}, "
+                    f"stderr={errpath}; do not start another TPU "
+                    "client until it exits)"
+                )
+        if timed_out:
+            # No kill, no retry (a second client would queue behind
+            # the orphan's claim), and NO unlink: if the orphan later
+            # finishes, its result JSON and stage markers are in the
+            # named files above — recoverable, not on deleted inodes.
+            sys.stderr.write(err_text)
+            break
+        for p in (errpath, outpath):
+            try:
+                os.unlink(p)
             except OSError:
                 pass
         sys.stderr.write(err_text)
-        out = proc.stdout.decode(errors="replace")
         lines = [ln for ln in out.splitlines() if ln.startswith("{")]
         if proc.returncode == 0 and lines:
             print(lines[-1])

@@ -1,8 +1,14 @@
 #!/bin/bash
 # On-chip work queue: run when the TPU claim is free. ONE client at a
-# time; stages run sequentially and log to chip_logs/. Generous
-# timeouts only — killing a TPU client mid-compile wedges the claim
-# (docs/OPS.md "The chip").
+# time; stages run sequentially and log to chip_logs/.
+#
+# WEDGE RULE (docs/OPS.md "The chip", round-3 postmortem): a TPU
+# client killed while holding the claim — mid-compile OR mid-execution
+# — wedges the claim for hours.  Therefore NO stage here runs under
+# `timeout` and nothing in this script ever signals a client.  If a
+# stage blocks, the queue blocks with it; read chip_logs/ and leave
+# the process alone.  bench.py's internal supervisor orphans (never
+# kills) its worker.
 #
 # Stage order is evidence-priority: headline number first (the round's
 # make-or-break artifact + warm compile cache), then kernel
@@ -13,45 +19,49 @@ mkdir -p chip_logs
 TS=$(date +%H%M%S)
 log() { echo "[chip_queue $(date +%H:%M:%S)] $*" | tee -a "chip_logs/queue_$TS.log"; }
 
-log "stage 1: headline bench (self-supervised; outer cap is slack)"
-timeout --signal=SIGTERM --kill-after=60 1300 python bench.py \
-    >"chip_logs/bench_$TS.json" 2>"chip_logs/bench_$TS.err"
+log "stage 1: headline bench (self-supervised, orphan-on-deadline)"
+python bench.py >"chip_logs/bench_$TS.json" 2>"chip_logs/bench_$TS.err"
 log "bench rc=$? ($(cat chip_logs/bench_$TS.json 2>/dev/null))"
+if grep -q "worker left running" "chip_logs/bench_$TS.json" 2>/dev/null; then
+    # bench.py orphaned its worker: that orphan still holds (or is
+    # queued on) the claim. Starting stage 2 would stack a second
+    # client behind it — the one-client rule (docs/OPS.md). Stop.
+    log "stage 1 orphaned its worker — aborting the queue; wait for the orphan to exit before any further chip work"
+    exit 1
+fi
 
 log "stage 2: on-chip kernel validation (tpu_tests)"
-PBST_TPU_TESTS=1 timeout 1800 python -m pytest tpu_tests/ -q \
+PBST_TPU_TESTS=1 python -m pytest tpu_tests/ -q \
     >"chip_logs/tpu_tests_$TS.log" 2>&1
 log "tpu_tests rc=$? (tail: $(tail -1 chip_logs/tpu_tests_$TS.log))"
 
 log "stage 3: serving benchmark"
-timeout 1500 python bench_serving.py \
+python bench_serving.py \
     >"chip_logs/serving_$TS.json" 2>"chip_logs/serving_$TS.err"
 log "bench_serving rc=$? ($(cat chip_logs/serving_$TS.json 2>/dev/null | tr '\n' ' '))"
 
 log "stage 4: pallas sweep (incl. batch-8 / remat-none MFU push points)"
-PBST_SWEEP_ATTN=pallas timeout --signal=SIGTERM --kill-after=60 3600 \
-    python bench_sweep.py \
+PBST_SWEEP_ATTN=pallas python bench_sweep.py \
     >"chip_logs/sweep_pallas_$TS.jsonl" 2>"chip_logs/sweep_pallas_$TS.err"
 log "sweep rc=$? ($(tail -2 chip_logs/sweep_pallas_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
 
 log "stage 4c: chunked-CE sweep (does loss_chunks=8 unlock batch 8?)"
-PBST_SWEEP_LOSS_CHUNKS=8 PBST_SWEEP_ATTN=xla \
-    timeout --signal=SIGTERM --kill-after=60 1500 python bench_sweep.py \
+PBST_SWEEP_LOSS_CHUNKS=8 PBST_SWEEP_ATTN=xla python bench_sweep.py \
     >"chip_logs/sweep_lc8_$TS.jsonl" 2>"chip_logs/sweep_lc8_$TS.err"
 log "lc8 sweep rc=$? ($(tail -2 chip_logs/sweep_lc8_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
 
 log "stage 5: long-context flash-vs-xla (S=4096/8192)"
-timeout 2400 python bench_longctx.py \
+python bench_longctx.py \
     >"chip_logs/longctx_$TS.jsonl" 2>"chip_logs/longctx_$TS.err"
 log "longctx rc=$? ($(tail -3 chip_logs/longctx_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
 
 log "stage 5b: roofline decomposition (MFU accounting)"
-timeout --signal=SIGTERM --kill-after=60 1200 python bench_decompose.py \
+python bench_decompose.py \
     >"chip_logs/decompose_$TS.jsonl" 2>"chip_logs/decompose_$TS.err"
 log "decompose rc=$? ($(tail -1 chip_logs/decompose_$TS.jsonl 2>/dev/null))"
 
 log "stage 6: headline bench re-run (warm cache, final number)"
-timeout --signal=SIGTERM --kill-after=60 1300 python bench.py \
+python bench.py \
     >"chip_logs/bench_final_$TS.json" 2>"chip_logs/bench_final_$TS.err"
 log "final bench rc=$? ($(cat chip_logs/bench_final_$TS.json 2>/dev/null))"
 

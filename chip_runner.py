@@ -1,0 +1,165 @@
+"""Long-lived single-claim chip session: diagnose, then measure.
+
+The round-2/3 wedge postmortem (docs/OPS.md "The chip") showed two
+facts: (1) killing a TPU client that holds the claim wedges it for
+hours; (2) the claim frees on its own when nobody pokes it with killed
+clients.  This runner is the consequence: ONE process that acquires
+the claim ONCE (blocking as long as that takes), runs the whole
+instrumented agenda with per-stage timestamps, writes results to
+chip_logs/, and exits cleanly.  It must NEVER be run under `timeout`
+or killed — if it blocks, leave it alone and read its log.
+
+Stages (each logged with wall-time deltas):
+  1. backend init + tiny matmul (claim acquisition marker)
+  2. flagship params/opt init + HBM stats
+  3. bare donated train_step x5 — per-step time (a stall here is
+     execution, not compile; donation is mandatory at this size:
+     2x the 8.4 GB fp32 state would breach the 16 GB HBM)
+  5. 10-step donated lax.scan chunk (the exact bench.py shape)
+  6. steady-state measurement (bench.py's chunk protocol, in-process)
+  -> chip_logs/runner_result_<ts>.json  (same schema as bench.py)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+T0 = time.time()
+TS = time.strftime("%H%M%S")
+LOG_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "chip_logs", f"runner_{TS}.log")
+os.makedirs(os.path.dirname(LOG_PATH), exist_ok=True)
+
+
+def mark(msg: str) -> None:
+    line = f"[runner +{time.time() - T0:8.1f}s {time.strftime('%H:%M:%S')}] {msg}"
+    with open(LOG_PATH, "a") as f:
+        f.write(line + "\n")
+    print(line, flush=True)
+
+
+def hbm(dev) -> str:
+    try:
+        s = dev.memory_stats()
+        if not s:
+            return "no-stats"
+        used = s.get("bytes_in_use", 0)
+        limit = s.get("bytes_limit", 0)
+        return f"{used/1e9:.2f}/{limit/1e9:.2f} GB"
+    except Exception as e:  # noqa: BLE001 — telemetry only
+        return f"stats-err:{e}"
+
+
+def main() -> None:
+    mark("importing jax")
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    import bench  # single source of the headline protocol's constants
+    from bench_common import PEAK_FLOPS, setup_compilation_cache
+
+    setup_compilation_cache(log=mark)
+    mark("backend init (blocks here while the claim is held elsewhere)")
+    devs = jax.devices()
+    dev = devs[0]
+    mark(f"claim acquired: {devs}")
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    y = (x @ x).block_until_ready()
+    mark(f"tiny matmul ok sum={float(y.sum()):.1f}; hbm={hbm(dev)}")
+
+    from pbs_tpu.models import init_params, make_train_step
+    from __graft_entry__ import _flagship_cfg
+
+    cfg = _flagship_cfg()
+    n_params = cfg.num_params()
+    key = jax.random.PRNGKey(0)
+    params = init_params(cfg, key)
+    jax.block_until_ready(params)
+    mark(f"params initialized ({n_params/1e6:.0f}M); hbm={hbm(dev)}")
+    init_opt, train_step = make_train_step(cfg, learning_rate=3e-4)
+    state = (params, jax.jit(init_opt)(params), 0)
+    jax.block_until_ready(state)
+    mark(f"opt state initialized; hbm={hbm(dev)}")
+
+    BATCH, SEQ = bench.BATCH, bench.SEQ
+    tokens = jax.random.randint(key, (BATCH, SEQ), 0, cfg.vocab, jnp.int32)
+    tokens.block_until_ready()
+
+    # Stage 3: bare single steps, no scan. DONATED: the non-donated
+    # variant needs 2x the 8.4 GB fp32 state live at once — over the
+    # 16 GB HBM budget — so it would probe OOM behavior, not timing.
+    # Donation matches bench.py's shape anyway; per-step marks are the
+    # diagnostic (a stall here pins execution, not compile).
+    step_d = jax.jit(train_step, donate_argnums=(0,))
+    mark("stage 3: compiling bare train_step (donated)")
+    try:
+        state, m = step_d(state, tokens)
+        jax.block_until_ready(state)
+        mark(f"  first bare step done (compile+run); "
+             f"loss={float(m['loss']):.4f}; hbm={hbm(dev)}")
+        for i in range(4):
+            t = time.time()
+            state, m = step_d(state, tokens)
+            jax.block_until_ready(state)
+            mark(f"  bare step {i}: {time.time()-t:6.3f}s")
+    except Exception as e:  # noqa: BLE001 — name the failure in the log
+        mark(f"  stage 3 FAILED: {type(e).__name__}: {e}")
+        raise  # later stages share the shape; nothing left to salvage
+
+    # Stage 4/5: the bench.py scan chunk, donated.
+    STEPS = bench.STEPS_PER_CHUNK
+
+    def run_chunk(st, toks):
+        def body(carry, _):
+            carry, mm = train_step(carry, toks)
+            return carry, mm["loss"]
+        st, losses = lax.scan(body, st, None, length=STEPS)
+        return st, losses[-1]
+
+    chunk_d = jax.jit(run_chunk, donate_argnums=(0,))
+    mark("stage 5: compiling donated chunk (exact bench.py shape)")
+    state, loss = chunk_d(state, tokens)
+    mark(f"  donated chunk 1 done, loss={float(loss):.4f}; hbm={hbm(dev)}")
+    t = time.time()
+    state, loss = chunk_d(state, tokens)
+    float(loss)
+    mark(f"  warm donated chunk: {time.time()-t:6.3f}s")
+
+    # Stage 6: steady-state measurement, bench.py protocol.
+    BENCH_CHUNKS = bench.BENCH_CHUNKS
+    mark(f"stage 6: timing {BENCH_CHUNKS} donated chunks "
+         f"({BENCH_CHUNKS * STEPS} steps)")
+    t0 = time.time()
+    for _ in range(BENCH_CHUNKS):
+        state, loss = chunk_d(state, tokens)
+    final_loss = float(loss)
+    dt = time.time() - t0
+    ntok = BATCH * (SEQ - 1) * STEPS * BENCH_CHUNKS
+    tps = ntok / dt
+    mfu = tps * 6 * n_params / PEAK_FLOPS
+    bar = bench.TARGET_MFU * PEAK_FLOPS / (6 * n_params)
+    result = {
+        "metric": "flagship_train_throughput",
+        "value": round(tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tps / bar, 4),
+        "mfu": round(mfu, 4),
+        "n_params": n_params,
+        "step_ms": round(1e3 * dt / (STEPS * BENCH_CHUNKS), 1),
+        "device": str(dev),
+        "loss": round(final_loss, 4),
+    }
+    mark(f"RESULT {json.dumps(result)}")
+    out = os.path.join(os.path.dirname(LOG_PATH),
+                       f"runner_result_{TS}.json")
+    with open(out, "w") as f:
+        json.dump(result, f)
+    mark(f"wrote {out}; exiting cleanly")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

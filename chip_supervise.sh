@@ -1,0 +1,56 @@
+#!/bin/bash
+# Chip session supervisor (round 3, no-kill edition).
+#
+# Facts this encodes (docs/OPS.md "The chip"):
+#   - a held claim makes backend init either BLOCK or RAISE
+#     "UNAVAILABLE: TPU backend setup/compile error" after ~15-25 min;
+#   - killing a client that holds the claim wedges it for hours, so
+#     NOTHING here uses timeout(1) or signals anything;
+#   - a client that exits on its own (clean error) is safe to replace.
+#
+# Loop: run chip_runner.py in the foreground, unkilled. If it blocks,
+# we block with it (that is the claim wait). If it exits without a
+# result (UNAVAILABLE), sleep and relaunch. When a fresh
+# runner_result_*.json appears and the queue deadline hasn't passed,
+# run chip_queue.sh for the rest of the on-chip agenda.
+#
+# Usage: nohup ./chip_supervise.sh [queue_not_after_epoch] &
+#   queue_not_after_epoch — latest time (date +%s) to START the
+#   multi-hour queue; the driver's end-of-round bench.py must find
+#   the chip free. Default: 5 h from launch.
+set -u
+cd "$(dirname "$0")"
+mkdir -p chip_logs
+NOT_AFTER=${1:-$(($(date +%s) + 18000))}
+START_MARK="chip_logs/.supervise_start_$$"
+touch "$START_MARK"
+LOG="chip_logs/supervise_$(date +%H%M%S).log"
+log() { echo "[supervise $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
+fresh_result() {
+    find chip_logs -maxdepth 1 -name 'runner_result_*.json' \
+        -newer "$START_MARK" | head -1
+}
+
+log "supervising; queue not-after $(date -d @"$NOT_AFTER" +%H:%M:%S)"
+ATTEMPT=0
+while :; do
+    ATTEMPT=$((ATTEMPT + 1))
+    log "runner attempt $ATTEMPT (foreground, unkilled)"
+    python chip_runner.py >>"chip_logs/runner_attempts.log" 2>&1
+    rc=$?
+    RESULT=$(fresh_result)
+    if [ -n "$RESULT" ]; then
+        log "runner attempt $ATTEMPT succeeded: $RESULT ($(cat "$RESULT"))"
+        break
+    fi
+    log "runner attempt $ATTEMPT exited rc=$rc without a result; retry in 180s"
+    sleep 180
+done
+rm -f "$START_MARK"
+if [ "$(date +%s)" -ge "$NOT_AFTER" ]; then
+    log "past queue deadline: leaving the chip free for the driver's end-of-round bench"
+    exit 0
+fi
+log "starting chip_queue.sh"
+./chip_queue.sh
+log "queue done"
