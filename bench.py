@@ -36,6 +36,7 @@ BENCH_CHUNKS = 3
 STEPS_PER_CHUNK = 10  # on-device lax.scan: one dispatch per chunk
 BATCH = 6
 SEQ = 1024
+MU_DTYPE_LABEL = "f32"  # set from PBST_BENCH_MU_DTYPE in main()
 
 # Per-attempt wall budget for the child (first TPU compile ~20-40 s plus
 # tunnel init; generous but finite).  Overridable for slow days.
@@ -87,7 +88,21 @@ def main() -> None:
     params = init_params(cfg, key)
     jax.block_until_ready(params)
     _mark(f"params initialized ({n_params / 1e6:.0f}M)")
-    init_opt, train_step = make_train_step(cfg, learning_rate=3e-4)
+    # Optional reduced-precision Adam moments (2.8 GB of HBM back at
+    # the flagship shape — models.default_optimizer): lets the driver
+    # invocation pick up a sweep-validated win without a code change.
+    mu_env = os.environ.get("PBST_BENCH_MU_DTYPE", "").strip().lower()
+    if mu_env in ("bf16", "bfloat16"):
+        mu_dtype = jnp.bfloat16
+    elif mu_env in ("", "f32", "fp32", "float32"):
+        mu_dtype = None
+    else:
+        raise ValueError(f"PBST_BENCH_MU_DTYPE={mu_env!r} unknown; "
+                         "expected bf16/bfloat16 or f32/fp32/float32")
+    global MU_DTYPE_LABEL
+    MU_DTYPE_LABEL = "bf16" if mu_dtype is not None else "f32"
+    init_opt, train_step = make_train_step(cfg, learning_rate=3e-4,
+                                           mu_dtype=mu_dtype)
     state = (params, jax.jit(init_opt)(params), 0)
 
     tokens = jax.random.randint(key, (BATCH, SEQ), 0, cfg.vocab, jnp.int32)
@@ -142,6 +157,7 @@ def main() -> None:
                 "step_ms": round(1e3 * dt / BENCH_STEPS, 1),
                 "device": str(jax.devices()[0]),
                 "loss": round(final_loss, 4),
+                "mu_dtype": MU_DTYPE_LABEL,
             }
         )
     )

@@ -31,7 +31,7 @@ STEPS = 8  # per timed chunk (one dispatch)
 
 
 def run_point(cfg_base, remat_name, remat, policy, batch, attn,
-              warm_chunks=1, timed_chunks=2):
+              warm_chunks=1, timed_chunks=2, mu_dtype=None):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -43,7 +43,8 @@ def run_point(cfg_base, remat_name, remat, policy, batch, attn,
     n_params = cfg.num_params()
     key = jax.random.PRNGKey(0)
     params = init_params(cfg, key)
-    init_opt, train_step = make_train_step(cfg, learning_rate=3e-4)
+    init_opt, train_step = make_train_step(cfg, learning_rate=3e-4,
+                                           mu_dtype=mu_dtype)
     state = (params, jax.jit(init_opt)(params), 0)
     tokens = jax.random.randint(key, (batch, SEQ), 0, cfg.vocab, jnp.int32)
 
@@ -108,6 +109,23 @@ def main() -> int:
         # of loss-tail activation unlocks the batch-8 points that
         # failed to compile in r02.
         cfg_base = dataclasses.replace(cfg_base, loss_chunks=int(lc_env))
+    mu_env = os.environ.get("PBST_SWEEP_MU_DTYPE")
+    mu_dtype = None
+    if mu_env:
+        import jax.numpy as jnp
+
+        # Reduced-precision Adam moments (models.default_optimizer):
+        # frees 2.8 GB of optimizer HBM at the flagship shape — the
+        # second batch-8 unlock hypothesis next to chunked CE.
+        table = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+                 "f32": None, "fp32": None, "float32": None}
+        mu_env = mu_env.strip().lower()
+        if mu_env not in table:
+            print(json.dumps({"error": f"PBST_SWEEP_MU_DTYPE={mu_env!r} "
+                              f"unknown; expected one of {sorted(table)}"}),
+                  flush=True)
+            return 1
+        mu_dtype = table[mu_env]
     attn_env = os.environ.get("PBST_SWEEP_ATTN")
     if attn_env:
         ATTN = attn_env.split(",")
@@ -123,9 +141,12 @@ def main() -> int:
         if attn == "pallas" and tiny:
             continue  # interpreter-mode pallas is too slow to smoke
         try:
-            r = run_point(cfg_base, rname, remat, policy, batch, attn)
+            r = run_point(cfg_base, rname, remat, policy, batch, attn,
+                          mu_dtype=mu_dtype)
             if cfg_base.loss_chunks > 1:
                 r["loss_chunks"] = cfg_base.loss_chunks
+            if mu_dtype is not None:
+                r["mu_dtype"] = mu_env
         except Exception as e:  # noqa: BLE001 — a failing point (OOM,
             r = {"remat": rname, "batch": batch, "attn": attn,  # eg)
                  "error": f"{type(e).__name__}: {str(e)[:120]}"}

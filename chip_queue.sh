@@ -50,6 +50,12 @@ PBST_SWEEP_LOSS_CHUNKS=8 PBST_SWEEP_ATTN=xla python bench_sweep.py \
     >"chip_logs/sweep_lc8_$TS.jsonl" 2>"chip_logs/sweep_lc8_$TS.err"
 log "lc8 sweep rc=$? ($(tail -2 chip_logs/sweep_lc8_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
 
+log "stage 4d: bf16-moment sweep (2.8 GB of optimizer HBM back; second batch-8 unlock lever)"
+PBST_SWEEP_MU_DTYPE=bf16 PBST_SWEEP_LOSS_CHUNKS=8 PBST_SWEEP_ATTN=xla \
+    python bench_sweep.py \
+    >"chip_logs/sweep_mu16_$TS.jsonl" 2>"chip_logs/sweep_mu16_$TS.err"
+log "mu16 sweep rc=$? ($(tail -2 chip_logs/sweep_mu16_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
+
 log "stage 5: long-context flash-vs-xla (S=4096/8192)"
 python bench_longctx.py \
     >"chip_logs/longctx_$TS.jsonl" 2>"chip_logs/longctx_$TS.err"

@@ -338,11 +338,55 @@ def chunked_head_xent(cfg: TransformerConfig, x: jax.Array,
     return total / jnp.sum(weights)
 
 
-def default_optimizer(learning_rate: float):
-    """The framework-standard AdamW recipe (shared by all train steps)."""
+def default_optimizer(learning_rate: float, mu_dtype: Any = None):
+    """The framework-standard AdamW recipe (shared by all train steps).
+
+    ``mu_dtype`` stores the first AND second Adam moments in a reduced
+    dtype (pass ``jnp.bfloat16``): optimizer state drops from 2x to 1x
+    the fp32 param bytes — at the flagship's ~700M that is 2.8 GB of
+    HBM back, the difference between fitting batch 8 and not. Update
+    math still runs in fp32 (optax upcasts per step); master params
+    stay fp32, so only the moment *storage* is rounded.
+    """
     import optax
 
-    return optax.adamw(learning_rate, b1=0.9, b2=0.95, weight_decay=0.1)
+    adam = optax.adamw(learning_rate, b1=0.9, b2=0.95, weight_decay=0.1,
+                       mu_dtype=mu_dtype)
+    if mu_dtype is None:
+        return adam
+    # optax's mu_dtype covers the first moment only; the second moment
+    # (nu) dominates dynamic range, so rather than truncating it too we
+    # round it through the same dtype at the chain boundary — a
+    # GradientTransformation that casts nu in/out around the update.
+    return _cast_nu(adam, mu_dtype)
+
+
+def _cast_nu(tx, dtype):
+    """Wrap ``tx`` (scale_by_adam-based) so the stored second moment is
+    kept in ``dtype`` between steps (fp32 inside the update)."""
+    import optax
+
+    def _map_nu(state, cast):
+        def walk(s):
+            if isinstance(s, optax.ScaleByAdamState):
+                return s._replace(nu=jax.tree.map(cast, s.nu))
+            if isinstance(s, tuple) and type(s) is not tuple:  # NamedTuple
+                return type(s)(*[walk(x) for x in s])
+            if isinstance(s, tuple):
+                return tuple(walk(x) for x in s)
+            return s
+        return walk(state)
+
+    def init(params):
+        st = tx.init(params)
+        return _map_nu(st, lambda x: x.astype(dtype))
+
+    def update(grads, state, params=None):
+        st32 = _map_nu(state, lambda x: x.astype(jnp.float32))
+        updates, new_state = tx.update(grads, st32, params)
+        return updates, _map_nu(new_state, lambda x: x.astype(dtype))
+
+    return optax.GradientTransformation(init, update)
 
 
 def next_token_loss(cfg: TransformerConfig, params: dict,
@@ -376,16 +420,17 @@ def next_token_loss(cfg: TransformerConfig, params: dict,
 
 def make_train_step(cfg: TransformerConfig, learning_rate: float = 3e-4,
                     constrain=lambda x: x, mesh=None,
-                    full_seq: bool = False):
+                    full_seq: bool = False, mu_dtype: Any = None):
     """Returns (init_opt_state, train_step). AdamW via optax; donate-safe.
 
     ``train_step(state, tokens) -> (state, metrics)`` where state is
     (params, opt_state, step). The metrics dict feeds the TpuBackend
     telemetry channel (tokens counted for throughput attribution).
+    ``mu_dtype`` reduces Adam moment storage (see default_optimizer).
     """
     import optax
 
-    tx = default_optimizer(learning_rate)
+    tx = default_optimizer(learning_rate, mu_dtype=mu_dtype)
 
     def init_opt_state(params):
         return tx.init(params)
