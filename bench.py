@@ -36,7 +36,6 @@ BENCH_CHUNKS = 3
 STEPS_PER_CHUNK = 10  # on-device lax.scan: one dispatch per chunk
 BATCH = 6
 SEQ = 1024
-MU_DTYPE_LABEL = "f32"  # set from PBST_BENCH_MU_DTYPE in main()
 
 # Per-attempt wall budget for the child (first TPU compile ~20-40 s plus
 # tunnel init; generous but finite).  Overridable for slow days.
@@ -56,6 +55,14 @@ _T0 = time.perf_counter()
 
 
 def main() -> None:
+    # Validate knobs BEFORE the backend: a typo must fail in
+    # milliseconds, not after 20-40 s of TPU init/compile. (This may
+    # import jax the *module*; backend init only happens at the first
+    # device touch, after the cache setup below.)
+    from bench_common import parse_mu_dtype
+
+    mu_dtype, mu_label = parse_mu_dtype(
+        os.environ.get("PBST_BENCH_MU_DTYPE"))
     _mark("importing jax")
     import jax
     import jax.numpy as jnp
@@ -91,16 +98,6 @@ def main() -> None:
     # Optional reduced-precision Adam moments (2.8 GB of HBM back at
     # the flagship shape — models.default_optimizer): lets the driver
     # invocation pick up a sweep-validated win without a code change.
-    mu_env = os.environ.get("PBST_BENCH_MU_DTYPE", "").strip().lower()
-    if mu_env in ("bf16", "bfloat16"):
-        mu_dtype = jnp.bfloat16
-    elif mu_env in ("", "f32", "fp32", "float32"):
-        mu_dtype = None
-    else:
-        raise ValueError(f"PBST_BENCH_MU_DTYPE={mu_env!r} unknown; "
-                         "expected bf16/bfloat16 or f32/fp32/float32")
-    global MU_DTYPE_LABEL
-    MU_DTYPE_LABEL = "bf16" if mu_dtype is not None else "f32"
     init_opt, train_step = make_train_step(cfg, learning_rate=3e-4,
                                            mu_dtype=mu_dtype)
     state = (params, jax.jit(init_opt)(params), 0)
@@ -157,7 +154,7 @@ def main() -> None:
                 "step_ms": round(1e3 * dt / BENCH_STEPS, 1),
                 "device": str(jax.devices()[0]),
                 "loss": round(final_loss, 4),
-                "mu_dtype": MU_DTYPE_LABEL,
+                "mu_dtype": mu_label,
             }
         )
     )

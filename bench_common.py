@@ -16,6 +16,24 @@ import os
 from pbs_tpu.telemetry.source import DEFAULT_PEAK_FLOPS as PEAK_FLOPS  # noqa: E402,F401
 
 
+def parse_mu_dtype(raw: str | None):
+    """One parser for the PBST_*_MU_DTYPE knobs -> (mu_dtype, label).
+
+    Accepts bf16/bfloat16 and f32/fp32/float32 (or empty/None for the
+    default); raises ValueError on anything else so a typo fails in
+    milliseconds, before any backend touch. Import of jax.numpy is
+    deferred so calling this costs nothing pre-init."""
+    key = (raw or "").strip().lower()
+    if key in ("bf16", "bfloat16"):
+        import jax.numpy as jnp
+
+        return jnp.bfloat16, "bf16"
+    if key in ("", "f32", "fp32", "float32"):
+        return None, "f32"
+    raise ValueError(f"mu_dtype {raw!r} unknown; expected bf16/bfloat16 "
+                     "or f32/fp32/float32")
+
+
 def setup_compilation_cache(log=None) -> None:
     """Point JAX at the repo-local persistent compile cache
     (best-effort: a backend that cannot serialize executables just

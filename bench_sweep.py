@@ -109,23 +109,19 @@ def main() -> int:
         # of loss-tail activation unlocks the batch-8 points that
         # failed to compile in r02.
         cfg_base = dataclasses.replace(cfg_base, loss_chunks=int(lc_env))
-    mu_env = os.environ.get("PBST_SWEEP_MU_DTYPE")
-    mu_dtype = None
-    if mu_env:
-        import jax.numpy as jnp
+    # Reduced-precision Adam moments (models.default_optimizer):
+    # frees 2.8 GB of optimizer HBM at the flagship shape — the
+    # second batch-8 unlock hypothesis next to chunked CE. One parser
+    # shared with bench.py (bench_common) so labels never diverge.
+    from bench_common import parse_mu_dtype
 
-        # Reduced-precision Adam moments (models.default_optimizer):
-        # frees 2.8 GB of optimizer HBM at the flagship shape — the
-        # second batch-8 unlock hypothesis next to chunked CE.
-        table = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
-                 "f32": None, "fp32": None, "float32": None}
-        mu_env = mu_env.strip().lower()
-        if mu_env not in table:
-            print(json.dumps({"error": f"PBST_SWEEP_MU_DTYPE={mu_env!r} "
-                              f"unknown; expected one of {sorted(table)}"}),
-                  flush=True)
-            return 1
-        mu_dtype = table[mu_env]
+    try:
+        mu_dtype, mu_label = parse_mu_dtype(
+            os.environ.get("PBST_SWEEP_MU_DTYPE"))
+    except ValueError as e:
+        print(json.dumps({"error": f"PBST_SWEEP_MU_DTYPE: {e}"}),
+              flush=True)
+        return 1
     attn_env = os.environ.get("PBST_SWEEP_ATTN")
     if attn_env:
         ATTN = attn_env.split(",")
@@ -146,7 +142,7 @@ def main() -> int:
             if cfg_base.loss_chunks > 1:
                 r["loss_chunks"] = cfg_base.loss_chunks
             if mu_dtype is not None:
-                r["mu_dtype"] = mu_env
+                r["mu_dtype"] = mu_label
         except Exception as e:  # noqa: BLE001 — a failing point (OOM,
             r = {"remat": rname, "batch": batch, "attn": attn,  # eg)
                  "error": f"{type(e).__name__}: {str(e)[:120]}"}
