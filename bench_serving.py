@@ -94,12 +94,18 @@ def main() -> int:
         "device": str(jax.devices()[0]),
     }), flush=True)
 
-    # Continuous batching engines, plain vs speculative: tokens/s and
-    # engine ticks for the same request mix. Self-draft gives the
-    # acceptance CEILING (the draft is free to be wrong in deployment;
-    # here the point is the engine overhead at high acceptance).
+    # Continuous batching engines, plain vs speculative, bf16 vs int8
+    # weights (the verdict's serving matrix): tokens/s, engine ticks,
+    # and the engine's own TTFT/completion percentiles for the same
+    # request mix. Self-draft gives the acceptance CEILING (the draft
+    # is free to be wrong in deployment; here the point is engine
+    # overhead at high acceptance). int8 target + fp draft is the
+    # deployment-shaped pair test_spec_serving pins for exactness.
     from pbs_tpu.models import ContinuousBatcher, SpeculativeBatcher
+    from pbs_tpu.models.quant import quantize_weights
 
+    qparams = quantize_weights(params)
+    jax.block_until_ready(qparams)
     n_slots = 2 if tiny else 8
     eng_new = 8 if tiny else 64
     bucket = 16 if tiny else 512
@@ -107,14 +113,22 @@ def main() -> int:
     prompts = [
         list(range(1, 1 + (3 + i % 5))) for i in range(2 * n_slots)
     ]
-    for name, eng in (
-        ("continuous", ContinuousBatcher(
+    engines = (
+        ("continuous_bf16", lambda: ContinuousBatcher(
             cfg, params, n_slots=n_slots, prompt_bucket=bucket,
             max_len=maxlen)),
-        ("spec_continuous", SpeculativeBatcher(
+        ("continuous_int8", lambda: ContinuousBatcher(
+            cfg, qparams, n_slots=n_slots, prompt_bucket=bucket,
+            max_len=maxlen)),
+        ("spec_continuous_bf16", lambda: SpeculativeBatcher(
             cfg, params, cfg, params, k=4, n_slots=n_slots,
             prompt_bucket=bucket, max_len=maxlen)),
-    ):
+        ("spec_continuous_int8", lambda: SpeculativeBatcher(
+            cfg, qparams, cfg, params, k=4, n_slots=n_slots,
+            prompt_bucket=bucket, max_len=maxlen)),
+    )
+    for name, make_eng in engines:
+        eng = make_eng()
         for p in prompts:
             eng.submit(p, max_new_tokens=eng_new)
         t0 = time.perf_counter()
@@ -128,6 +142,9 @@ def main() -> int:
             "unit": "tokens/s",
             "ticks": st["steps"],
             "requests": st["completed"],
+            "ttft_p50_s": st["ttft_p50_s"],
+            "ttft_p99_s": st["ttft_p99_s"],
+            "latency_p99_s": st["latency_p99_s"],
         }
         if "spec_acceptance" in st:
             row["acceptance"] = st["spec_acceptance"]
