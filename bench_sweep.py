@@ -101,7 +101,7 @@ def main() -> int:
     if tiny:
         SEQ, STEPS, BATCHES = 128, 2, [2]
     # Env-restricted grids for follow-up runs (e.g. the pallas column
-    # alone after a kernel fix, chip_queue.sh stage 3).
+    # alone after a kernel fix, chip_queue.sh stages 4/4c/4d/4e).
     lc_env = os.environ.get("PBST_SWEEP_LOSS_CHUNKS")
     if lc_env:
         # Chunked cross-entropy: the (B, S, vocab) fp32 logits tensor

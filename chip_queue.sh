@@ -56,6 +56,12 @@ PBST_SWEEP_MU_DTYPE=bf16 PBST_SWEEP_LOSS_CHUNKS=8 PBST_SWEEP_ATTN=xla \
     >"chip_logs/sweep_mu16_$TS.jsonl" 2>"chip_logs/sweep_mu16_$TS.err"
 log "mu16 sweep rc=$? ($(tail -2 chip_logs/sweep_mu16_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
 
+log "stage 4e: all three HBM levers composed (flash + chunked CE + bf16 moments: the remat-none bid)"
+PBST_SWEEP_MU_DTYPE=bf16 PBST_SWEEP_LOSS_CHUNKS=8 PBST_SWEEP_ATTN=pallas \
+    python bench_sweep.py \
+    >"chip_logs/sweep_all_$TS.jsonl" 2>"chip_logs/sweep_all_$TS.err"
+log "composed sweep rc=$? ($(tail -2 chip_logs/sweep_all_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
+
 log "stage 5: long-context flash-vs-xla (S=4096/8192)"
 python bench_longctx.py \
     >"chip_logs/longctx_$TS.jsonl" 2>"chip_logs/longctx_$TS.err"
