@@ -12,6 +12,8 @@ or killed — if it blocks, leave it alone and read its log.
 Stages (each logged with wall-time deltas):
   1. backend init + tiny matmul (claim acquisition marker)
   2. flagship params/opt init + HBM stats
+  2.5 mid-size (~160M) bisection probe, per-step synced; failure is
+     marked and SKIPPED (the flagship run still happens)
   3. bare donated train_step x5 — per-step time (a stall here is
      execution, not compile; donation is mandatory at this size:
      2x the 8.4 GB fp32 state would breach the 16 GB HBM)
@@ -73,6 +75,38 @@ def main() -> None:
 
     from pbs_tpu.models import init_params, make_train_step
     from __graft_entry__ import _flagship_cfg
+
+    # Stage 2.5: mid-size bisection probe. The 01:03 stall was in
+    # EXECUTION of the flagship program (compile had already cached);
+    # if this ~124M model runs and the 700M stalls, the failure is
+    # size/transfer-related; if this stalls too, it is systemic.
+    import dataclasses
+
+    mid_cfg = dataclasses.replace(
+        _flagship_cfg(), d_model=1024, n_layers=8, n_heads=8,
+        n_kv_heads=4, d_ff=2816)
+    mark(f"stage 2.5: mid-size probe ({mid_cfg.num_params()/1e6:.0f}M)")
+    try:
+        mid_params = init_params(mid_cfg, jax.random.PRNGKey(1))
+        jax.block_until_ready(mid_params)
+        mid_init, mid_step = make_train_step(mid_cfg, learning_rate=3e-4)
+        mid_state = (mid_params, jax.jit(mid_init)(mid_params), 0)
+        mid_toks = jax.random.randint(jax.random.PRNGKey(2), (4, 512), 0,
+                                      mid_cfg.vocab, jnp.int32)
+        jmid = jax.jit(mid_step, donate_argnums=(0,))
+        mid_state, mm = jmid(mid_state, mid_toks)
+        mark(f"  mid first step ok (compile+run), "
+             f"loss={float(mm['loss']):.4f}")
+        for i in range(3):
+            t = time.time()
+            mid_state, mm = jmid(mid_state, mid_toks)
+            float(mm["loss"])  # per-step sync: a stall names its step
+            mark(f"  mid step {i}: {time.time()-t:6.3f}s")
+        mark(f"  mid probe done; hbm={hbm(dev)}")
+        del mid_state, mid_params, mm, jmid
+    except Exception as e:  # noqa: BLE001 — probe-only: flagship still runs
+        mark(f"  stage 2.5 FAILED ({type(e).__name__}: {e}) — "
+             "continuing to the flagship anyway")
 
     cfg = _flagship_cfg()
     n_params = cfg.num_params()

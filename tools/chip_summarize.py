@@ -1,0 +1,107 @@
+"""Summarize chip_logs/ artifacts into PERF.md-ready markdown.
+
+Reads every bench/sweep/serving/longctx/decompose artifact in
+chip_logs/ (newest first per family), prints one markdown section per
+family. Purely offline — never touches JAX or the chip — so it is
+safe to run at any time, including while a chip client is live.
+
+    python tools/chip_summarize.py [chip_logs_dir]
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+
+def _read_jsonl(path: str) -> list[dict]:
+    rows = []
+    try:
+        with open(path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if ln.startswith("{"):
+                    try:
+                        rows.append(json.loads(ln))
+                    except json.JSONDecodeError:
+                        pass
+    except OSError:
+        pass
+    return rows
+
+
+def _newest(pattern: str) -> list[str]:
+    return sorted(glob.glob(pattern), key=os.path.getmtime, reverse=True)
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:,.1f}" if abs(v) >= 100 else f"{v:.4g}"
+    if isinstance(v, int) and abs(v) >= 10_000:
+        return f"{v:,}"
+    return str(v)
+
+
+def _table(rows: list[dict], cols: list[str]) -> str:
+    out = ["| " + " | ".join(cols) + " |",
+           "|" + "---|" * len(cols)]
+    for r in rows:
+        out.append("| " + " | ".join(
+            _fmt(r.get(c, "—")) for c in cols) + " |")
+    return "\n".join(out)
+
+
+def main() -> int:
+    d = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "chip_logs")
+
+    for name, pattern in (("headline bench", "bench_*.json"),
+                          ("runner result", "runner_result_*.json"),
+                          ("final bench", "bench_final_*.json")):
+        for path in _newest(os.path.join(d, pattern))[:2]:
+            rows = _read_jsonl(path)
+            if rows:
+                print(f"### {name} — {os.path.basename(path)}\n")
+                print("```json\n" + json.dumps(rows[-1]) + "\n```\n")
+
+    for fam, pattern, cols in (
+        ("sweep (pallas)", "sweep_pallas_*.jsonl",
+         ["remat", "batch", "attn", "tokens_per_s", "mfu", "step_ms",
+          "error"]),
+        ("sweep (chunked CE)", "sweep_lc8_*.jsonl",
+         ["remat", "batch", "attn", "loss_chunks", "tokens_per_s",
+          "mfu", "step_ms", "error"]),
+        ("sweep (bf16 moments)", "sweep_mu16_*.jsonl",
+         ["remat", "batch", "attn", "mu_dtype", "tokens_per_s", "mfu",
+          "step_ms", "error"]),
+        ("sweep (all levers)", "sweep_all_*.jsonl",
+         ["remat", "batch", "attn", "mu_dtype", "loss_chunks",
+          "tokens_per_s", "mfu", "step_ms", "error"]),
+        ("long context", "longctx_*.jsonl",
+         ["seq", "batch", "attn", "tokens_per_s", "mfu_dense",
+          "mfu_incl_attn", "step_ms", "pallas_speedup", "error"]),
+        ("serving", "serving_*.json*",
+         ["metric", "value", "unit", "ttft_p50_s", "ttft_p99_s",
+          "acceptance", "error"]),
+        ("decompose", "decompose_*.jsonl",
+         ["step_ms_scan", "dispatch_overhead_ms", "mfu_6N",
+          "compute_frac", "stall_frac", "collective_frac"]),
+    ):
+        paths = _newest(os.path.join(d, pattern))
+        if not paths:
+            continue
+        rows = _read_jsonl(paths[0])
+        rows = [r for r in rows if "best" not in r]
+        if not rows:
+            continue
+        used = [c for c in cols if any(c in r for r in rows)]
+        print(f"### {fam} — {os.path.basename(paths[0])}\n")
+        print(_table(rows, used) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
