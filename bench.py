@@ -119,23 +119,54 @@ def main() -> None:
     chunk = jax.jit(run_chunk, donate_argnums=(0,))
     _mark("compiling train chunk")
 
-    for i in range(WARMUP_CHUNKS):
+    state, loss = chunk(state, tokens)
+    float(loss)  # host fetch: hard sync per chunk so a stalled
+    _mark("warmup chunk 0 done")  # execution is attributable
+    # Degraded-protocol fallback: if chunks run so slowly that the
+    # remaining warmup+timed chunks would overrun the supervisor's
+    # deadline (leaving a red artifact despite working hardware),
+    # shrink the protocol and say so in the result. A slow green
+    # number beats a timeout error. The post-compile chunk below is
+    # both the second warmup AND the timing probe; in the worst tier
+    # it IS the measurement.
+    n_warm = max(0, WARMUP_CHUNKS - 2)  # chunk 0 + probe already run
+    n_bench = BENCH_CHUNKS
+    t_probe = time.perf_counter()
+    state, loss = chunk(state, tokens)  # first post-compile chunk
+    probe_loss = float(loss)
+    chunk_s = time.perf_counter() - t_probe
+    _mark(f"warmup chunk 1 done ({chunk_s:.1f}s/chunk)")
+    degraded = False
+    budget = 0.7 * ATTEMPT_TIMEOUT_S
+    elapsed = time.perf_counter() - _T0
+    if elapsed + chunk_s > budget:
+        # Even ONE more chunk would overrun: the probe chunk itself is
+        # the measurement (post-compile, hard-synced — a valid if
+        # noisy sample).
+        degraded, n_warm, n_bench = True, 0, 0
+        dt, final_loss = chunk_s, probe_loss
+        _mark("degraded protocol: probe chunk is the measurement")
+    elif elapsed + chunk_s * (n_warm + n_bench) > budget:
+        degraded, n_warm, n_bench = True, 0, 1
+        _mark(f"degraded protocol: {chunk_s:.1f}s/chunk would overrun "
+              f"the {ATTEMPT_TIMEOUT_S:.0f}s deadline; timing 1 chunk")
+    for i in range(n_warm):
         state, loss = chunk(state, tokens)
-        float(loss)  # host fetch: hard sync per chunk so a stalled
-        _mark(f"warmup chunk {i} done")  # execution is attributable
-    _mark("warmup done; timing")
+        float(loss)
+        _mark(f"warmup chunk {i + 2} done")
+    if n_bench:
+        _mark("warmup done; timing")
+        t0 = time.perf_counter()
+        for _ in range(n_bench):
+            state, loss = chunk(state, tokens)
+        # Sync via host fetch of the last step's loss rather than
+        # block_until_ready: a device-to-host read cannot complete
+        # until the whole dependency chain has executed, independent
+        # of any platform quirk in readiness signaling.
+        final_loss = float(loss)
+        dt = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    for _ in range(BENCH_CHUNKS):
-        state, loss = chunk(state, tokens)
-    # Sync via host fetch of the last step's loss rather than
-    # block_until_ready: a device-to-host read cannot complete until the
-    # whole dependency chain has executed, independent of any platform
-    # quirk in readiness signaling.
-    final_loss = float(loss)
-    dt = time.perf_counter() - t0
-
-    BENCH_STEPS = BENCH_CHUNKS * STEPS_PER_CHUNK
+    BENCH_STEPS = max(n_bench, 1) * STEPS_PER_CHUNK
     ntok = BATCH * (SEQ - 1) * BENCH_STEPS
     tokens_per_s = ntok / dt
     flops_per_token = 6 * n_params
@@ -155,6 +186,8 @@ def main() -> None:
                 "device": str(jax.devices()[0]),
                 "loss": round(final_loss, 4),
                 "mu_dtype": mu_label,
+                **({"degraded_protocol": True,
+                    "bench_chunks": n_bench} if degraded else {}),
             }
         )
     )
