@@ -18,7 +18,19 @@ cd "$(dirname "$0")"
 mkdir -p chip_logs
 TS=$(date +%H%M%S)
 log() { echo "[chip_queue $(date +%H:%M:%S)] $*" | tee -a "chip_logs/queue_$TS.log"; }
+# Inter-stage gap: a client that connects the instant its predecessor
+# exits can race the lease release and end up waiting forever (r03
+# session 3: a 13 s gap handed the claim over cleanly, a 0 s gap left
+# the next client parked in its retry loop for >40 min). Give the
+# lease time to settle between every pair of chip clients.
+GAP=${PBST_QUEUE_GAP_S:-45}
+gap() { log "inter-client gap ${GAP}s"; sleep "$GAP"; }
 
+# Leading gap: the queue itself is usually launched right after a
+# previous client (chip_supervise.sh's runner) exited — same race.
+gap
+
+if [ "${PBST_QUEUE_SKIP_BENCH:-}" != "1" ]; then
 log "stage 1: headline bench (self-supervised, orphan-on-deadline)"
 python bench.py >"chip_logs/bench_$TS.json" 2>"chip_logs/bench_$TS.err"
 log "bench rc=$? ($(cat chip_logs/bench_$TS.json 2>/dev/null))"
@@ -29,48 +41,58 @@ if grep -q "worker left running" "chip_logs/bench_$TS.json" 2>/dev/null; then
     log "stage 1 orphaned its worker — aborting the queue; wait for the orphan to exit before any further chip work"
     exit 1
 fi
+gap
+fi
 
 log "stage 2: on-chip kernel validation (tpu_tests)"
 PBST_TPU_TESTS=1 python -m pytest tpu_tests/ -q \
     >"chip_logs/tpu_tests_$TS.log" 2>&1
 log "tpu_tests rc=$? (tail: $(tail -1 chip_logs/tpu_tests_$TS.log))"
+gap
 
 log "stage 3: serving benchmark"
 python bench_serving.py \
     >"chip_logs/serving_$TS.json" 2>"chip_logs/serving_$TS.err"
 log "bench_serving rc=$? ($(cat chip_logs/serving_$TS.json 2>/dev/null | tr '\n' ' '))"
+gap
 
 log "stage 4: pallas sweep (incl. batch-8 / remat-none MFU push points)"
 PBST_SWEEP_ATTN=pallas python bench_sweep.py \
     >"chip_logs/sweep_pallas_$TS.jsonl" 2>"chip_logs/sweep_pallas_$TS.err"
 log "sweep rc=$? ($(tail -2 chip_logs/sweep_pallas_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
+gap
 
 log "stage 4c: chunked-CE sweep (does loss_chunks=8 unlock batch 8?)"
 PBST_SWEEP_LOSS_CHUNKS=8 PBST_SWEEP_ATTN=xla python bench_sweep.py \
     >"chip_logs/sweep_lc8_$TS.jsonl" 2>"chip_logs/sweep_lc8_$TS.err"
 log "lc8 sweep rc=$? ($(tail -2 chip_logs/sweep_lc8_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
+gap
 
 log "stage 4d: bf16-moment sweep (2.8 GB of optimizer HBM back; second batch-8 unlock lever)"
 PBST_SWEEP_MU_DTYPE=bf16 PBST_SWEEP_LOSS_CHUNKS=8 PBST_SWEEP_ATTN=xla \
     python bench_sweep.py \
     >"chip_logs/sweep_mu16_$TS.jsonl" 2>"chip_logs/sweep_mu16_$TS.err"
 log "mu16 sweep rc=$? ($(tail -2 chip_logs/sweep_mu16_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
+gap
 
 log "stage 4e: all three HBM levers composed (flash + chunked CE + bf16 moments: the remat-none bid)"
 PBST_SWEEP_MU_DTYPE=bf16 PBST_SWEEP_LOSS_CHUNKS=8 PBST_SWEEP_ATTN=pallas \
     python bench_sweep.py \
     >"chip_logs/sweep_all_$TS.jsonl" 2>"chip_logs/sweep_all_$TS.err"
 log "composed sweep rc=$? ($(tail -2 chip_logs/sweep_all_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
+gap
 
 log "stage 5: long-context flash-vs-xla (S=4096/8192)"
 python bench_longctx.py \
     >"chip_logs/longctx_$TS.jsonl" 2>"chip_logs/longctx_$TS.err"
 log "longctx rc=$? ($(tail -3 chip_logs/longctx_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
+gap
 
 log "stage 5b: roofline decomposition (MFU accounting)"
 python bench_decompose.py \
     >"chip_logs/decompose_$TS.jsonl" 2>"chip_logs/decompose_$TS.err"
 log "decompose rc=$? ($(tail -1 chip_logs/decompose_$TS.jsonl 2>/dev/null))"
+gap
 
 log "stage 6: headline bench re-run (warm cache, final number)"
 python bench.py \
