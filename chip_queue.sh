@@ -23,14 +23,31 @@ log() { echo "[chip_queue $(date +%H:%M:%S)] $*" | tee -a "chip_logs/queue_$TS.l
 # session 3: a 13 s gap handed the claim over cleanly, a 0 s gap left
 # the next client parked in its retry loop for >40 min). Give the
 # lease time to settle between every pair of chip clients.
+# Hard per-stage deadline: no NEW chip client starts after this epoch
+# (running stages are never signalled — the queue just stops advancing)
+# so the driver's end-of-round bench.py finds the chip free even when
+# the queue itself started late. Default: 4 h from queue start.
+DEADLINE=${PBST_QUEUE_DEADLINE:-$(($(date +%s) + 14400))}
+case "$DEADLINE" in
+    ''|*[!0-9]*)
+        echo "PBST_QUEUE_DEADLINE must be a unix epoch (date +%s), got: $DEADLINE" >&2
+        exit 2;;
+esac
+gate() {
+    if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+        log "deadline passed before $1 — stopping the queue (chip left free)"
+        exit 0
+    fi
+}
 GAP=${PBST_QUEUE_GAP_S:-45}
-gap() { log "inter-client gap ${GAP}s"; sleep "$GAP"; }
+gap() { gate "the next stage's gap"; log "inter-client gap ${GAP}s"; sleep "$GAP"; }
 
 # Leading gap: the queue itself is usually launched right after a
 # previous client (chip_supervise.sh's runner) exited — same race.
 gap
 
 if [ "${PBST_QUEUE_SKIP_BENCH:-}" != "1" ]; then
+gate "stage 1"
 log "stage 1: headline bench (self-supervised, orphan-on-deadline)"
 python bench.py >"chip_logs/bench_$TS.json" 2>"chip_logs/bench_$TS.err"
 log "bench rc=$? ($(cat chip_logs/bench_$TS.json 2>/dev/null))"
@@ -44,30 +61,35 @@ fi
 gap
 fi
 
+gate "stage 2"
 log "stage 2: on-chip kernel validation (tpu_tests)"
 PBST_TPU_TESTS=1 python -m pytest tpu_tests/ -q \
     >"chip_logs/tpu_tests_$TS.log" 2>&1
 log "tpu_tests rc=$? (tail: $(tail -1 chip_logs/tpu_tests_$TS.log))"
 gap
 
+gate "stage 3"
 log "stage 3: serving benchmark"
 python bench_serving.py \
     >"chip_logs/serving_$TS.json" 2>"chip_logs/serving_$TS.err"
 log "bench_serving rc=$? ($(cat chip_logs/serving_$TS.json 2>/dev/null | tr '\n' ' '))"
 gap
 
+gate "stage 4"
 log "stage 4: pallas sweep (incl. batch-8 / remat-none MFU push points)"
 PBST_SWEEP_ATTN=pallas python bench_sweep.py \
     >"chip_logs/sweep_pallas_$TS.jsonl" 2>"chip_logs/sweep_pallas_$TS.err"
 log "sweep rc=$? ($(tail -2 chip_logs/sweep_pallas_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
 gap
 
+gate "stage 4c"
 log "stage 4c: chunked-CE sweep (does loss_chunks=8 unlock batch 8?)"
 PBST_SWEEP_LOSS_CHUNKS=8 PBST_SWEEP_ATTN=xla python bench_sweep.py \
     >"chip_logs/sweep_lc8_$TS.jsonl" 2>"chip_logs/sweep_lc8_$TS.err"
 log "lc8 sweep rc=$? ($(tail -2 chip_logs/sweep_lc8_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
 gap
 
+gate "stage 4d"
 log "stage 4d: bf16-moment sweep (2.8 GB of optimizer HBM back; second batch-8 unlock lever)"
 PBST_SWEEP_MU_DTYPE=bf16 PBST_SWEEP_LOSS_CHUNKS=8 PBST_SWEEP_ATTN=xla \
     python bench_sweep.py \
@@ -75,6 +97,7 @@ PBST_SWEEP_MU_DTYPE=bf16 PBST_SWEEP_LOSS_CHUNKS=8 PBST_SWEEP_ATTN=xla \
 log "mu16 sweep rc=$? ($(tail -2 chip_logs/sweep_mu16_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
 gap
 
+gate "stage 4e"
 log "stage 4e: all three HBM levers composed (flash + chunked CE + bf16 moments: the remat-none bid)"
 PBST_SWEEP_MU_DTYPE=bf16 PBST_SWEEP_LOSS_CHUNKS=8 PBST_SWEEP_ATTN=pallas \
     python bench_sweep.py \
@@ -82,18 +105,21 @@ PBST_SWEEP_MU_DTYPE=bf16 PBST_SWEEP_LOSS_CHUNKS=8 PBST_SWEEP_ATTN=pallas \
 log "composed sweep rc=$? ($(tail -2 chip_logs/sweep_all_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
 gap
 
+gate "stage 5"
 log "stage 5: long-context flash-vs-xla (S=4096/8192)"
 python bench_longctx.py \
     >"chip_logs/longctx_$TS.jsonl" 2>"chip_logs/longctx_$TS.err"
 log "longctx rc=$? ($(tail -3 chip_logs/longctx_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
 gap
 
+gate "stage 5b"
 log "stage 5b: roofline decomposition (MFU accounting)"
 python bench_decompose.py \
     >"chip_logs/decompose_$TS.jsonl" 2>"chip_logs/decompose_$TS.err"
 log "decompose rc=$? ($(tail -1 chip_logs/decompose_$TS.jsonl 2>/dev/null))"
 gap
 
+gate "stage 6"
 log "stage 6: headline bench re-run (warm cache, final number)"
 python bench.py \
     >"chip_logs/bench_final_$TS.json" 2>"chip_logs/bench_final_$TS.err"
