@@ -122,6 +122,20 @@ def main() -> int:
         print(json.dumps({"error": f"PBST_SWEEP_MU_DTYPE: {e}"}),
               flush=True)
         return 1
+    batches_env = os.environ.get("PBST_SWEEP_BATCHES")
+    if batches_env:
+        # e.g. PBST_SWEEP_BATCHES=8,12,16 — probe beyond the default
+        # grid once the HBM levers (flash + chunked CE + bf16 moments)
+        # have freed enough headroom for larger batches.
+        try:
+            BATCHES = [int(b) for b in batches_env.split(",") if b.strip()]
+        except ValueError:
+            BATCHES = []
+        if not BATCHES:
+            print(json.dumps(
+                {"error": f"PBST_SWEEP_BATCHES must be ints: {batches_env}"}),
+                flush=True)
+            return 1
     attn_env = os.environ.get("PBST_SWEEP_ATTN")
     if attn_env:
         ATTN = attn_env.split(",")
