@@ -43,8 +43,14 @@ while :; do
         log "runner attempt $ATTEMPT succeeded: $RESULT ($(cat "$RESULT"))"
         break
     fi
-    log "runner attempt $ATTEMPT exited rc=$rc without a result; retry in 180s"
-    sleep 180
+    # Wide quiet window between attempts: the only times the claim has
+    # ever been observed to free are after LONG fully-quiet periods
+    # (overnight; a 1.5 h gap) — so when an attempt comes back
+    # UNAVAILABLE, give the lease a real quiet stretch rather than
+    # re-knocking every few minutes (the r02 watcher's tight cadence
+    # is what kept its wedge alive).
+    log "runner attempt $ATTEMPT exited rc=$rc without a result; retry in ${RETRY_QUIET_S:-1800}s"
+    sleep "${RETRY_QUIET_S:-1800}"
 done
 rm -f "$START_MARK"
 if [ "$(date +%s)" -ge "$NOT_AFTER" ]; then
