@@ -49,6 +49,11 @@ while :; do
     # UNAVAILABLE, give the lease a real quiet stretch rather than
     # re-knocking every few minutes (the r02 watcher's tight cadence
     # is what kept its wedge alive).
+    if [ "$(date +%s)" -ge "$NOT_AFTER" ]; then
+        log "past the queue deadline with no claim — stopping attempts (chip left free for the driver)"
+        rm -f "$START_MARK"
+        exit 0
+    fi
     log "runner attempt $ATTEMPT exited rc=$rc without a result; retry in ${RETRY_QUIET_S:-1800}s"
     sleep "${RETRY_QUIET_S:-1800}"
 done
