@@ -63,7 +63,9 @@ fi
 
 gate "stage 2"
 log "stage 2: on-chip kernel validation (tpu_tests)"
-PBST_TPU_TESTS=1 python -m pytest tpu_tests/ -q \
+# -v + unbuffered: each test lands in the log as it finishes, so a
+# parked or slow client shows WHICH test it is stuck in.
+PBST_TPU_TESTS=1 PYTHONUNBUFFERED=1 python -u -m pytest tpu_tests/ -v \
     >"chip_logs/tpu_tests_$TS.log" 2>&1
 log "tpu_tests rc=$? (tail: $(tail -1 chip_logs/tpu_tests_$TS.log))"
 gap
