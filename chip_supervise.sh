@@ -34,6 +34,11 @@ fresh_result() {
 log "supervising; queue not-after $(date -d @"$NOT_AFTER" +%H:%M:%S)"
 ATTEMPT=0
 while :; do
+    if [ "$(date +%s)" -ge "$NOT_AFTER" ]; then
+        log "past the queue deadline — no further claim attempts (chip left free for the driver)"
+        rm -f "$START_MARK"
+        exit 0
+    fi
     ATTEMPT=$((ATTEMPT + 1))
     log "runner attempt $ATTEMPT (foreground, unkilled)"
     python chip_runner.py >>"chip_logs/runner_attempts.log" 2>&1
