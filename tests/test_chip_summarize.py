@@ -1,0 +1,54 @@
+"""tools/chip_summarize.py: offline artifact summarizer.
+
+Purely file-based (never touches JAX or the chip), so it must render
+whatever artifact mix a chip session leaves behind — including error
+rows and interrupted runs with empty logs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "chip_summarize.py")
+
+
+def _run(d: str) -> str:
+    proc = subprocess.run([sys.executable, TOOL, d], capture_output=True,
+                          text=True, timeout=60, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+    return proc.stdout
+
+
+def test_renders_mixed_artifacts(tmp_path):
+    d = tmp_path / "chip_logs"
+    d.mkdir()
+    (d / "bench_120000.json").write_text(json.dumps({
+        "metric": "flagship_train_throughput", "value": 19911.1,
+        "unit": "tokens/s", "vs_baseline": 1.062, "mfu": 0.4248}) + "\n")
+    rows = [
+        {"remat": "dots", "batch": 6, "attn": "pallas",
+         "tokens_per_s": 20100.0, "mfu": 0.429, "step_ms": 305.0},
+        {"remat": "none", "batch": 8, "attn": "pallas",
+         "error": "XlaRuntimeError: RESOURCE_EXHAUSTED"},
+        {"best": {"remat": "dots", "batch": 6}},
+    ]
+    (d / "sweep_pallas_120100.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in rows))
+    (d / "tpu_tests_120050.log").write_text("")  # interrupted: empty
+
+    out = _run(str(d))
+    assert "headline bench" in out and "19911.1" in out
+    # Sweep table: data rows rendered, the trailing best-line excluded,
+    # error rows kept visible (an OOM point is a result, not noise).
+    assert "sweep (pallas)" in out
+    assert "| dots | 6 | pallas |" in out
+    assert "RESOURCE_EXHAUSTED" in out
+    assert '"best"' not in out
+
+
+def test_empty_dir_is_quiet(tmp_path):
+    assert _run(str(tmp_path)).strip() == ""
