@@ -107,6 +107,14 @@ PBST_SWEEP_MU_DTYPE=bf16 PBST_SWEEP_LOSS_CHUNKS=8 PBST_SWEEP_ATTN=pallas \
 log "composed sweep rc=$? ($(tail -2 chip_logs/sweep_all_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
 gap
 
+gate "stage 4f"
+log "stage 4f: beyond-grid batch probe (12/16 under all levers; error rows are answers)"
+PBST_SWEEP_MU_DTYPE=bf16 PBST_SWEEP_LOSS_CHUNKS=8 PBST_SWEEP_ATTN=pallas \
+    PBST_SWEEP_BATCHES=12,16 python bench_sweep.py \
+    >"chip_logs/sweep_bigbatch_$TS.jsonl" 2>"chip_logs/sweep_bigbatch_$TS.err"
+log "bigbatch sweep rc=$? ($(tail -2 chip_logs/sweep_bigbatch_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
+gap
+
 gate "stage 5"
 log "stage 5: long-context flash-vs-xla (S=4096/8192)"
 python bench_longctx.py \
