@@ -80,6 +80,9 @@ def main() -> int:
         ("sweep (all levers)", "sweep_all_*.jsonl",
          ["remat", "batch", "attn", "mu_dtype", "loss_chunks",
           "tokens_per_s", "mfu", "step_ms", "error"]),
+        ("sweep (batch 12/16 probe)", "sweep_bigbatch_*.jsonl",
+         ["remat", "batch", "attn", "mu_dtype", "loss_chunks",
+          "tokens_per_s", "mfu", "step_ms", "error"]),
         ("long context", "longctx_*.jsonl",
          ["seq", "batch", "attn", "tokens_per_s", "mfu_dense",
           "mfu_incl_attn", "step_ms", "pallas_speedup", "error"]),
