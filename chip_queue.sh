@@ -39,8 +39,40 @@ gate() {
         exit 0
     fi
 }
+DRYRUN=${PBST_QUEUE_DRYRUN:-}
 GAP=${PBST_QUEUE_GAP_S:-45}
-gap() { gate "the next stage's gap"; log "inter-client gap ${GAP}s"; sleep "$GAP"; }
+gap() {
+    gate "the next stage's gap"
+    if [ "$DRYRUN" = "1" ]; then return 0; fi  # no lease to settle
+    log "inter-client gap ${GAP}s"
+    sleep "$GAP"
+}
+# PBST_QUEUE_DRYRUN=1: echo each stage command (with its PBST_* env
+# levers, read from the real child environment) instead of running it
+# (tests/test_chip_queue.py exercises the gate/skip/gap logic without
+# a chip; also useful to preview an agenda before spending the claim).
+# Dry runs work in a scratch dir so their per-stage redirections can
+# never shadow real artifacts in chip_logs/ (chip_summarize picks
+# newest-first). Override the scratch location with
+# PBST_QUEUE_DRYRUN_DIR.
+if [ "$DRYRUN" = "1" ]; then
+    DRYDIR=${PBST_QUEUE_DRYRUN_DIR:-$(mktemp -d /tmp/pbst_queue_dry.XXXXXX)}
+    echo "[chip_queue] DRYRUN artifacts under $DRYDIR" >&2
+    cd "$DRYDIR"
+    mkdir -p chip_logs
+fi
+run() {
+    if [ "$DRYRUN" = "1" ]; then
+        local levers
+        levers=$(env | grep -E '^PBST_(SWEEP|TPU|BENCH)_' | sort | tr '\n' ' ')
+        # Straight to the queue log: stdout/stderr are redirected into
+        # the stage's artifact file here, which must stay empty.
+        echo "[chip_queue $(date +%H:%M:%S)] DRYRUN: ${levers}$*" \
+            >> "chip_logs/queue_$TS.log"
+        return 0
+    fi
+    "$@"
+}
 
 # Leading gap: the queue itself is usually launched right after a
 # previous client (chip_supervise.sh's runner) exited — same race.
@@ -49,7 +81,7 @@ gap
 if [ "${PBST_QUEUE_SKIP_BENCH:-}" != "1" ]; then
 gate "stage 1"
 log "stage 1: headline bench (self-supervised, orphan-on-deadline)"
-python bench.py >"chip_logs/bench_$TS.json" 2>"chip_logs/bench_$TS.err"
+run python bench.py >"chip_logs/bench_$TS.json" 2>"chip_logs/bench_$TS.err"
 log "bench rc=$? ($(cat chip_logs/bench_$TS.json 2>/dev/null))"
 if grep -q "worker left running" "chip_logs/bench_$TS.json" 2>/dev/null; then
     # bench.py orphaned its worker: that orphan still holds (or is
@@ -65,28 +97,28 @@ gate "stage 2"
 log "stage 2: on-chip kernel validation (tpu_tests)"
 # -v + unbuffered: each test lands in the log as it finishes, so a
 # parked or slow client shows WHICH test it is stuck in.
-PBST_TPU_TESTS=1 PYTHONUNBUFFERED=1 python -u -m pytest tpu_tests/ -v \
+PBST_TPU_TESTS=1 PYTHONUNBUFFERED=1 run python -u -m pytest tpu_tests/ -v \
     >"chip_logs/tpu_tests_$TS.log" 2>&1
 log "tpu_tests rc=$? (tail: $(tail -1 chip_logs/tpu_tests_$TS.log))"
 gap
 
 gate "stage 3"
 log "stage 3: serving benchmark"
-python bench_serving.py \
+run python bench_serving.py \
     >"chip_logs/serving_$TS.json" 2>"chip_logs/serving_$TS.err"
 log "bench_serving rc=$? ($(cat chip_logs/serving_$TS.json 2>/dev/null | tr '\n' ' '))"
 gap
 
 gate "stage 4"
 log "stage 4: pallas sweep (incl. batch-8 / remat-none MFU push points)"
-PBST_SWEEP_ATTN=pallas python bench_sweep.py \
+PBST_SWEEP_ATTN=pallas run python bench_sweep.py \
     >"chip_logs/sweep_pallas_$TS.jsonl" 2>"chip_logs/sweep_pallas_$TS.err"
 log "sweep rc=$? ($(tail -2 chip_logs/sweep_pallas_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
 gap
 
 gate "stage 4c"
 log "stage 4c: chunked-CE sweep (does loss_chunks=8 unlock batch 8?)"
-PBST_SWEEP_LOSS_CHUNKS=8 PBST_SWEEP_ATTN=xla python bench_sweep.py \
+PBST_SWEEP_LOSS_CHUNKS=8 PBST_SWEEP_ATTN=xla run python bench_sweep.py \
     >"chip_logs/sweep_lc8_$TS.jsonl" 2>"chip_logs/sweep_lc8_$TS.err"
 log "lc8 sweep rc=$? ($(tail -2 chip_logs/sweep_lc8_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
 gap
@@ -94,7 +126,7 @@ gap
 gate "stage 4d"
 log "stage 4d: bf16-moment sweep (2.8 GB of optimizer HBM back; second batch-8 unlock lever)"
 PBST_SWEEP_MU_DTYPE=bf16 PBST_SWEEP_LOSS_CHUNKS=8 PBST_SWEEP_ATTN=xla \
-    python bench_sweep.py \
+    run python bench_sweep.py \
     >"chip_logs/sweep_mu16_$TS.jsonl" 2>"chip_logs/sweep_mu16_$TS.err"
 log "mu16 sweep rc=$? ($(tail -2 chip_logs/sweep_mu16_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
 gap
@@ -102,7 +134,7 @@ gap
 gate "stage 4e"
 log "stage 4e: all three HBM levers composed (flash + chunked CE + bf16 moments: the remat-none bid)"
 PBST_SWEEP_MU_DTYPE=bf16 PBST_SWEEP_LOSS_CHUNKS=8 PBST_SWEEP_ATTN=pallas \
-    python bench_sweep.py \
+    run python bench_sweep.py \
     >"chip_logs/sweep_all_$TS.jsonl" 2>"chip_logs/sweep_all_$TS.err"
 log "composed sweep rc=$? ($(tail -2 chip_logs/sweep_all_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
 gap
@@ -110,28 +142,28 @@ gap
 gate "stage 4f"
 log "stage 4f: beyond-grid batch probe (12/16 under all levers; error rows are answers)"
 PBST_SWEEP_MU_DTYPE=bf16 PBST_SWEEP_LOSS_CHUNKS=8 PBST_SWEEP_ATTN=pallas \
-    PBST_SWEEP_BATCHES=12,16 python bench_sweep.py \
+    PBST_SWEEP_BATCHES=12,16 run python bench_sweep.py \
     >"chip_logs/sweep_bigbatch_$TS.jsonl" 2>"chip_logs/sweep_bigbatch_$TS.err"
 log "bigbatch sweep rc=$? ($(tail -2 chip_logs/sweep_bigbatch_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
 gap
 
 gate "stage 5"
 log "stage 5: long-context flash-vs-xla (S=4096/8192)"
-python bench_longctx.py \
+run python bench_longctx.py \
     >"chip_logs/longctx_$TS.jsonl" 2>"chip_logs/longctx_$TS.err"
 log "longctx rc=$? ($(tail -3 chip_logs/longctx_$TS.jsonl 2>/dev/null | tr '\n' ' '))"
 gap
 
 gate "stage 5b"
 log "stage 5b: roofline decomposition (MFU accounting)"
-python bench_decompose.py \
+run python bench_decompose.py \
     >"chip_logs/decompose_$TS.jsonl" 2>"chip_logs/decompose_$TS.err"
 log "decompose rc=$? ($(tail -1 chip_logs/decompose_$TS.jsonl 2>/dev/null))"
 gap
 
 gate "stage 6"
 log "stage 6: headline bench re-run (warm cache, final number)"
-python bench.py \
+run python bench.py \
     >"chip_logs/bench_final_$TS.json" 2>"chip_logs/bench_final_$TS.err"
 log "final bench rc=$? ($(cat chip_logs/bench_final_$TS.json 2>/dev/null))"
 

@@ -1,0 +1,85 @@
+"""chip_queue.sh control logic, chip-free (PBST_QUEUE_DRYRUN=1).
+
+The queue's gating logic guards real chip time: the deadline must stop
+new clients, the skip knob must resume from stage 2, and stage
+commands must carry their env levers. All of it testable without a
+chip via the dry-run mode (stage commands are echoed, not executed).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_queue(tmp_path, extra_env: dict) -> str:
+    # Copy the script next to a private chip_logs so dry runs never
+    # pollute the repo's real artifact directory.
+    qdir = tmp_path / "q"
+    qdir.mkdir()
+    (qdir / "chip_queue.sh").write_bytes(
+        open(os.path.join(REPO, "chip_queue.sh"), "rb").read())
+    os.chmod(qdir / "chip_queue.sh", 0o755)
+    env = dict(os.environ)
+    env.update({"PBST_QUEUE_DRYRUN": "1",
+                "PBST_QUEUE_DRYRUN_DIR": str(qdir), **extra_env})
+    proc = subprocess.run(["bash", str(qdir / "chip_queue.sh")],
+                          capture_output=True, text=True, timeout=60,
+                          env=env, cwd=str(qdir))
+    assert proc.returncode in (0, 2), proc.stderr
+    logs = ""
+    for p in sorted((qdir / "chip_logs").glob("queue_*.log")):
+        logs += p.read_text()
+    return proc.stdout + logs, qdir
+
+
+def test_dryrun_walks_every_stage(tmp_path):
+    out, qdir = _run_queue(tmp_path, {})
+    for stage in ("stage 1", "stage 2", "stage 3", "stage 4",
+                  "stage 4c", "stage 4d", "stage 4e", "stage 4f",
+                  "stage 5", "stage 5b", "stage 6"):
+        assert f"{stage}:" in out, stage
+    # Every chip client is echoed, never executed.
+    assert out.count("DRYRUN:") >= 11
+    assert "queue complete" in out
+    # The echo carries each sweep stage's env levers, so the agenda
+    # preview distinguishes the six bench_sweep invocations.
+    assert "PBST_SWEEP_ATTN=pallas" in out
+    assert "PBST_SWEEP_MU_DTYPE=bf16" in out
+    assert "PBST_SWEEP_BATCHES=12,16" in out
+    # Dry-run artifacts stay out of the REAL chip_logs: every stage
+    # artifact created alongside the queue log must be empty.
+    arts = [p for p in (qdir / "chip_logs").iterdir()
+            if not p.name.startswith("queue_")]
+    assert arts and all(p.stat().st_size == 0 for p in arts)
+
+
+def test_skip_bench_resumes_from_stage_2(tmp_path):
+    out, _ = _run_queue(tmp_path, {"PBST_QUEUE_SKIP_BENCH": "1"})
+    assert "stage 1:" not in out
+    assert "stage 2:" in out and "queue complete" in out
+
+
+def test_past_deadline_stops_before_first_client(tmp_path):
+    past = str(int(time.time()) - 10)
+    out, _ = _run_queue(tmp_path, {"PBST_QUEUE_DEADLINE": past})
+    assert "deadline passed" in out
+    assert "DRYRUN:" not in out  # no chip client would have started
+
+
+def test_bogus_deadline_fails_fast(tmp_path):
+    qdir = tmp_path / "q2"
+    qdir.mkdir()
+    (qdir / "chip_queue.sh").write_bytes(
+        open(os.path.join(REPO, "chip_queue.sh"), "rb").read())
+    env = dict(os.environ)
+    env.update({"PBST_QUEUE_DRYRUN": "1",
+                "PBST_QUEUE_DEADLINE": "2026-07-31T14:00"})
+    proc = subprocess.run(["bash", str(qdir / "chip_queue.sh")],
+                          capture_output=True, text=True, timeout=30,
+                          env=env, cwd=str(qdir))
+    assert proc.returncode == 2
+    assert "must be a unix epoch" in proc.stderr
