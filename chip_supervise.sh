@@ -41,7 +41,10 @@ while :; do
     fi
     ATTEMPT=$((ATTEMPT + 1))
     log "runner attempt $ATTEMPT (foreground, unkilled)"
-    python chip_runner.py >>"chip_logs/runner_attempts.log" 2>&1
+    # PBST_RUNNER_CMD: test seam (tests/test_chip_supervise.py stubs
+    # the claim-wait without a chip). Production default unchanged.
+    ${PBST_RUNNER_CMD:-python chip_runner.py} \
+        >>"chip_logs/runner_attempts.log" 2>&1
     rc=$?
     RESULT=$(fresh_result)
     if [ -n "$RESULT" ]; then

@@ -1,0 +1,98 @@
+"""chip_supervise.sh control logic, chip-free (stubbed runner).
+
+The supervisor is the machinery that turns a wedged claim into a
+green round: block with ONE unkilled client, relaunch on clean error
+with a quiet window, stop at the queue deadline so the driver's
+end-of-round bench finds the chip free. All of that is control flow,
+testable with a stub runner + the queue's dry-run mode.
+"""
+
+from __future__ import annotations
+
+import os
+import stat
+import subprocess
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _setup(tmp_path, stub_body: str):
+    qdir = tmp_path / "s"
+    qdir.mkdir()
+    for script in ("chip_supervise.sh", "chip_queue.sh"):
+        dst = qdir / script
+        dst.write_bytes(open(os.path.join(REPO, script), "rb").read())
+        os.chmod(dst, os.stat(dst).st_mode | stat.S_IXUSR)
+    stub = qdir / "stub_runner.sh"
+    stub.write_text("#!/bin/bash\n" + stub_body)
+    os.chmod(stub, 0o755)
+    return qdir
+
+
+def _run(qdir, not_after: int, extra_env: dict):
+    env = dict(os.environ)
+    env.update({
+        "PBST_RUNNER_CMD": f"bash {qdir}/stub_runner.sh",
+        # The queue (launched on success) must not touch a chip.
+        "PBST_QUEUE_DRYRUN": "1",
+        "PBST_QUEUE_DRYRUN_DIR": str(qdir),
+        "RETRY_QUIET_S": "0",
+        **extra_env,
+    })
+    proc = subprocess.run(
+        ["bash", str(qdir / "chip_supervise.sh"), str(not_after)],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=str(qdir))
+    logs = ""
+    for p in sorted((qdir / "chip_logs").glob("*.log")):
+        logs += p.read_text()
+    return proc.returncode, proc.stdout + logs
+
+
+def test_success_path_runs_queue(tmp_path):
+    qdir = _setup(
+        tmp_path,
+        'echo \'{"value": 1.0}\' > chip_logs/runner_result_stub.json\n')
+    rc, out = _run(qdir, int(time.time()) + 3600, {})
+    assert rc == 0, out
+    assert "runner attempt 1 succeeded" in out
+    assert "starting chip_queue.sh" in out
+    assert "queue complete" in out or "queue done" in out
+
+
+def test_clean_failures_retry_then_succeed(tmp_path):
+    qdir = _setup(
+        tmp_path,
+        'n=$(cat n 2>/dev/null || echo 0); n=$((n+1)); echo $n > n\n'
+        'if [ "$n" -lt 3 ]; then echo UNAVAILABLE; exit 1; fi\n'
+        'echo \'{"value": 1.0}\' > chip_logs/runner_result_stub.json\n')
+    rc, out = _run(qdir, int(time.time()) + 3600, {})
+    assert rc == 0, out
+    assert "runner attempt 2 exited rc=1" in out
+    assert "runner attempt 3 succeeded" in out
+
+
+def test_deadline_stops_attempts_and_leaves_chip_free(tmp_path):
+    # Runner always fails; the supervisor must stop at the deadline
+    # instead of knocking forever (the driver's bench needs the chip).
+    qdir = _setup(tmp_path, "echo UNAVAILABLE; exit 1\n")
+    rc, out = _run(qdir, int(time.time()) + 1, {})
+    assert rc == 0, out
+    assert ("past the queue deadline" in out
+            or "no further claim attempts" in out)
+    assert "starting chip_queue.sh" not in out
+
+
+def test_success_after_deadline_skips_queue(tmp_path):
+    # A late acquire still records its result but must NOT start the
+    # multi-hour queue past the deadline.
+    qdir = _setup(
+        tmp_path,
+        'sleep 2\n'
+        'echo \'{"value": 1.0}\' > chip_logs/runner_result_stub.json\n')
+    rc, out = _run(qdir, int(time.time()) + 1, {})
+    assert rc == 0, out
+    assert "runner attempt 1 succeeded" in out
+    assert "leaving the chip free" in out
+    assert "starting chip_queue.sh" not in out
