@@ -131,9 +131,13 @@ def main() -> int:
             BATCHES = [int(b) for b in batches_env.split(",") if b.strip()]
         except ValueError:
             BATCHES = []
-        if not BATCHES:
+        # Fail fast on empty AND on non-positive batches: a 0/-1 batch
+        # would only surface as per-point error rows after burning chip
+        # time (bench.py's _int_knob enforces >= 1 the same way).
+        if not BATCHES or any(b < 1 for b in BATCHES):
             print(json.dumps(
-                {"error": f"PBST_SWEEP_BATCHES must be ints: {batches_env}"}),
+                {"error": "PBST_SWEEP_BATCHES must be ints >= 1: "
+                          f"{batches_env}"}),
                 flush=True)
             return 1
     attn_env = os.environ.get("PBST_SWEEP_ATTN")

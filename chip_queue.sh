@@ -41,6 +41,14 @@ gate() {
 }
 DRYRUN=${PBST_QUEUE_DRYRUN:-}
 GAP=${PBST_QUEUE_GAP_S:-45}
+case "$GAP" in
+    ''|*[!0-9]*)
+        # With no `set -e`, a bad GAP would make `sleep` error and the
+        # queue would silently proceed with a 0 s gap — the exact
+        # lease-release race the gap exists to prevent.
+        echo "PBST_QUEUE_GAP_S must be a non-negative integer (seconds), got: $GAP" >&2
+        exit 2;;
+esac
 gap() {
     gate "the next stage's gap"
     if [ "$DRYRUN" = "1" ]; then return 0; fi  # no lease to settle
@@ -83,11 +91,13 @@ gate "stage 1"
 log "stage 1: headline bench (self-supervised, orphan-on-deadline)"
 run python bench.py >"chip_logs/bench_$TS.json" 2>"chip_logs/bench_$TS.err"
 log "bench rc=$? ($(cat chip_logs/bench_$TS.json 2>/dev/null))"
-if grep -q "worker left running" "chip_logs/bench_$TS.json" 2>/dev/null; then
-    # bench.py orphaned its worker: that orphan still holds (or is
-    # queued on) the claim. Starting stage 2 would stack a second
+if grep -qE "worker left running|claim-unavailable" \
+        "chip_logs/bench_$TS.json" 2>/dev/null; then
+    # bench.py orphaned its worker (deadline) or reported the claim
+    # held (fast probe): either way a client may still hold or be
+    # queued on the claim. Starting stage 2 would stack a second
     # client behind it — the one-client rule (docs/OPS.md). Stop.
-    log "stage 1 orphaned its worker — aborting the queue; wait for the orphan to exit before any further chip work"
+    log "stage 1 left a worker behind or found the claim held — aborting the queue; wait for the chip to free before any further chip work"
     exit 1
 fi
 gap

@@ -22,6 +22,17 @@ set -u
 cd "$(dirname "$0")"
 mkdir -p chip_logs
 NOT_AFTER=${1:-$(($(date +%s) + 18000))}
+# Quiet window between claim attempts (seconds). PBST_ prefix like
+# every other knob; legacy RETRY_QUIET_S still honored. Validated up
+# front: a non-numeric value would make `sleep` fail and turn the
+# quiet window into a tight relaunch loop — the exact cadence that
+# keeps a wedge alive (docs/OPS.md "The chip").
+RETRY_QUIET=${PBST_RETRY_QUIET_S:-${RETRY_QUIET_S:-1800}}
+case "$RETRY_QUIET" in
+    ''|*[!0-9]*)
+        echo "PBST_RETRY_QUIET_S must be a non-negative integer (seconds), got: $RETRY_QUIET" >&2
+        exit 2;;
+esac
 START_MARK="chip_logs/.supervise_start_$$"
 touch "$START_MARK"
 LOG="chip_logs/supervise_$(date +%H%M%S).log"
@@ -62,8 +73,8 @@ while :; do
         rm -f "$START_MARK"
         exit 0
     fi
-    log "runner attempt $ATTEMPT exited rc=$rc without a result; retry in ${RETRY_QUIET_S:-1800}s"
-    sleep "${RETRY_QUIET_S:-1800}"
+    log "runner attempt $ATTEMPT exited rc=$rc without a result; retry in ${RETRY_QUIET}s"
+    sleep "$RETRY_QUIET"
 done
 rm -f "$START_MARK"
 if [ "$(date +%s)" -ge "$NOT_AFTER" ]; then

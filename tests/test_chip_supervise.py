@@ -84,6 +84,34 @@ def test_deadline_stops_attempts_and_leaves_chip_free(tmp_path):
     assert "starting chip_queue.sh" not in out
 
 
+def test_bogus_retry_quiet_fails_fast(tmp_path):
+    """A non-numeric quiet knob would make `sleep` fail and turn the
+    quiet window into a tight relaunch loop — the cadence that keeps a
+    wedge alive (ADVICE r3). Must exit 2 at startup, before any
+    runner attempt."""
+    qdir = _setup(tmp_path, "echo should-not-run; exit 1\n")
+    rc, out = _run(qdir, int(time.time()) + 3600,
+                   {"PBST_RETRY_QUIET_S": "30min"})
+    assert rc == 2
+    assert "runner attempt" not in out
+
+
+def test_prefixed_quiet_knob_overrides_legacy(tmp_path):
+    """PBST_RETRY_QUIET_S (documented name) wins over the legacy
+    RETRY_QUIET_S that _run sets to 0."""
+    qdir = _setup(
+        tmp_path,
+        'n=$(cat n 2>/dev/null || echo 0); n=$((n+1)); echo $n > n\n'
+        'if [ "$n" -lt 2 ]; then echo UNAVAILABLE; exit 1; fi\n'
+        'echo \'{"value": 1.0}\' > chip_logs/runner_result_stub.json\n')
+    t0 = time.time()
+    rc, out = _run(qdir, int(time.time()) + 3600,
+                   {"PBST_RETRY_QUIET_S": "2"})
+    assert rc == 0, out
+    assert "retry in 2s" in out
+    assert time.time() - t0 >= 2.0
+
+
 def test_success_after_deadline_skips_queue(tmp_path):
     # A late acquire still records its result but must NOT start the
     # multi-hour queue past the deadline.
