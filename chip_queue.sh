@@ -91,15 +91,19 @@ gate "stage 1"
 log "stage 1: headline bench (self-supervised, orphan-on-deadline)"
 run python bench.py >"chip_logs/bench_$TS.json" 2>"chip_logs/bench_$TS.err"
 log "bench rc=$? ($(cat chip_logs/bench_$TS.json 2>/dev/null))"
-if grep -qE "worker left running|claim-unavailable" \
-        "chip_logs/bench_$TS.json" 2>/dev/null; then
-    # bench.py orphaned its worker (deadline) or reported the claim
-    # held (fast probe): either way a client may still hold or be
-    # queued on the claim. Starting stage 2 would stack a second
-    # client behind it — the one-client rule (docs/OPS.md). Stop.
-    log "stage 1 left a worker behind or found the claim held — aborting the queue; wait for the chip to free before any further chip work"
-    exit 1
-fi
+check_bench() {
+    # $1 = artifact, $2 = stage name. bench.py orphaned its worker
+    # (deadline) or reported the claim held (fast probe): either way a
+    # client may still hold or be queued on the claim. Starting the
+    # next stage would stack a second client behind it — the
+    # one-client rule (docs/OPS.md). Stop the queue.
+    if grep -qE "worker left running|claim-unavailable" "$1" 2>/dev/null
+    then
+        log "$2 left a worker behind or found the claim held — aborting the queue; wait for the chip to free before any further chip work"
+        exit 1
+    fi
+}
+check_bench "chip_logs/bench_$TS.json" "stage 1"
 gap
 fi
 
@@ -169,6 +173,31 @@ log "stage 5b: roofline decomposition (MFU accounting)"
 run python bench_decompose.py \
     >"chip_logs/decompose_$TS.jsonl" 2>"chip_logs/decompose_$TS.err"
 log "decompose rc=$? ($(tail -1 chip_logs/decompose_$TS.jsonl 2>/dev/null))"
+gap
+
+gate "stage 5c"
+log "stage 5c: candidate-config headline (chunked CE + bf16 moments, batch 8, xla attn; driver protocol)"
+# The sweep stages answer "which config is fastest" under the sweep
+# protocol; the flip decision needs the winner under bench.py's EXACT
+# driver protocol. Run the hypothesized-best compositions here so the
+# number exists even if the session isn't interactive at flip time.
+# Artifacts are cand8_* (NOT bench_*): chip_summarize's headline glob
+# must never pick up a candidate-config number as the default-config
+# headline.
+PBST_BENCH_BATCH=8 PBST_BENCH_LOSS_CHUNKS=8 PBST_BENCH_MU_DTYPE=bf16 \
+    run python bench.py \
+    >"chip_logs/cand8_$TS.json" 2>"chip_logs/cand8_$TS.err"
+log "cand8 bench rc=$? ($(cat chip_logs/cand8_$TS.json 2>/dev/null))"
+check_bench "chip_logs/cand8_$TS.json" "stage 5c"
+gap
+
+gate "stage 5d"
+log "stage 5d: candidate-config headline, all three HBM levers (+ flash attention)"
+PBST_BENCH_BATCH=8 PBST_BENCH_LOSS_CHUNKS=8 PBST_BENCH_MU_DTYPE=bf16 \
+    PBST_BENCH_ATTN=pallas run python bench.py \
+    >"chip_logs/cand8p_$TS.json" 2>"chip_logs/cand8p_$TS.err"
+log "cand8p bench rc=$? ($(cat chip_logs/cand8p_$TS.json 2>/dev/null))"
+check_bench "chip_logs/cand8p_$TS.json" "stage 5d"
 gap
 
 gate "stage 6"

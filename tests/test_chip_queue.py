@@ -40,10 +40,16 @@ def test_dryrun_walks_every_stage(tmp_path):
     out, qdir = _run_queue(tmp_path, {})
     for stage in ("stage 1", "stage 2", "stage 3", "stage 4",
                   "stage 4c", "stage 4d", "stage 4e", "stage 4f",
-                  "stage 5", "stage 5b", "stage 6"):
+                  "stage 5", "stage 5b", "stage 5c", "stage 5d",
+                  "stage 6"):
         assert f"{stage}:" in out, stage
     # Every chip client is echoed, never executed.
-    assert out.count("DRYRUN:") >= 11
+    assert out.count("DRYRUN:") >= 13
+    # Candidate-config artifacts must NOT match the headline glob
+    # bench_*.json (chip_summarize would report a lever config as the
+    # default-config headline).
+    assert "chip_logs/bench_cand" not in open(
+        os.path.join(REPO, "chip_queue.sh")).read()
     assert "queue complete" in out
     # The echo carries each sweep stage's env levers, so the agenda
     # preview distinguishes the six bench_sweep invocations.

@@ -60,6 +60,9 @@ def main() -> int:
 
     for name, pattern in (("headline bench", "bench_*.json"),
                           ("runner result", "runner_result_*.json"),
+                          ("candidate bench (levers)", "cand8_*.json"),
+                          ("candidate bench (levers+flash)",
+                           "cand8p_*.json"),
                           ("final bench", "bench_final_*.json")):
         for path in _newest(os.path.join(d, pattern))[:2]:
             rows = _read_jsonl(path)
