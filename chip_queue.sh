@@ -86,23 +86,25 @@ run() {
 # previous client (chip_supervise.sh's runner) exited — same race.
 gap
 
-if [ "${PBST_QUEUE_SKIP_BENCH:-}" != "1" ]; then
-gate "stage 1"
-log "stage 1: headline bench (self-supervised, orphan-on-deadline)"
-run python bench.py >"chip_logs/bench_$TS.json" 2>"chip_logs/bench_$TS.err"
-log "bench rc=$? ($(cat chip_logs/bench_$TS.json 2>/dev/null))"
 check_bench() {
     # $1 = artifact, $2 = stage name. bench.py orphaned its worker
     # (deadline) or reported the claim held (fast probe): either way a
     # client may still hold or be queued on the claim. Starting the
     # next stage would stack a second client behind it — the
-    # one-client rule (docs/OPS.md). Stop the queue.
+    # one-client rule (docs/OPS.md). Stop the queue.  Defined at top
+    # level: stages 5c/5d call it even when stage 1 is skipped.
     if grep -qE "worker left running|claim-unavailable" "$1" 2>/dev/null
     then
         log "$2 left a worker behind or found the claim held — aborting the queue; wait for the chip to free before any further chip work"
         exit 1
     fi
 }
+
+if [ "${PBST_QUEUE_SKIP_BENCH:-}" != "1" ]; then
+gate "stage 1"
+log "stage 1: headline bench (self-supervised, orphan-on-deadline)"
+run python bench.py >"chip_logs/bench_$TS.json" 2>"chip_logs/bench_$TS.err"
+log "bench rc=$? ($(cat chip_logs/bench_$TS.json 2>/dev/null))"
 check_bench "chip_logs/bench_$TS.json" "stage 1"
 gap
 fi

@@ -47,9 +47,19 @@ def test_dryrun_walks_every_stage(tmp_path):
     assert out.count("DRYRUN:") >= 13
     # Candidate-config artifacts must NOT match the headline glob
     # bench_*.json (chip_summarize would report a lever config as the
-    # default-config headline).
-    assert "chip_logs/bench_cand" not in open(
-        os.path.join(REPO, "chip_queue.sh")).read()
+    # default-config headline): among the dry-run artifacts, the only
+    # bench_*.json files allowed are the stage-1 headline and the
+    # stage-6 final re-run.
+    import fnmatch
+    import re
+
+    bench_like = [p.name for p in (qdir / "chip_logs").iterdir()
+                  if fnmatch.fnmatch(p.name, "bench_*.json")]
+    assert bench_like, "stage 1/6 artifacts missing from the dryrun"
+    for name in bench_like:
+        assert re.fullmatch(r"bench_(final_)?\d{6}\.json", name), (
+            f"{name} collides with chip_summarize's headline glob"
+        )
     assert "queue complete" in out
     # The echo carries each sweep stage's env levers, so the agenda
     # preview distinguishes the six bench_sweep invocations.

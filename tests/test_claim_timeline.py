@@ -1,0 +1,48 @@
+"""tools/claim_timeline.py: one chronological view of a claim window."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "claim_timeline.py")
+
+
+def _render(tmp_path, files: dict) -> str:
+    d = tmp_path / "logs"
+    d.mkdir()
+    for name, text in files.items():
+        (d / name).write_text(text)
+    proc = subprocess.run([sys.executable, TOOL, str(d)],
+                          capture_output=True, text=True, timeout=30)
+    assert proc.returncode == 0, proc.stderr
+    return proc.stdout
+
+
+def test_merges_sorts_and_dedupes(tmp_path):
+    out = _render(tmp_path, {
+        "supervise_x.log": "[supervise 10:00:01] knocking\n",
+        "supervise_nohup.log": "[supervise 10:00:01] knocking\n",  # tee'd
+        "runner_1.log": "[runner +   0.2s 10:00:05] backend init\n"
+                        "some traceback line\n"
+                        "[runner + 100.0s 10:01:45] claim acquired\n",
+        "queue_1.log": "[chip_queue 09:59:00] stage 1: headline\n",
+    })
+    lines = [ln for ln in out.splitlines() if ln.strip()]
+    # chronological: queue 09:59 first, runner acquire last
+    assert "09:59:00" in lines[0]
+    assert "claim acquired" in lines[-1]
+    # tee'd duplicate collapsed
+    assert out.count("knocking") == 1
+    # unstamped continuation attached, indented
+    assert any("| some traceback line" in ln for ln in lines)
+
+
+def test_handles_empty_dir(tmp_path):
+    d = tmp_path / "empty"
+    d.mkdir()
+    proc = subprocess.run([sys.executable, TOOL, str(d)],
+                          capture_output=True, text=True, timeout=30)
+    assert proc.returncode == 0
