@@ -202,6 +202,19 @@ log "cand8p bench rc=$? ($(cat chip_logs/cand8p_$TS.json 2>/dev/null))"
 check_bench "chip_logs/cand8p_$TS.json" "stage 5d"
 gap
 
+gate "stage 5e"
+log "stage 5e: candidate-config headline, remat=none under both HBM levers (batch 6, xla attn)"
+# remat(dots) recomputes every non-matmul op in the backward; if the
+# chunked-CE + bf16-moment HBM headroom lets remat=none compile at the
+# default batch, that recompute tax disappears — the largest single
+# MFU jump the sweep can reveal, pinned here under the driver protocol.
+PBST_BENCH_REMAT=none PBST_BENCH_LOSS_CHUNKS=8 PBST_BENCH_MU_DTYPE=bf16 \
+    run python bench.py \
+    >"chip_logs/cand6rn_$TS.json" 2>"chip_logs/cand6rn_$TS.err"
+log "cand6rn bench rc=$? ($(cat chip_logs/cand6rn_$TS.json 2>/dev/null))"
+check_bench "chip_logs/cand6rn_$TS.json" "stage 5e"
+gap
+
 gate "stage 6"
 log "stage 6: headline bench re-run (warm cache, final number)"
 run python bench.py \

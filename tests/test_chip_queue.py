@@ -41,10 +41,10 @@ def test_dryrun_walks_every_stage(tmp_path):
     for stage in ("stage 1", "stage 2", "stage 3", "stage 4",
                   "stage 4c", "stage 4d", "stage 4e", "stage 4f",
                   "stage 5", "stage 5b", "stage 5c", "stage 5d",
-                  "stage 6"):
+                  "stage 5e", "stage 6"):
         assert f"{stage}:" in out, stage
     # Every chip client is echoed, never executed.
-    assert out.count("DRYRUN:") >= 13
+    assert out.count("DRYRUN:") >= 14
     # Candidate-config artifacts must NOT match the headline glob
     # bench_*.json (chip_summarize would report a lever config as the
     # default-config headline): among the dry-run artifacts, the only

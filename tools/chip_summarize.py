@@ -63,6 +63,8 @@ def main() -> int:
                           ("candidate bench (levers)", "cand8_*.json"),
                           ("candidate bench (levers+flash)",
                            "cand8p_*.json"),
+                          ("candidate bench (remat=none)",
+                           "cand6rn_*.json"),
                           ("final bench", "bench_final_*.json")):
         for path in _newest(os.path.join(d, pattern))[:2]:
             rows = _read_jsonl(path)
