@@ -58,6 +58,21 @@ class MoEConfig(TransformerConfig):
     # linear in total tokens, not quadratic. Groups that don't divide T
     # fall back to one group (tiny shapes / tests).
     router_group_size: int = 4096
+    # Provably dropless routing: capacity = group token count, the
+    # exact worst case (under top-k each token occupies at most one
+    # slot per expert), so overflow is IMPOSSIBLE for any routing
+    # pattern — not merely unlikely under an ample capacity_factor.
+    # This is the mode speculative verification and engine/lockstep
+    # parity need: token-exact regardless of how adversarially the
+    # router concentrates.  Cost: the dispatch tensors become O(g²E)
+    # per group and the expert compute is provisioned for E*g slots,
+    # so it is a SERVING/VERIFY mode (decode steps route a handful of
+    # tokens; prefill buckets are bounded); dropless_group_max guards
+    # against accidentally training with it.  In dropless mode the
+    # group size has no routing semantics at all — grouping degrades
+    # to a pure memory-tiling choice.
+    dropless: bool = False
+    dropless_group_max: int = 1024
 
     def num_params(self) -> int:
         d, f, v = self.d_model, self.d_ff, self.vocab
@@ -73,6 +88,19 @@ class MoEConfig(TransformerConfig):
         return v * d + self.n_layers * per_layer + d + d * v
 
     def capacity(self, n_tokens: int) -> int:
+        if self.dropless:
+            if n_tokens > self.dropless_group_max:
+                raise ValueError(
+                    f"dropless routing over a {n_tokens}-token group "
+                    f"exceeds dropless_group_max="
+                    f"{self.dropless_group_max} (dispatch memory is "
+                    "O(g²·E)). Shrink router_group_size (grouping is "
+                    "semantics-free in dropless mode — moe_mlp "
+                    "auto-tiles this way), use capacity routing for "
+                    "training/long-prefill scale, or raise the guard "
+                    "knowingly"
+                )
+            return n_tokens
         per = self.capacity_factor * self.top_k * n_tokens / self.n_experts
         return max(1, int(np.ceil(per)))
 
@@ -171,7 +199,17 @@ def moe_mlp(cfg: MoEConfig, x: jax.Array, lp: dict, constrain_ec):
     dt = cfg.dtype
     T = B * S
     g = cfg.router_group_size
-    if g <= 0 or T % g != 0:
+    if cfg.dropless:
+        # Grouping carries no routing semantics in dropless mode (every
+        # token keeps every choice regardless of neighbors), so pick
+        # the tiling HERE: the largest divisor of T within both the
+        # configured group size and the memory guard. This keeps
+        # MoEConfig(dropless=True) working at any T — including
+        # non-multiples of router_group_size — without tripping the
+        # O(g²·E) guard on the single-group fallback.
+        bound = min(g if g > 0 else T, cfg.dropless_group_max, T)
+        g = next(d_ for d_ in range(bound, 0, -1) if T % d_ == 0)
+    elif g <= 0 or T % g != 0:
         g = T  # single group (tiny shapes / tests)
     G = T // g
     Cg = cfg.capacity(g)
@@ -309,11 +347,12 @@ def moe_slot_mlp(cfg: MoEConfig, constrain_ec=lambda x: x):
     ``(lp, h) -> (y, drop_frac)`` — shared by the lockstep cache path
     (``moe_forward_with_cache``) and the continuous-batching engines
     (``ContinuousBatcher(..., mlp_fn=moe_slot_mlp(cfg))``, where the
-    drop fraction surfaces as ``stats()['mlp_extra_mean']``). The
-    router sees each forward's tokens as its groups — dropless
-    capacity (ample ``capacity_factor``) keeps engine decode routing
-    identical to the lockstep path; a nonzero drop telemetry means
-    co-resident lanes are competing for expert slots."""
+    drop fraction surfaces as ``stats()['mlp_extra_mean']``). For
+    engine/lockstep routing parity use ``MoEConfig(dropless=True)``
+    (capacity = group tokens: overflow structurally impossible, the
+    canonical mode for serving and speculative verification); under
+    capacity routing a nonzero drop telemetry means co-resident lanes
+    are competing for expert slots."""
     def mlp(lp, h):
         y, _aux, drop = moe_mlp(cfg, h, lp, constrain_ec)
         return y, drop

@@ -54,12 +54,14 @@ def make_speculative_generate(
     covers both.
 
     MoE caveat: token-exactness vs the plain decode loop requires the
-    router to be **dropless** for these batch shapes (capacity ample
-    for B·(k+1) tokens). Capacity dropping makes MoE logits depend on
-    which tokens share the forward, so a k+1-token verify can route —
-    and therefore score — differently than one-token-at-a-time decode;
-    with zero drops, routing is per-token and the exactness proof
-    carries over unchanged (pinned by test).
+    router to be **dropless** — use ``MoEConfig(dropless=True)``,
+    which makes overflow structurally impossible (capacity = group
+    tokens) rather than relying on an ample ``capacity_factor`` for
+    the particular batch shapes. Capacity dropping makes MoE logits
+    depend on which tokens share the forward, so a k+1-token verify
+    can route — and therefore score — differently than
+    one-token-at-a-time decode; with zero drops, routing is per-token
+    and the exactness proof carries over unchanged (pinned by test).
     """
     if k < 1:
         raise ValueError(f"k must be >= 1, got {k}")

@@ -61,6 +61,99 @@ def test_combine_weights_renormalized():
     np.testing.assert_allclose(sums, 1.0, atol=1e-5)
 
 
+def test_dropless_mode_is_exact_under_adversarial_concentration():
+    """dropless=True sets capacity = group tokens — the provable worst
+    case — so even ALL tokens picking the SAME expert drops nothing,
+    where the default capacity factor drops most of them. This is the
+    guarantee speculative MoE verification relies on (OPS.md serving
+    workflows): token-exact routing for any routing pattern, not just
+    the shapes a capacity factor happened to cover."""
+    T, E = 16, 4
+    # Every token: 90% expert 0, 10% expert 1 -> top-2 = (0, 1) for all.
+    probs = jnp.tile(jnp.array([[0.90, 0.08, 0.01, 0.01]]), (T, 1))
+    cap_cfg = MoEConfig(**{**TINY.__dict__, "capacity_factor": 1.25})
+    d_cap, _, _, drop_cap = top_k_dispatch(
+        probs, k=2, capacity=cap_cfg.capacity(T))
+    assert float(drop_cap) > 0.2  # the capacity router really drops here
+
+    drop_cfg = MoEConfig(**{**TINY.__dict__, "dropless": True})
+    assert drop_cfg.capacity(T) == T
+    d_free, c_free, _, drop_free = top_k_dispatch(
+        probs, k=2, capacity=drop_cfg.capacity(T))
+    assert float(drop_free) == 0.0
+    # every token keeps BOTH choices, and its renormalized gate
+    # weights sum to exactly 1
+    per_token = np.asarray(d_free.sum(axis=(1, 2)))
+    assert np.all(per_token == 2.0)
+    np.testing.assert_allclose(
+        np.asarray(c_free.sum(axis=(1, 2))), 1.0, rtol=1e-6)
+
+
+def test_dropless_moe_mlp_matches_per_token_reference():
+    """End to end: the dropless routed FFN equals the explicit
+    per-token mixture  y[t] = Σ_i gate_i · SwiGLU_{e_i}(x[t])  computed
+    with no dispatch machinery at all."""
+    from pbs_tpu.models.moe import init_moe_params, moe_mlp
+
+    cfg = MoEConfig(**{**TINY.__dict__, "dropless": True})
+    params = init_moe_params(cfg, jax.random.PRNGKey(3))
+    lp = jax.tree.map(lambda a: a[0], params["layers"])  # layer 0
+    B, S = 2, 8
+    x = jax.random.normal(jax.random.PRNGKey(4), (B, S, cfg.d_model))
+
+    y, aux, drop = moe_mlp(cfg, x, lp, lambda a: a)
+    assert float(drop) == 0.0
+
+    # Reference: dense per-token mixture over the top-k experts.
+    xt = x.reshape(-1, cfg.d_model)
+    probs = jax.nn.softmax(xt @ lp["router"], axis=-1)
+    topv, topi = jax.lax.top_k(probs, cfg.top_k)
+    topv = topv / topv.sum(axis=-1, keepdims=True)
+    # All-experts FFN for every token, then select.
+    h1 = jnp.einsum("td,edf->tef", xt, lp["we1"])
+    h3 = jnp.einsum("td,edf->tef", xt, lp["we3"])
+    he = jnp.einsum("tef,efd->ted", jax.nn.silu(h1) * h3, lp["we2"])
+    ref = jnp.zeros_like(xt)
+    for i in range(cfg.top_k):
+        ref = ref + topv[:, i:i + 1] * jnp.take_along_axis(
+            he, topi[:, i][:, None, None], axis=1)[:, 0]
+    np.testing.assert_allclose(
+        np.asarray(y.reshape(-1, cfg.d_model)), np.asarray(ref),
+        rtol=2e-4, atol=2e-5)
+
+
+def test_dropless_group_guard_and_auto_tiling():
+    """Direct oversized capacity() calls fail fast with guidance, but
+    moe_mlp AUTO-TILES in dropless mode (grouping is semantics-free
+    there): default knobs work at any token count — including
+    non-multiples of router_group_size — and still drop nothing."""
+    big = MoEConfig(**{**TINY.__dict__, "dropless": True,
+                       "dropless_group_max": 32})
+    with pytest.raises(ValueError, match="router_group_size"):
+        big.capacity(64)
+
+    from pbs_tpu.models.moe import init_moe_params, moe_mlp
+
+    # Default knobs (router_group_size 4096 > guard 1024): auto-tiling
+    # must pick a legal divisor rather than tripping the guard.
+    dflt = MoEConfig(**{**TINY.__dict__, "dropless": True})
+    params = init_moe_params(dflt, jax.random.PRNGKey(5))
+    lp = jax.tree.map(lambda a: a[0], params["layers"])
+    x = jax.random.normal(jax.random.PRNGKey(6), (32, 64, dflt.d_model))
+    y, _, drop = moe_mlp(dflt, x, lp, lambda a: a)  # 2048 tokens
+    assert y.shape == x.shape
+    assert float(drop) == 0.0
+
+    # Non-multiple of the configured group size (T = 1500, groups of
+    # 512 configured): largest divisor <= 512 is chosen, no error.
+    odd = MoEConfig(**{**TINY.__dict__, "dropless": True,
+                       "router_group_size": 512})
+    x2 = jax.random.normal(jax.random.PRNGKey(7), (4, 375, odd.d_model))
+    y2, _, drop2 = moe_mlp(odd, x2, lp, lambda a: a)
+    assert y2.shape == x2.shape
+    assert float(drop2) == 0.0
+
+
 def test_moe_forward_shapes_and_causality():
     params = init_moe_params(TINY, jax.random.PRNGKey(0))
     t1 = toks()

@@ -177,7 +177,7 @@ def test_moe_continuous_serving_token_exact():
     mcfg = MoEConfig(vocab=128, d_model=64, n_layers=2, n_heads=4,
                      n_kv_heads=2, d_ff=96, max_seq=128,
                      dtype=jnp.float32, n_experts=4, top_k=2,
-                     capacity_factor=4.0)  # dropless: routing exact
+                     dropless=True)  # provably dropless routing
     mparams = init_moe_params(mcfg, jax.random.PRNGKey(0))
     prompt = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
     ref, _drop = jax.jit(make_moe_generate(mcfg, 8, temperature=0.0))(

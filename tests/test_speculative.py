@@ -101,11 +101,13 @@ def test_speculative_serve_job_telemetry(models, prompt):
 def test_speculative_moe_target_token_exact():
     """Cross-family speculation: a dense draft proposing into an MoE
     target must reproduce the MoE model's own greedy decode exactly.
-    Exactness requires a DROPLESS router (ample capacity): with
-    capacity dropping, MoE logits depend on which tokens share the
-    forward, so the k+1-token verify routes differently than
-    one-at-a-time decode — the module docstring documents the caveat;
-    this test pins the dropless guarantee."""
+    Exactness requires a DROPLESS router: with capacity dropping, MoE
+    logits depend on which tokens share the forward, so the k+1-token
+    verify routes differently than one-at-a-time decode — the module
+    docstring documents the caveat; this test pins the PROVABLE
+    dropless mode (``MoEConfig(dropless=True)``: capacity = group
+    tokens, overflow impossible for any routing pattern — stronger
+    than the ample-capacity-factor configuration it replaces)."""
     from pbs_tpu.models import (
         MoEConfig,
         init_moe_params,
@@ -116,7 +118,7 @@ def test_speculative_moe_target_token_exact():
     mcfg = MoEConfig(
         vocab=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
         d_ff=64, max_seq=256, dtype=jnp.float32, n_experts=4, top_k=2,
-        capacity_factor=8.0)  # dropless at these batch shapes
+        dropless=True)  # provably dropless, any batch shape
     dcfg = TransformerConfig(**DFT)
     mp = init_moe_params(mcfg, jax.random.PRNGKey(0))
     dp = init_params(dcfg, jax.random.PRNGKey(1))
