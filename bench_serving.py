@@ -102,10 +102,33 @@ def main() -> int:
     # overhead at high acceptance). int8 target + fp draft is the
     # deployment-shaped pair test_spec_serving pins for exactness.
     from pbs_tpu.models import ContinuousBatcher, SpeculativeBatcher
+    from pbs_tpu.models.moe import MoEConfig, init_moe_params, moe_slot_mlp
     from pbs_tpu.models.quant import quantize_weights
 
     qparams = quantize_weights(params)
     jax.block_until_ready(qparams)
+    # MoE serving rows (the matrix's second model family): flagship
+    # attention dims with E=4 experts sized so total params match the
+    # dense flagship (~700M; active/token comparable), routed
+    # PROVABLY dropless (MoEConfig.dropless) — the mode engine
+    # parity and speculative verification require.
+    import dataclasses as _dc
+
+    mcfg = MoEConfig(
+        **{**_dc.asdict(cfg), "d_ff": cfg.d_ff // 4},
+        n_experts=4, top_k=2, dropless=True)
+    # Lazy + memoized: ~2.8 GB of fp32 MoE masters must not sit in
+    # HBM while the four DENSE rows run (the loop drops each engine
+    # before building the next for exactly this reason).
+    _moe_params_cache: list = []
+
+    def mparams():
+        if not _moe_params_cache:
+            p = init_moe_params(mcfg, key)
+            jax.block_until_ready(p)
+            _moe_params_cache.append(p)
+        return _moe_params_cache[0]
+
     n_slots = 2 if tiny else 8
     eng_new = 8 if tiny else 64
     bucket = 16 if tiny else 512
@@ -126,6 +149,13 @@ def main() -> int:
         ("spec_continuous_int8", lambda: SpeculativeBatcher(
             cfg, qparams, cfg, params, k=4, n_slots=n_slots,
             prompt_bucket=bucket, max_len=maxlen)),
+        ("continuous_moe_dropless", lambda: ContinuousBatcher(
+            mcfg, mparams(), n_slots=n_slots, prompt_bucket=bucket,
+            max_len=maxlen, mlp_fn=moe_slot_mlp(mcfg))),
+        ("spec_continuous_moe_dropless", lambda: SpeculativeBatcher(
+            mcfg, mparams(), cfg, params, k=4, n_slots=n_slots,
+            prompt_bucket=bucket, max_len=maxlen,
+            mlp_fn=moe_slot_mlp(mcfg))),
     )
     any_engine_ok = False
     eng = None
