@@ -129,6 +129,17 @@ def main() -> int:
             _moe_params_cache.append(p)
         return _moe_params_cache[0]
 
+    _qmoe_cache: list = []
+
+    def qmparams():
+        # int8 MoE tree (experts int8 per-output-channel, router fp32
+        # by design — models/quant.py); lazy like the fp masters.
+        if not _qmoe_cache:
+            q = quantize_weights(mparams())
+            jax.block_until_ready(q)
+            _qmoe_cache.append(q)
+        return _qmoe_cache[0]
+
     n_slots = 2 if tiny else 8
     eng_new = 8 if tiny else 64
     bucket = 16 if tiny else 512
@@ -154,6 +165,15 @@ def main() -> int:
             max_len=maxlen, mlp_fn=moe_slot_mlp(mcfg))),
         ("spec_continuous_moe_dropless", lambda: SpeculativeBatcher(
             mcfg, mparams(), cfg, params, k=4, n_slots=n_slots,
+            prompt_bucket=bucket, max_len=maxlen,
+            mlp_fn=moe_slot_mlp(mcfg))),
+        # The remaining two cells of the {dense, MoE} x {plain, spec}
+        # x {bf16, int8} matrix:
+        ("continuous_moe_int8", lambda: ContinuousBatcher(
+            mcfg, qmparams(), n_slots=n_slots, prompt_bucket=bucket,
+            max_len=maxlen, mlp_fn=moe_slot_mlp(mcfg))),
+        ("spec_continuous_moe_int8", lambda: SpeculativeBatcher(
+            mcfg, qmparams(), cfg, params, k=4, n_slots=n_slots,
             prompt_bucket=bucket, max_len=maxlen,
             mlp_fn=moe_slot_mlp(mcfg))),
     )
