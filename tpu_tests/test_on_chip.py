@@ -240,6 +240,44 @@ def test_bf16_moments_train_step_compiled():
     assert jax.tree_util.tree_leaves(adam.nu)[0].dtype == jnp.bfloat16
 
 
+def test_dropless_moe_serving_on_chip():
+    """The dropless router (capacity = group tokens) and the slot
+    engine's MoE seam through the real TPU lowering: a small MoE
+    target serves a prompt end to end with zero drops, token-identical
+    to the lockstep MoE generate loop. (CI proves the parity in
+    interpreter/CPU mode; this proves the dispatch einsums and the
+    engine's jitted programs compile and agree ON CHIP.)"""
+    from pbs_tpu.models import (
+        ContinuousBatcher,
+        MoEConfig,
+        init_moe_params,
+        make_moe_generate,
+    )
+    from pbs_tpu.models.moe import moe_slot_mlp
+
+    mcfg = MoEConfig(
+        vocab=256, d_model=256, n_layers=2, n_heads=8, n_kv_heads=4,
+        d_ff=512, max_seq=128, dtype=jnp.bfloat16, n_experts=4,
+        top_k=2, dropless=True)
+    mparams = init_moe_params(mcfg, jax.random.PRNGKey(0))
+    prompt = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+    ref, _ = jax.jit(make_moe_generate(mcfg, 8, temperature=0.0))(
+        mparams, prompt, jax.random.PRNGKey(9))
+    ref_toks = [int(t) for t in np.asarray(ref)[0]]
+
+    eng = ContinuousBatcher(mcfg, mparams, n_slots=2, prompt_bucket=4,
+                            max_len=32, mlp_fn=moe_slot_mlp(mcfg))
+    eng.submit([5, 6, 7, 8], max_new_tokens=8)
+    got = None
+    for _ in range(100):
+        for c in eng.step():
+            got = [int(t) for t in c.tokens]
+        if not eng.has_work():
+            break
+    assert got == ref_toks, (got, ref_toks)
+    assert eng.stats()["mlp_extra_mean"] == 0.0  # provably dropless
+
+
 def test_chunked_ce_train_step_compiled():
     """loss_chunks (the logits-never-materialize loss tail) through the
     TPU lowering: scan-of-checkpoint over head chunks, one train step,
