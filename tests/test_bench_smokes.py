@@ -1,0 +1,64 @@
+"""Tiny-mode CI smokes for every chip-queue bench script.
+
+Stage scripts fail on the CHIP if they regress — and chip minutes are
+the scarcest resource in this environment (docs/OPS.md). Each script
+has a CPU tiny mode for exactly this reason; this module pins that
+every queue stage's script still runs end to end and emits its
+artifact shape, so a refactor cannot silently spend tonight's claim
+window on a crash. (bench.py itself is covered by test_bench_knobs /
+test_bench_probe.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script: str, env_extra: dict, timeout: float = 900.0):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PBST_BENCH_", "PBST_SWEEP_",
+                                "PBST_LONGCTX_", "PBST_DECOMP_"))}
+    env.update(env_extra)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, script)],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=REPO)
+    rows = []
+    for ln in proc.stdout.splitlines():
+        if ln.startswith("{"):
+            rows.append(json.loads(ln))
+    return proc, rows
+
+
+def test_bench_serving_tiny_covers_the_matrix():
+    proc, rows = _run("bench_serving.py", {"PBST_BENCH_TINY": "1"})
+    assert proc.returncode == 0, proc.stderr[-800:]
+    metrics = {r["metric"] for r in rows}
+    assert "serving_prefill_ms" in metrics
+    assert "serving_decode_throughput" in metrics
+    # the full {dense, MoE} x {plain, spec} x {bf16, int8} engine
+    # matrix minus interpreter-hostile cells (none: all engines are
+    # XLA) — 8 rows, none allowed to be an error row on CPU
+    engine_rows = [r for r in rows if "continuous" in r["metric"]]
+    assert len(engine_rows) == 8, sorted(metrics)
+    errs = [r for r in engine_rows if "error" in r]
+    assert not errs, errs
+
+
+def test_bench_longctx_tiny_emits_points():
+    proc, rows = _run("bench_longctx.py", {"PBST_LONGCTX_TINY": "1"})
+    assert proc.returncode == 0, proc.stderr[-800:]
+    ok = [r for r in rows if "tokens_per_s" in r]
+    assert ok, rows
+
+
+def test_bench_decompose_tiny_emits_sections():
+    proc, rows = _run("bench_decompose.py", {"PBST_DECOMP_TINY": "1"})
+    assert proc.returncode == 0, proc.stderr[-800:]
+    sections = {r.get("section") for r in rows}
+    assert len(rows) >= 3, rows
