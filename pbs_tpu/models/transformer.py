@@ -214,25 +214,36 @@ def causal_attention(
 
 def layer_body(cfg: TransformerConfig, x: jax.Array, lp: dict,
                cos: jax.Array, sin: jax.Array, constrain,
-               mesh=None) -> jax.Array:
+               mesh=None, reduce=None) -> jax.Array:
     """One transformer block. ``constrain`` re-applies the activation
-    sharding between ops (sequence-parallel residual stream)."""
+    sharding between ops (sequence-parallel residual stream).
+
+    ``reduce`` (default identity) wraps the two row-parallel matmul
+    outputs (wo, w2) — the manual-collective seam: inside a
+    ``shard_map`` region with Megatron-sharded weights these products
+    are partial sums and the caller passes ``lax.psum(..., 'tp')``
+    (pbs_tpu/parallel/pipeline._pipe_blocks); under annotation-driven
+    sharding XLA inserts the same collectives itself and the default
+    applies. Head reshapes use -1 so the body works on tp SHARDS
+    (n_heads/tp local heads) as well as full weights."""
     B, S, _ = x.shape
-    nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    hd = cfg.head_dim
     dt = cfg.dtype
+    if reduce is None:
+        reduce = lambda t: t  # noqa: E731 — identity seam
 
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = (h @ lp["wq"].astype(dt)).reshape(B, S, nh, hd)
-    k = (h @ lp["wk"].astype(dt)).reshape(B, S, nkv, hd)
-    v = (h @ lp["wv"].astype(dt)).reshape(B, S, nkv, hd)
+    q = (h @ lp["wq"].astype(dt)).reshape(B, S, -1, hd)
+    k = (h @ lp["wk"].astype(dt)).reshape(B, S, -1, hd)
+    v = (h @ lp["wv"].astype(dt)).reshape(B, S, -1, hd)
     q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
-    attn = causal_attention(q, k, v, cfg, mesh).reshape(B, S, nh * hd)
-    x = constrain(x + attn @ lp["wo"].astype(dt))
+    attn = causal_attention(q, k, v, cfg, mesh).reshape(B, S, -1)
+    x = constrain(x + reduce(attn @ lp["wo"].astype(dt)))
 
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     gate = jax.nn.silu(h @ lp["w1"].astype(dt))
     up = h @ lp["w3"].astype(dt)
-    x = constrain(x + (gate * up) @ lp["w2"].astype(dt))
+    x = constrain(x + reduce((gate * up) @ lp["w2"].astype(dt)))
     return x
 
 

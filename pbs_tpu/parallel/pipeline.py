@@ -49,27 +49,33 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
 
-def pipeline_layer_specs() -> dict:
-    """Specs for the layer-stacked subtree: stage-sharded on axis 0."""
+def pipeline_layer_specs(tp: bool = False) -> dict:
+    """Specs for the layer-stacked subtree: stage-sharded on axis 0.
+
+    With ``tp`` the in-stage weights additionally shard Megatron-style
+    over the ``tp`` axis: qkv/gate/up column-parallel (output dim),
+    wo/w2 row-parallel (input dim); norms replicate over tp (the full
+    residual stream is needed for the d-dim reduction)."""
+    t = "tp" if tp else None
     return {
         "attn_norm": P("pp", None),
-        "wq": P("pp", None, None),
-        "wk": P("pp", None, None),
-        "wv": P("pp", None, None),
-        "wo": P("pp", None, None),
+        "wq": P("pp", None, t),
+        "wk": P("pp", None, t),
+        "wv": P("pp", None, t),
+        "wo": P("pp", t, None),
         "mlp_norm": P("pp", None),
-        "w1": P("pp", None, None),
-        "w3": P("pp", None, None),
-        "w2": P("pp", None, None),
+        "w1": P("pp", None, t),
+        "w3": P("pp", None, t),
+        "w2": P("pp", t, None),
     }
 
 
-def pipeline_param_specs(cfg: TransformerConfig) -> dict:
+def pipeline_param_specs(cfg: TransformerConfig, tp: bool = False) -> dict:
     """Full-tree specs: embed/head replicated (they run outside the
     manual region, dp-sharded by activation), blocks stage-sharded."""
     return {
         "embed": P(None, None),
-        "layers": pipeline_layer_specs(),
+        "layers": pipeline_layer_specs(tp),
         "final_norm": P(None),
         "head": P(None, None),
     }
@@ -77,8 +83,10 @@ def pipeline_param_specs(cfg: TransformerConfig) -> dict:
 
 def shard_pipeline_params(params: dict, mesh: Mesh,
                           cfg: TransformerConfig) -> dict:
+    tp = mesh.shape.get("tp", 1) > 1
     shardings = jax.tree.map(
-        lambda spec: NamedSharding(mesh, spec), pipeline_param_specs(cfg),
+        lambda spec: NamedSharding(mesh, spec),
+        pipeline_param_specs(cfg, tp),
         is_leaf=lambda x: isinstance(x, P),
     )
     return jax.tree.map(jax.device_put, params, shardings)
@@ -86,12 +94,26 @@ def shard_pipeline_params(params: dict, mesh: Mesh,
 
 def _pipe_blocks(cfg: TransformerConfig, mesh: Mesh, n_micro: int):
     """Builds the shard_map'd pipelined block-stack: (layers, xs) -> ys
-    with xs/ys (M, mb, S, d) dp-sharded on mb."""
+    with xs/ys (M, mb, S, d) dp-sharded on mb (and, with a tp axis in
+    the mesh, the in-stage weights Megatron-sharded over tp)."""
     pp = mesh.shape["pp"]
+    tp = mesh.shape.get("tp", 1)
     if cfg.n_layers % pp != 0:
         raise ValueError(
             f"n_layers={cfg.n_layers} not divisible by pp={pp}"
         )
+    if tp > 1:
+        if cfg.n_heads % tp or cfg.n_kv_heads % tp or cfg.d_ff % tp:
+            raise ValueError(
+                f"tp={tp} must divide n_heads={cfg.n_heads}, "
+                f"n_kv_heads={cfg.n_kv_heads}, and d_ff={cfg.d_ff}"
+            )
+        if cfg.attn_impl != "xla":
+            raise ValueError(
+                "pipelined tp stages implement attention manually on "
+                f"local heads; attn_impl={cfg.attn_impl!r} is not "
+                "supported inside the pp schedule (use 'xla')"
+            )
 
     def pipe(layers, xs):
         # Manual per-device view: layers (L/pp, ...), xs (M, mb/dp, S, d).
@@ -99,9 +121,16 @@ def _pipe_blocks(cfg: TransformerConfig, mesh: Mesh, n_micro: int):
         S = xs.shape[2]
         cos, sin = rope_tables(cfg, S)
 
+        # With tp > 1 each device holds a Megatron shard of the stage
+        # weights; layer_body's reduce seam makes the row-parallel
+        # partial sums explicit psums over tp (the manual-collective
+        # form of the annotation-driven sharding the dense path uses).
+        reduce = (lambda t: jax.lax.psum(t, "tp")) if tp > 1 else None
+
         def stage(x):
             def scan_fn(x, lp):
-                return layer_body(cfg, x, lp, cos, sin, lambda a: a), None
+                return layer_body(cfg, x, lp, cos, sin, lambda a: a,
+                                  reduce=reduce), None
 
             x, _ = jax.lax.scan(jax.checkpoint(scan_fn), x, layers)
             return x
@@ -121,7 +150,7 @@ def _pipe_blocks(cfg: TransformerConfig, mesh: Mesh, n_micro: int):
 
     kwargs = dict(
         mesh=mesh,
-        in_specs=(pipeline_layer_specs(), P(None, "dp", None, None)),
+        in_specs=(pipeline_layer_specs(tp > 1), P(None, "dp", None, None)),
         out_specs=P("pp", "dp", None, None),
     )
     try:  # replication-check kwarg was renamed check_rep -> check_vma
