@@ -1,0 +1,33 @@
+#!/bin/bash
+# One delayed, single-attempt claim window — the strategy the round-4
+# evidence selected (docs/OPS.md "claim lifecycle model"): periodic
+# knocking can refresh the hold indefinitely, so schedule ONE fresh
+# attempt after a long true-quiet stretch and stop if it parks.
+#
+#   ./chip_oneshot.sh <start_epoch> <not_after_epoch> [queue_deadline_epoch]
+#
+# Sleeps until start_epoch, then runs chip_supervise.sh with
+# not_after_epoch (a parked attempt self-exits ~25 min in; the
+# supervisor's next loop-top lands past the deadline, so exactly one
+# attempt is made when not_after - start < RETRY_QUIET + ~25 min).
+# queue_deadline_epoch (default: not_after + 4 h) caps new queue
+# stages via PBST_QUEUE_DEADLINE. No timeouts, no signals — the
+# no-kill rules are inherited wholesale from the supervisor/queue.
+set -u
+cd "$(dirname "$0")"
+START=${1:?usage: chip_oneshot.sh <start_epoch> <not_after_epoch> [queue_deadline_epoch]}
+NOT_AFTER=${2:?usage: chip_oneshot.sh <start_epoch> <not_after_epoch> [queue_deadline_epoch]}
+QDL=${3:-$((NOT_AFTER + 14400))}
+for v in "$START" "$NOT_AFTER" "$QDL"; do
+    case "$v" in
+        ''|*[!0-9]*)
+            echo "chip_oneshot.sh: epochs must be numeric (date +%s), got: $v" >&2
+            exit 2;;
+    esac
+done
+NOW=$(date +%s)
+if [ "$START" -gt "$NOW" ]; then
+    sleep $((START - NOW))
+fi
+exec env PBST_RETRY_QUIET_S="${PBST_RETRY_QUIET_S:-2700}" \
+    PBST_QUEUE_DEADLINE="$QDL" ./chip_supervise.sh "$NOT_AFTER"

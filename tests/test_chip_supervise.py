@@ -124,3 +124,41 @@ def test_success_after_deadline_skips_queue(tmp_path):
     assert "runner attempt 1 succeeded" in out
     assert "leaving the chip free" in out
     assert "starting chip_queue.sh" not in out
+
+
+def test_oneshot_validates_and_makes_single_attempt(tmp_path):
+    """chip_oneshot.sh: numeric-epoch validation, then exactly one
+    supervisor attempt when the window is sized for one (the round-4
+    strategy: a parked knock must not be followed by another)."""
+    qdir = _setup(tmp_path, "echo UNAVAILABLE; exit 1\n")
+    dst = qdir / "chip_oneshot.sh"
+    dst.write_bytes(open(os.path.join(REPO, "chip_oneshot.sh"), "rb").read())
+    os.chmod(dst, 0o755)
+
+    proc = subprocess.run(
+        ["bash", str(dst), "not-an-epoch", "123"],
+        capture_output=True, text=True, timeout=30, cwd=str(qdir))
+    assert proc.returncode == 2
+    assert "must be numeric" in proc.stderr
+
+    env = dict(os.environ)
+    env.update({
+        "PBST_RUNNER_CMD": f"bash {qdir}/stub_runner.sh",
+        "PBST_QUEUE_DRYRUN": "1",
+        "PBST_QUEUE_DRYRUN_DIR": str(qdir),
+        "PBST_RETRY_QUIET_S": "3",
+    })
+    now = int(time.time())
+    # window: start now, not-after in 2 s -> the failed attempt plus
+    # its quiet sleep lands past the deadline: exactly one attempt.
+    proc = subprocess.run(
+        ["bash", str(dst), str(now), str(now + 2)],
+        capture_output=True, text=True, timeout=60, env=env,
+        cwd=str(qdir))
+    assert proc.returncode == 0, proc.stderr
+    logs = ""
+    for p in sorted((qdir / "chip_logs").glob("*.log")):
+        logs += p.read_text()
+    assert logs.count("runner attempt 1 (foreground") == 1
+    assert "runner attempt 2 (foreground" not in logs
+    assert "past the queue deadline" in logs
