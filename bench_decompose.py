@@ -102,6 +102,34 @@ def main() -> int:
                            f"{str(e)[:100]}"}
     print(json.dumps(r), flush=True)
 
+    # -- 2b: measured HBM bandwidth — the roofline's OTHER axis. The
+    # MFU frame argues about where 197 TF/s goes; the memory-bound
+    # buckets need the real achievable GB/s, not the datasheet 819.
+    # A donated x + 1 over a ~1 GB buffer is the cleanest read+write
+    # stream XLA will emit; 2*bytes / t is the achieved bandwidth.
+    try:
+        mb = 16 if tiny else 1024
+        buf = jnp.zeros((mb, 1024, 256), jnp.float32)  # mb MiB
+        bump = jax.jit(lambda a: a + 1, donate_argnums=(0,))
+        buf = bump(buf)
+        jax.block_until_ready(buf)
+        t0 = time.perf_counter()
+        reps = 4
+        for _ in range(reps):
+            buf = bump(buf)
+        jax.block_until_ready(buf)
+        dt_bw = (time.perf_counter() - t0) / reps
+        nbytes = mb * 1024 * 1024
+        print(json.dumps({
+            "membw_gbs": round(2 * nbytes / dt_bw / 1e9, 1),
+            "membw_buffer_mib": mb,
+        }), flush=True)
+        del buf
+    except Exception as e:  # noqa: BLE001 — a probe, not the bench
+        print(json.dumps({"membw": f"probe failed: "
+                          f"{type(e).__name__}: {str(e)[:100]}"}),
+              flush=True)
+
     # -- 3: analytic attention share (causal matmul FLOPs, fwd+bwd)
     attn_per_tok = 12 * cfg.n_layers * cfg.d_model * S // 2
     print(json.dumps({
