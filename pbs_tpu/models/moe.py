@@ -185,19 +185,9 @@ def top_k_dispatch(probs: jax.Array, k: int, capacity: int):
     return dispatch, combine, aux, drop_frac
 
 
-def moe_mlp(cfg: MoEConfig, x: jax.Array, lp: dict, constrain_ec):
-    """Routed SwiGLU experts. x (B, S, d) -> (y, aux, drop_frac).
-
-    Routing happens independently within fixed-size token groups, so the
-    dense dispatch/combine tensors are (G, g, E, Cg) with Cg ∝ g/E —
-    total memory O(T·g·k·cf), linear in T. The expert buffers flatten
-    group slots into (E, G·Cg, d); ``constrain_ec`` pins them to the
-    ``ep`` mesh axis, where the dispatch einsum (token-sharded in,
-    expert-sharded out) becomes the all-to-all.
-    """
-    B, S, d = x.shape
-    dt = cfg.dtype
-    T = B * S
+def routing_groups(cfg: MoEConfig, T: int) -> tuple[int, int, int]:
+    """(group size g, group count G, capacity Cg) for T tokens — the
+    one place the grouping/auto-tiling rules live."""
     g = cfg.router_group_size
     if cfg.dropless:
         # Grouping carries no routing semantics in dropless mode (every
@@ -211,8 +201,43 @@ def moe_mlp(cfg: MoEConfig, x: jax.Array, lp: dict, constrain_ec):
         g = next(d_ for d_ in range(bound, 0, -1) if T % d_ == 0)
     elif g <= 0 or T % g != 0:
         g = T  # single group (tiny shapes / tests)
-    G = T // g
-    Cg = cfg.capacity(g)
+    return g, T // g, cfg.capacity(g)
+
+
+def routed_expert_ffn(xg: jax.Array, dispatch: jax.Array,
+                      combine: jax.Array, lp: dict, dt,
+                      constrain_ec=lambda a: a) -> jax.Array:
+    """Dense-dispatch expert SwiGLU on an EXPERT SLICE: xg (G, g, d),
+    dispatch/combine (G, g, Ne, Cg) with Ne the experts whose weights
+    ``lp`` holds — the full set in the single-program path, the local
+    shard inside an ep ``shard_map`` (where the caller psums the
+    returned partial combine over ``ep``)."""
+    G, g, Ne, Cg = dispatch.shape
+    d = xg.shape[-1]
+    ein = jnp.einsum("gtec,gtd->egcd", dispatch.astype(dt), xg)
+    ein = constrain_ec(ein.reshape(Ne, G * Cg, d))
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", ein,
+                                  wload(lp["we1"], dt)))
+    up = jnp.einsum("ecd,edf->ecf", ein, wload(lp["we3"], dt))
+    eout = jnp.einsum("ecf,efd->ecd", constrain_ec(gate * up),
+                      wload(lp["we2"], dt))
+    eout = constrain_ec(eout).reshape(Ne, G, Cg, d)
+    return jnp.einsum("gtec,egcd->gtd", combine.astype(dt), eout)
+
+
+def moe_mlp(cfg: MoEConfig, x: jax.Array, lp: dict, constrain_ec):
+    """Routed SwiGLU experts. x (B, S, d) -> (y, aux, drop_frac).
+
+    Routing happens independently within fixed-size token groups, so the
+    dense dispatch/combine tensors are (G, g, E, Cg) with Cg ∝ g/E —
+    total memory O(T·g·k·cf), linear in T. The expert buffers flatten
+    group slots into (E, G·Cg, d); ``constrain_ec`` pins them to the
+    ``ep`` mesh axis, where the dispatch einsum (token-sharded in,
+    expert-sharded out) becomes the all-to-all.
+    """
+    B, S, d = x.shape
+    dt = cfg.dtype
+    g, G, Cg = routing_groups(cfg, B * S)
     xg = x.reshape(G, g, d)
 
     logits = xg.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
@@ -221,19 +246,16 @@ def moe_mlp(cfg: MoEConfig, x: jax.Array, lp: dict, constrain_ec):
         lambda p: top_k_dispatch(p, cfg.top_k, Cg)
     )(probs)
 
-    ein = jnp.einsum("gtec,gtd->egcd", dispatch.astype(dt), xg)
-    ein = constrain_ec(ein.reshape(cfg.n_experts, G * Cg, d))
-    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", ein, wload(lp["we1"], dt)))
-    up = jnp.einsum("ecd,edf->ecf", ein, wload(lp["we3"], dt))
-    eout = jnp.einsum("ecf,efd->ecd", constrain_ec(gate * up),
-                      wload(lp["we2"], dt))
-    eout = constrain_ec(eout).reshape(cfg.n_experts, G, Cg, d)
-    y = jnp.einsum("gtec,egcd->gtd", combine.astype(dt), eout)
+    y = routed_expert_ffn(xg, dispatch, combine, lp, dt, constrain_ec)
     return y.reshape(B, S, d), jnp.mean(aux), jnp.mean(drop)
 
 
 def moe_layer_body(cfg: MoEConfig, x: jax.Array, lp: dict, cos, sin,
-                   constrain, constrain_ec, mesh=None):
+                   constrain, constrain_ec, mesh=None, mlp=None):
+    """One MoE block. ``mlp`` (default: the full-E :func:`moe_mlp`)
+    is the routed-FFN seam — ``(h, lp) -> (y, aux, drop)`` — so
+    manual-collective callers (the pp x ep pipeline) swap in their
+    expert-sharded variant without duplicating the attention half."""
     B, S, _ = x.shape
     nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     dt = cfg.dtype
@@ -249,7 +271,10 @@ def moe_layer_body(cfg: MoEConfig, x: jax.Array, lp: dict, cos, sin,
     x = constrain(x + attn @ lp["wo"].astype(dt))
 
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    y, aux, drop = moe_mlp(cfg, h, lp, constrain_ec)
+    if mlp is None:
+        y, aux, drop = moe_mlp(cfg, h, lp, constrain_ec)
+    else:
+        y, aux, drop = mlp(h, lp)
     x = constrain(x + y)
     return x, aux, drop
 

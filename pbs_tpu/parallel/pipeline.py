@@ -70,26 +70,35 @@ def pipeline_layer_specs(tp: bool = False) -> dict:
     }
 
 
-def pipeline_param_specs(cfg: TransformerConfig, tp: bool = False) -> dict:
-    """Full-tree specs: embed/head replicated (they run outside the
-    manual region, dp-sharded by activation), blocks stage-sharded."""
+def _full_tree_specs(layer_specs: dict) -> dict:
+    """Full-tree specs around any stage subtree: embed/head replicated
+    (they run outside the manual region, dp-sharded by activation),
+    blocks per the given layer specs — ONE copy for the dense and MoE
+    pipelines."""
     return {
         "embed": P(None, None),
-        "layers": pipeline_layer_specs(tp),
+        "layers": layer_specs,
         "final_norm": P(None),
         "head": P(None, None),
     }
 
 
-def shard_pipeline_params(params: dict, mesh: Mesh,
-                          cfg: TransformerConfig) -> dict:
-    tp = mesh.shape.get("tp", 1) > 1
+def _shard_by_specs(params: dict, mesh: Mesh, specs: dict) -> dict:
     shardings = jax.tree.map(
-        lambda spec: NamedSharding(mesh, spec),
-        pipeline_param_specs(cfg, tp),
+        lambda spec: NamedSharding(mesh, spec), specs,
         is_leaf=lambda x: isinstance(x, P),
     )
     return jax.tree.map(jax.device_put, params, shardings)
+
+
+def pipeline_param_specs(cfg: TransformerConfig, tp: bool = False) -> dict:
+    return _full_tree_specs(pipeline_layer_specs(tp))
+
+
+def shard_pipeline_params(params: dict, mesh: Mesh,
+                          cfg: TransformerConfig) -> dict:
+    tp = mesh.shape.get("tp", 1) > 1
+    return _shard_by_specs(params, mesh, pipeline_param_specs(cfg, tp))
 
 
 def _pipe_blocks(cfg: TransformerConfig, mesh: Mesh, n_micro: int):
@@ -226,3 +235,206 @@ def make_pipelined_train(
 
 def pipeline_batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("dp", None))
+
+
+# -- MoE pipeline: pp x ep (+dp) --------------------------------------------
+
+
+def moe_pipeline_layer_specs(ep: bool = False) -> dict:
+    """MoE stage subtree: layers stage-sharded on axis 0; with ``ep``
+    the expert tensors additionally shard over the ep axis. Attention
+    weights and the router replicate over ep (full-E routing is
+    recomputed per ep shard — cheap next to expert FLOPs — and the
+    expert combine is the one psum)."""
+    e = "ep" if ep else None
+    return {
+        "attn_norm": P("pp", None),
+        "wq": P("pp", None, None),
+        "wk": P("pp", None, None),
+        "wv": P("pp", None, None),
+        "wo": P("pp", None, None),
+        "mlp_norm": P("pp", None),
+        "router": P("pp", None, None),
+        "we1": P("pp", e, None, None),
+        "we3": P("pp", e, None, None),
+        "we2": P("pp", e, None, None),
+    }
+
+
+def _moe_pipe_blocks(cfg, mesh: Mesh, n_micro: int):
+    """shard_map'd pipelined MoE block-stack: (layers, xs) ->
+    (ys, aux (1,), drop (1,)). GPipe schedule identical to the dense
+    pipe; each stage runs full-E routing and its LOCAL expert shard,
+    psum-combining over ep. Bubble ticks are masked out of the aux
+    accumulation — they process garbage activations and their aux
+    would otherwise leak into the LOSS gradient."""
+    from pbs_tpu.models.moe import (
+        moe_layer_body,
+        routed_expert_ffn,
+        routing_groups,
+        top_k_dispatch,
+    )
+
+    pp = mesh.shape["pp"]
+    ep = mesh.shape.get("ep", 1)
+    if cfg.n_layers % pp != 0:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by pp={pp}"
+        )
+    if cfg.n_experts % ep != 0:
+        raise ValueError(
+            f"ep={ep} must divide n_experts={cfg.n_experts}"
+        )
+    if cfg.attn_impl != "xla":
+        raise ValueError(
+            "pipelined MoE stages support attn_impl='xla' only "
+            f"(got {cfg.attn_impl!r})"
+        )
+    el = cfg.n_experts // ep
+
+    def pipe(layers, xs):
+        idx = jax.lax.axis_index("pp")
+        S = xs.shape[2]
+        cos, sin = rope_tables(cfg, S)
+        dt = cfg.dtype
+
+        def sharded_ffn(h, lp):
+            # The ep-manual routed FFN behind moe_layer_body's mlp
+            # seam: full-E routing recomputed per shard (identical on
+            # every ep device), expert compute on the LOCAL slice,
+            # partial combines psum'd over ep.
+            B_, S_, _ = h.shape
+            g, G, Cg = routing_groups(cfg, B_ * S_)
+            xg = h.reshape(G, g, cfg.d_model)
+            logits = xg.astype(jnp.float32) @ lp["router"].astype(
+                jnp.float32)
+            probs = jax.nn.softmax(logits, axis=-1)
+            dispatch, combine, aux, drop = jax.vmap(
+                lambda p: top_k_dispatch(p, cfg.top_k, Cg)
+            )(probs)
+            if ep > 1:
+                e0 = jax.lax.axis_index("ep") * el
+                dispatch = jax.lax.dynamic_slice_in_dim(
+                    dispatch, e0, el, 2)
+                combine = jax.lax.dynamic_slice_in_dim(
+                    combine, e0, el, 2)
+            y = routed_expert_ffn(xg, dispatch, combine, lp, dt)
+            if ep > 1:
+                y = jax.lax.psum(y, "ep")
+            return (y.reshape(B_, S_, cfg.d_model), jnp.mean(aux),
+                    jnp.mean(drop))
+
+        def block(x, lp):
+            return moe_layer_body(
+                cfg, x, lp, cos, sin, lambda a: a, lambda a: a,
+                mesh=None, mlp=sharded_ffn)
+
+        def stage(x):
+            def scan_fn(carry, lp):
+                x, a, dr = carry
+                x, a2, d2 = block(x, lp)
+                return (x, a + a2, dr + d2), None
+
+            (x, a, dr), _ = jax.lax.scan(
+                jax.checkpoint(scan_fn), (x, 0.0, 0.0), layers)
+            return x, a, dr
+
+        perm = [(i, i + 1) for i in range(pp - 1)]
+        state = jnp.zeros_like(xs[0])
+        outs = jnp.zeros_like(xs)
+        aux_acc = 0.0
+        drop_acc = 0.0
+        for t in range(n_micro + pp - 1):  # static GPipe schedule
+            x_in = jnp.where(idx == 0, xs[min(t, n_micro - 1)], state)
+            y, a, dr = stage(x_in)
+            active = jnp.logical_and(t - idx >= 0, t - idx < n_micro)
+            aux_acc = aux_acc + jnp.where(active, a, 0.0)
+            drop_acc = drop_acc + jnp.where(active, dr, 0.0)
+            if t >= pp - 1:
+                outs = outs.at[t - pp + 1].set(y)
+            if perm:
+                state = jax.lax.ppermute(y, "pp", perm)
+        # Sum over stages = sum over ALL layers x microbatches; the
+        # ep shards computed identical full-E routing, so no ep sum.
+        aux_tot = jax.lax.psum(aux_acc, "pp")
+        drop_tot = jax.lax.psum(drop_acc, "pp")
+        return (outs, jnp.reshape(aux_tot, (1,)),
+                jnp.reshape(drop_tot, (1,)))
+
+    kwargs = dict(
+        mesh=mesh,
+        in_specs=(moe_pipeline_layer_specs(ep > 1),
+                  P(None, "dp", None, None)),
+        out_specs=(P("pp", "dp", None, None), P("dp"), P("dp")),
+    )
+    try:
+        return shard_map(pipe, check_vma=False, **kwargs)
+    except TypeError:  # pragma: no cover - older jax
+        return shard_map(pipe, check_rep=False, **kwargs)
+
+
+def make_pipelined_moe_train(
+    cfg,
+    mesh: Mesh,
+    n_micro: int = 4,
+    learning_rate: float = 3e-4,
+    key: jax.Array | None = None,
+):
+    """dp x pp x ep MoE train state + jitted step. Loss = token xent
+    + aux_loss_weight * load-balance aux (bubble-masked, normalized
+    per layer per microbatch, matching ``moe_loss`` semantics when
+    routing groups align — dropless mode or group size dividing the
+    per-microbatch token count)."""
+    import optax
+
+    from pbs_tpu.models.moe import init_moe_params
+    from pbs_tpu.models.transformer import (
+        rms_norm as _rms,
+        token_xent as _xent,
+    )
+
+    key = key if key is not None else jax.random.PRNGKey(0)
+    pipe = _moe_pipe_blocks(cfg, mesh, n_micro)
+    mb_spec = NamedSharding(mesh, P(None, "dp", None, None))
+    tx = default_optimizer(learning_rate)
+
+    def loss_fn(params, tokens):
+        B, S_full = tokens.shape
+        inp = tokens[:, :-1]
+        S = S_full - 1
+        if B % n_micro != 0:
+            raise ValueError(f"batch {B} not divisible by M={n_micro}")
+        mb = B // n_micro
+        dt = cfg.dtype
+        x = params["embed"].astype(dt)[inp]
+        xs = jax.lax.with_sharding_constraint(
+            x.reshape(n_micro, mb, S, cfg.d_model), mb_spec
+        )
+        ys, aux_v, drop_v = pipe(params["layers"], xs)
+        y = ys[-n_micro:].reshape(B, S, cfg.d_model)
+        y = _rms(y, params["final_norm"], cfg.norm_eps)
+        logits = (y @ params["head"].astype(dt)).astype(jnp.float32)
+        lm = _xent(logits, tokens[:, 1:])
+        aux = jnp.mean(aux_v) / (cfg.n_layers * n_micro)
+        drop = jnp.mean(drop_v) / (cfg.n_layers * n_micro)
+        return lm + cfg.aux_loss_weight * aux, (lm, aux, drop)
+
+    def train_step(state, tokens):
+        params, opt_state, step = state
+        (_, (lm, aux, drop)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, tokens)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        ntok = tokens.shape[0] * (tokens.shape[1] - 1)
+        return (params, opt_state, step + 1), {
+            "loss": lm, "aux_loss": aux, "moe_drop_frac": drop,
+            "tokens": jnp.asarray(ntok, jnp.int32),
+        }
+
+    specs = _full_tree_specs(
+        moe_pipeline_layer_specs(mesh.shape.get("ep", 1) > 1))
+    params = _shard_by_specs(init_moe_params(cfg, key), mesh, specs)
+    opt_state = jax.jit(tx.init)(params)
+    state = (params, opt_state, jax.device_put(0))
+    step = jax.jit(train_step, donate_argnums=(0,))
+    return state, step

@@ -112,6 +112,64 @@ def test_pipelined_tp_guards():
         _pipe_blocks(pallas_cfg, mesh2, 2)
 
 
+def test_pipelined_moe_train_matches_single_device():
+    """dp2 x pp2 x ep2: the MoE family through the GPipe schedule with
+    expert-sharded stages and psum combine. Dropless routing with the
+    group size pinned to one row makes routing groups identical
+    between the microbatched and single-program paths, so THREE
+    optimizer steps must track the single-device MoE reference
+    exactly (loss includes the bubble-masked aux term — a leak of
+    garbage-tick aux into the gradient shows up here)."""
+    from pbs_tpu.models import MoEConfig, init_moe_params
+    from pbs_tpu.models.moe import make_moe_train_step
+    from pbs_tpu.parallel import make_mesh
+    from pbs_tpu.parallel.pipeline import (
+        make_pipelined_moe_train,
+        pipeline_batch_sharding,
+    )
+
+    mcfg = MoEConfig(
+        vocab=128, d_model=64, n_layers=4, n_heads=4, n_kv_heads=2,
+        d_ff=96, max_seq=64, dtype=jnp.float32, n_experts=4, top_k=2,
+        dropless=True, router_group_size=31,  # = S-1: one row per group
+    )
+    mesh = make_mesh({"dp": 2, "pp": 2, "ep": 2})
+    state, step = make_pipelined_moe_train(mcfg, mesh, n_micro=2,
+                                           learning_rate=1e-2)
+
+    params = init_moe_params(mcfg, jax.random.PRNGKey(0))
+    init_opt, step_single = make_moe_train_step(mcfg, learning_rate=1e-2)
+    state_single = (params, init_opt(params), 0)
+    step_single = jax.jit(step_single)
+
+    batch = jax.random.randint(
+        jax.random.PRNGKey(3), (4, 32), 0, mcfg.vocab)
+    sharded = jax.device_put(batch, pipeline_batch_sharding(mesh))
+    for i in range(3):
+        state, m = step(state, sharded)
+        state_single, ms = step_single(state_single, batch)
+        np.testing.assert_allclose(
+            float(m["loss"]), float(ms["loss"]), rtol=2e-4)
+        np.testing.assert_allclose(
+            float(m["aux_loss"]), float(ms["aux_loss"]), rtol=2e-3)
+        # exactly-zero drops up to fp32 accumulation noise across the
+        # masked tick sum + psum
+        assert abs(float(m["moe_drop_frac"])) < 1e-6
+
+
+def test_pipelined_moe_guards():
+    from pbs_tpu.models import MoEConfig
+    from pbs_tpu.parallel import make_mesh
+    from pbs_tpu.parallel.pipeline import _moe_pipe_blocks
+
+    mcfg = MoEConfig(
+        vocab=128, d_model=64, n_layers=4, n_heads=4, n_kv_heads=2,
+        d_ff=96, max_seq=64, dtype=jnp.float32, n_experts=4, top_k=2)
+    mesh = make_mesh({"dp": 1, "pp": 1, "ep": 8})
+    with pytest.raises(ValueError, match="must divide n_experts"):
+        _moe_pipe_blocks(mcfg, mesh, 2)
+
+
 def test_bad_divisibility_raises():
     from pbs_tpu.parallel.pipeline import make_pipelined_loss, _pipe_blocks
     from pbs_tpu.parallel import make_mesh
