@@ -71,10 +71,21 @@ try:
     # (the plugin usually raises UNAVAILABLE after ~15-25 min, but
     # parked waiters have been observed >40 min with no raise).
     # Longer than the plugin's own raise so the clean-raise path wins
-    # when it works; the grace window below removes the
+    # when it works; the grace window below narrows the
     # kill-a-holder race (see _waiter_watchdog).
     SELF_EXIT_S = _float_env("PBST_BENCH_SELF_EXIT_S", 2400.0)
     SELF_EXIT_GRACE_S = _float_env("PBST_BENCH_SELF_EXIT_GRACE_S", 300.0)
+    # Probe-scaled self-exit (round-5): once the PARENT has declared
+    # claim-unavailable (it writes a sentinel file), the worker is a
+    # waiter by definition and its continued parking serves nobody —
+    # it only keeps a client on the lease (docs/OPS.md: connection
+    # attempts refresh the hold).  On seeing the sentinel the watchdog
+    # drops to this short grace instead of the 2400 s backstop, so a
+    # red probe leaves ZERO clients within ~5 min of launch.  The
+    # grace is ~7x the worst observed acquire->devices() latency
+    # (~30 s), protecting a lease granted just after the probe expired
+    # from a mid-init exit (the same reasoning as SELF_EXIT_GRACE_S).
+    PROBE_EXIT_GRACE_S = _float_env("PBST_BENCH_PROBE_EXIT_GRACE_S", 210.0)
     RETRY_SLEEP_S = _float_env("PBST_BENCH_RETRY_SLEEP_S", 10.0)
 except SystemExit as e:
     if __name__ == "__main__" and "--worker" not in sys.argv:
@@ -165,22 +176,51 @@ def main() -> None:
     import threading
 
     backend_ready = threading.Event()
+    # Sentinel path the parent writes when ITS claim probe declares
+    # claim-unavailable; unset when the worker runs standalone.
+    probe_sentinel = os.environ.get("PBST_BENCH_PROBE_SENTINEL")
 
     def _waiter_watchdog():
-        if backend_ready.wait(SELF_EXIT_S):
-            return
-        sys.stderr.write(
-            f"[bench] no backend within {SELF_EXIT_S:.0f}s; self-exit "
-            f"in {SELF_EXIT_GRACE_S:.0f}s unless the backend comes up\n")
-        sys.stderr.flush()
-        if backend_ready.wait(SELF_EXIT_GRACE_S):
-            return
-        sys.stderr.write(
-            f"[bench] claim-unavailable self-exit: no backend within "
-            f"{SELF_EXIT_S + SELF_EXIT_GRACE_S:.0f}s (waiter, never "
-            "acquired)\n")
-        sys.stderr.flush()
-        os._exit(3)
+        t0 = time.monotonic()
+        warned_long = False
+        probe_seen_at = None
+        while not backend_ready.is_set():
+            now = time.monotonic() - t0
+            if (probe_sentinel and probe_seen_at is None
+                    and os.path.exists(probe_sentinel)):
+                probe_seen_at = now
+                sys.stderr.write(
+                    f"[bench] parent declared claim-unavailable "
+                    f"(sentinel {probe_sentinel}); self-exit in "
+                    f"{PROBE_EXIT_GRACE_S:.0f}s unless the backend "
+                    "comes up\n")
+                sys.stderr.flush()
+            if (probe_seen_at is not None
+                    and now - probe_seen_at >= PROBE_EXIT_GRACE_S):
+                sys.stderr.write(
+                    "[bench] claim-unavailable self-exit (probe "
+                    f"sentinel + {PROBE_EXIT_GRACE_S:.0f}s grace; "
+                    "waiter, never acquired)\n")
+                sys.stderr.flush()
+                os._exit(3)
+            if now >= SELF_EXIT_S:
+                if not warned_long:
+                    warned_long = True
+                    sys.stderr.write(
+                        f"[bench] no backend within {SELF_EXIT_S:.0f}s; "
+                        f"self-exit in {SELF_EXIT_GRACE_S:.0f}s unless "
+                        "the backend comes up\n")
+                    sys.stderr.flush()
+                if now >= SELF_EXIT_S + SELF_EXIT_GRACE_S:
+                    sys.stderr.write(
+                        "[bench] claim-unavailable self-exit: no "
+                        f"backend within "
+                        f"{SELF_EXIT_S + SELF_EXIT_GRACE_S:.0f}s "
+                        "(waiter, never acquired)\n")
+                    sys.stderr.flush()
+                    os._exit(3)
+            if backend_ready.wait(2.0):
+                return
 
     threading.Thread(target=_waiter_watchdog, daemon=True).start()
     _mark("importing jax")
@@ -371,6 +411,12 @@ def _supervise() -> None:
             outpath = outf.name
         timed_out = False
         claim_unavailable = False
+        # Probe sentinel: written by THIS parent if its claim probe
+        # declares claim-unavailable; the worker's watchdog polls for
+        # it and self-exits within ~PROBE_EXIT_GRACE_S instead of
+        # parking for the 2400 s backstop (round-4 left 25-45 min
+        # residual waiters that kept a client on the held lease).
+        sentinel_path = errpath + ".halt"
         with open(errpath, "w") as ef, open(outpath, "w") as of, \
                 open(errpath, "rb") as tailf:
             proc = subprocess.Popen(
@@ -378,6 +424,8 @@ def _supervise() -> None:
                 stdout=of,
                 stderr=ef,
                 cwd=os.path.dirname(os.path.abspath(__file__)),
+                env={**os.environ,
+                     "PBST_BENCH_PROBE_SENTINEL": sentinel_path},
             )
             t_start = time.monotonic()
             acquired = False
@@ -422,14 +470,23 @@ def _supervise() -> None:
         with open(outpath, "r", errors="replace") as f:
             out = f.read()
         if claim_unavailable:
+            # Tell the worker the verdict: it is a waiter by
+            # definition now, and its watchdog drops to the short
+            # probe grace the moment it sees this file.
+            try:
+                with open(sentinel_path, "w") as f:
+                    f.write("claim-unavailable declared by bench.py "
+                            "supervisor\n")
+            except OSError:
+                pass  # worker falls back to the long watchdog
             last_err = (
                 f"claim-unavailable: no TPU backend within "
                 f"{CLAIM_PROBE_S:.0f}s — the chip claim is held "
-                f"elsewhere (worker pid {proc.pid} left waiting; it "
-                "self-exits on its own UNAVAILABLE or the "
-                f"{SELF_EXIT_S + SELF_EXIT_GRACE_S:.0f}s waiter "
-                "watchdog; do not start another TPU client until "
-                f"then; stderr={errpath})"
+                f"elsewhere (worker pid {proc.pid} left waiting; the "
+                f"probe sentinel asks it to self-exit within "
+                f"~{PROBE_EXIT_GRACE_S:.0f}s — or sooner via its own "
+                "UNAVAILABLE raise; do not start another TPU client "
+                f"until then; stderr={errpath})"
             )
         elif timed_out:
             marks = [ln.strip() for ln in err_text.splitlines()

@@ -163,6 +163,56 @@ def test_bad_seconds_knob_still_prints_json():
     assert result["value"] == 0.0
 
 
+def test_probe_writes_sentinel_and_worker_can_see_it(tmp_path):
+    """Round-5: on claim-unavailable the parent writes a sentinel file
+    (path passed to the worker via PBST_BENCH_PROBE_SENTINEL) so the
+    worker can self-exit within the short probe grace instead of the
+    2400 s backstop.  The stub worker proves the env is plumbed and
+    the file appears while the worker is still alive."""
+    result, proc, dt = _run_supervisor(
+        tmp_path,
+        "import os, sys, time\n"
+        "sys.stderr.write('[bench +  0.0s] importing jax\\n')\n"
+        "sys.stderr.flush()\n"
+        "p = os.environ['PBST_BENCH_PROBE_SENTINEL']\n"
+        "d = os.environ['PBST_STUB_DIR']\n"
+        "for _ in range(100):\n"  # park past the 6 s probe
+        "    if os.path.exists(p):\n"
+        "        open(d + '/saw_sentinel', 'w').write('1')\n"
+        "        break\n"
+        "    time.sleep(0.3)\n",
+        {})
+    assert "claim-unavailable" in result["error"]
+    assert "probe sentinel" in result["error"]
+    deadline = time.time() + 20
+    marker = tmp_path / "saw_sentinel"
+    while time.time() < deadline and not marker.exists():
+        time.sleep(0.3)
+    assert marker.exists(), "sentinel never reached the worker"
+
+
+def test_worker_probe_sentinel_self_exit(tmp_path):
+    """The REAL worker with a pre-existing sentinel and a 0 s probe
+    grace must self-exit(3) before touching any backend — proving the
+    probe-scaled path is armed before the first backend touch and is
+    independent of the long watchdog (set far away here)."""
+    sentinel = tmp_path / "halt"
+    sentinel.write_text("claim-unavailable declared by test\n")
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PBST_BENCH_")}
+    env.update({
+        "PBST_BENCH_TINY": "1",
+        "PBST_BENCH_PROBE_SENTINEL": str(sentinel),
+        "PBST_BENCH_PROBE_EXIT_GRACE_S": "0",
+        "PBST_BENCH_SELF_EXIT_S": "3600",
+    })
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--worker"], capture_output=True,
+        text=True, timeout=120, env=env, cwd=REPO)
+    assert proc.returncode == 3, proc.stderr[-500:]
+    assert "claim-unavailable self-exit (probe" in proc.stderr
+
+
 def test_worker_waiter_watchdog_self_exits():
     """The REAL worker (tiny mode) with a 0-second self-exit window
     must os._exit(3) with the claim-unavailable marker — proving the

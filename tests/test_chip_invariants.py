@@ -30,6 +30,24 @@ CHIP_PY = sorted(
 )
 
 
+def _root_jax_importers():
+    """Every repo-root .py that imports jax at MODULE scope — each one
+    becomes a chip client the moment it runs under the ambient axon
+    session, whatever its own intent (round-4 incident: a smoke run of
+    __graft_entry__ became a 24-min TPU waiter because its platform
+    pin used os.environ.setdefault, a no-op under the session's
+    JAX_PLATFORMS=axon export).  The old scan set (bench*/chip_*) did
+    not include the one file that actually misfired; this derives the
+    set from the property that matters instead of from filenames."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(REPO, "*.py"))):
+        for ln in _lines(path):
+            if re.match(r"(import jax\b|from jax(\.| import))", ln):
+                out.append(path)
+                break
+    return out
+
+
 def _lines(path):
     with open(path) as f:
         return f.read().splitlines()
@@ -68,6 +86,62 @@ def test_no_signals_in_shell_scripts():
             )
 
 
+def test_root_jax_importers_are_in_scope():
+    """The derived scan set must cover the known fleet — and pick up
+    __graft_entry__.py, the file the r4 incident proved was outside
+    the old filename globs."""
+    names = {os.path.basename(p) for p in _root_jax_importers()}
+    assert "__graft_entry__.py" in names, names
+    assert "chip_probe.py" in names, names
+
+
+def test_no_env_var_platform_pins():
+    """Platform pinning via environment variables is FORBIDDEN in every
+    repo-root jax importer and chip script: the ambient axon plugin
+    monkeypatches jax backend resolution and ignores JAX_PLATFORMS, and
+    `os.environ.setdefault("JAX_PLATFORMS", "cpu")` is additionally a
+    no-op under the session's JAX_PLATFORMS=axon export — the exact
+    combination that turned a CPU smoke run into a chip waiter at
+    16:46 on Jul 31 (docs/ROUND4.md).  The only reliable pin is
+    `jax.config.update("jax_platforms", "cpu")` before the first
+    backend touch (docs/OPS.md)."""
+    pat = re.compile(
+        r"""setdefault\(\s*['"]JAX_PLATFORMS|"""
+        r"""environ\[\s*['"]JAX_PLATFORMS['"]\s*\]\s*="""
+    )
+    for path in sorted(set(CHIP_PY) | set(_root_jax_importers())):
+        for i, ln in enumerate(_lines(path), 1):
+            code = ln.split("#", 1)[0]
+            assert not pat.search(code), (
+                f"{os.path.basename(path)}:{i} pins the platform via "
+                f"an env var (unreliable under axon; use jax.config."
+                f"update('jax_platforms', ...)): {ln.strip()!r}"
+            )
+
+
+def test_non_chip_entry_points_pin_via_jax_config():
+    """Repo-root jax importers that are NOT declared chip clients
+    (bench*/chip_* touch the chip by design) must carry at least one
+    `jax.config.update("jax_platforms", "cpu")` pin for their CPU
+    paths — the recipe tests/conftest.py and docs/OPS.md prescribe."""
+    chip_clients = set(CHIP_PY)
+    pin = re.compile(
+        r"""jax\.config\.update\(\s*['"]jax_platforms['"]""")
+    checked = 0
+    for path in _root_jax_importers():
+        if path in chip_clients:
+            continue
+        checked += 1
+        text = "\n".join(_lines(path))
+        assert pin.search(text), (
+            f"{os.path.basename(path)} imports jax at module scope but "
+            "never pins jax_platforms via jax.config.update — under the "
+            "ambient axon session any backend touch becomes a chip "
+            "client (docs/OPS.md)"
+        )
+    assert checked >= 1  # __graft_entry__.py at minimum
+
+
 def test_no_signals_in_chip_python():
     """The python chip clients/supervisors must never signal anything:
     bench.py's parent orphans on deadline, workers self-exit only via
@@ -76,7 +150,7 @@ def test_no_signals_in_chip_python():
         r"\.kill\(|\.terminate\(|\.send_signal\(|os\.kill\(|"
         r"signal\.SIGKILL|signal\.SIGTERM|subprocess\.run\([^)]*kill"
     )
-    for path in CHIP_PY:
+    for path in sorted(set(CHIP_PY) | set(_root_jax_importers())):
         for i, ln in enumerate(_lines(path), 1):
             code = ln.split("#", 1)[0]
             assert not forbidden.search(code), (
