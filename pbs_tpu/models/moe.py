@@ -199,6 +199,25 @@ def routing_groups(cfg: MoEConfig, T: int) -> tuple[int, int, int]:
         # O(g²·E) guard on the single-group fallback.
         bound = min(g if g > 0 else T, cfg.dropless_group_max, T)
         g = next(d_ for d_ in range(bound, 0, -1) if T % d_ == 0)
+        # The divisor search is CORRECT at any T but degenerates for
+        # token counts with no usable divisor (e.g. prime T > bound:
+        # g collapses to 1 → T single-token routing groups, a severe
+        # dispatch/vmap cliff). That tiling must never be silent: the
+        # caller should pad/reshape its token count to something
+        # composite (batch*seq is normally a power of two; odd T only
+        # arises from unusual slicing).
+        if g * 4 < bound:
+            import warnings
+
+            warnings.warn(
+                f"dropless auto-tiling picked group size {g} for "
+                f"T={T} tokens (bound {bound}): T has no divisor near "
+                "the configured group size, so routing will run "
+                f"{T // g} tiny groups — a large dispatch overhead. "
+                "Pad the token count to a composite size (e.g. a "
+                "multiple of router_group_size).",
+                stacklevel=2,
+            )
     elif g <= 0 or T % g != 0:
         g = T  # single group (tiny shapes / tests)
     return g, T // g, cfg.capacity(g)

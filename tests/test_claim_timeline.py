@@ -30,7 +30,8 @@ def test_merges_sorts_and_dedupes(tmp_path):
                         "[runner + 100.0s 10:01:45] claim acquired\n",
         "queue_1.log": "[chip_queue 09:59:00] stage 1: headline\n",
     })
-    lines = [ln for ln in out.splitlines() if ln.strip()]
+    lines = [ln for ln in out.splitlines()
+             if ln.strip() and not ln.startswith("=== ")]
     # chronological: queue 09:59 first, runner acquire last
     assert "09:59:00" in lines[0]
     assert "claim acquired" in lines[-1]
@@ -38,6 +39,28 @@ def test_merges_sorts_and_dedupes(tmp_path):
     assert out.count("knocking") == 1
     # unstamped continuation attached, indented
     assert any("| some traceback line" in ln for ln in lines)
+
+
+def test_same_second_events_from_different_days_not_collapsed(tmp_path):
+    """The stamps carry no date, so the file's mtime date joins the
+    dedup key: two genuinely distinct events with identical
+    (HH:MM:SS, msg) from different DAYS must both render (the old
+    key silently dropped one from the audit trail), while same-day
+    duplicates (nohup vs tee) still collapse."""
+    d = tmp_path / "logs"
+    d.mkdir()
+    a = d / "supervise_day1.log"
+    b = d / "supervise_day2.log"
+    a.write_text("[supervise 10:00:01] knocking\n")
+    b.write_text("[supervise 10:00:01] knocking\n")
+    day1 = 1_700_000_000  # two distinct mtime dates
+    os.utime(a, (day1, day1))
+    os.utime(b, (day1 + 86400 * 3, day1 + 86400 * 3))
+    proc = subprocess.run([sys.executable, TOOL, str(d)],
+                          capture_output=True, text=True, timeout=30)
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.count("knocking") == 2
+    assert proc.stdout.count("=== ") == 2  # one header per day
 
 
 def test_handles_empty_dir(tmp_path):

@@ -154,6 +154,26 @@ def test_dropless_group_guard_and_auto_tiling():
     assert float(drop2) == 0.0
 
 
+def test_dropless_degenerate_tiling_warns():
+    """A token count with no usable divisor (prime T > bound) collapses
+    the auto-tiled group size toward 1 — still correct, but a severe
+    dispatch cliff that must be announced, not silent (ADVICE r4)."""
+    import warnings
+
+    from pbs_tpu.models.moe import routing_groups
+
+    cfg = MoEConfig(**{**TINY.__dict__, "dropless": True,
+                       "router_group_size": 512})
+    with pytest.warns(UserWarning, match="no divisor near"):
+        g, G, Cg = routing_groups(cfg, 1031)  # prime > 512
+    assert g == 1 and G == 1031 and Cg == 1
+    # Composite T near the group size: silent, healthy tiling.
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        g, G, _ = routing_groups(cfg, 1536)
+    assert g == 512 and G == 3
+
+
 def test_moe_forward_shapes_and_causality():
     params = init_moe_params(TINY, jax.random.PRNGKey(0))
     t1 = toks()

@@ -10,8 +10,14 @@ claim window correctly" story is auditable line by line.
 Usage: python tools/claim_timeline.py [chip_logs_dir]
 Lines without a parseable timestamp are kept, attached to the file's
 previous stamped line (indented), so tracebacks stay in context.
-Stamps are HH:MM:SS (no date): archive or prune chip_logs/ between
-rounds if a single-day view is needed.
+Stamps are HH:MM:SS (no date): the file's mtime DATE joins the dedup
+key and the sort, so identical messages at the same wall-clock second
+from different days render as distinct events rather than silently
+collapsing (they collapse only when carried by same-day duplicate
+files — the intended nohup-vs-tee case). Caveat: a multi-day
+aggregate file carries one mtime date, so its early-day copies can
+render twice; archive or prune chip_logs/ between rounds for a
+clean single-day view.
 """
 
 from __future__ import annotations
@@ -20,6 +26,7 @@ import glob
 import os
 import re
 import sys
+import time
 
 # [supervise 17:16:37] msg   /  [chip_queue 03:21:11] msg
 _TAGGED = re.compile(r"^\[(\w[\w .]*?) (\d\d:\d\d:\d\d)\] (.*)$")
@@ -52,28 +59,38 @@ def main() -> int:
         "chip_logs")
     events = []
     for path in sorted(glob.glob(os.path.join(d, "*.log"))):
+        # The stamp has no date; the file's mtime date stands in for it
+        # in the sort and the dedup key so a genuinely distinct event
+        # from ANOTHER day with the same (HH:MM:SS, msg) is not
+        # silently dropped from what is meant to be an audit trail.
+        try:
+            day = time.strftime("%Y-%m-%d",
+                                time.localtime(os.path.getmtime(path)))
+        except OSError:
+            day = "????-??-??"
         for ts, src, msg, cont in parse_file(path):
-            # File mtime breaks HH:MM:SS ties across midnight poorly;
-            # within one round the wall clock is monotone enough, and
-            # the source column disambiguates the rest.
-            events.append((ts, src, msg, cont))
-    events.sort(key=lambda e: e[0] or "99")
+            events.append((day, ts, src, msg, cont))
+    events.sort(key=lambda e: (e[0], e[1] or "99"))
     # nohup capture files duplicate the tee'd session logs: collapse
-    # identical (ts, msg) pairs regardless of which file carried them,
-    # keeping whichever copy carries MORE continuation lines (the
+    # identical (day, ts, msg) triples regardless of which file carried
+    # them, keeping whichever copy carries MORE continuation lines (the
     # aggregate file often has the traceback the per-run file lacks).
     by_key: dict = {}
     order = []
     for e in events:
-        key = (e[0], e[2])
+        key = (e[0], e[1], e[3])
         if key not in by_key:
             by_key[key] = e
             order.append(key)
-        elif len(e[3]) > len(by_key[key][3]):
+        elif len(e[4]) > len(by_key[key][4]):
             by_key[key] = e
     events = [by_key[k] for k in order]
-    width = max((len(e[1]) for e in events), default=10)
-    for ts, src, msg, cont in events:
+    width = max((len(e[2]) for e in events), default=10)
+    last_day = None
+    for day, ts, src, msg, cont in events:
+        if day != last_day:
+            print(f"=== {day} ===")
+            last_day = day
         print(f"{ts or '--:--:--'}  {src:<{width}}  {msg}")
         for c in cont[:3]:  # keep tracebacks short; the file has it all
             print(f"{'':>10}{'':<{width}}  | {c.strip()}")
