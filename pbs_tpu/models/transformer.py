@@ -214,7 +214,7 @@ def causal_attention(
 
 def layer_body(cfg: TransformerConfig, x: jax.Array, lp: dict,
                cos: jax.Array, sin: jax.Array, constrain,
-               mesh=None, reduce=None) -> jax.Array:
+               mesh=None, reduce=None, attn=None) -> jax.Array:
     """One transformer block. ``constrain`` re-applies the activation
     sharding between ops (sequence-parallel residual stream).
 
@@ -225,7 +225,14 @@ def layer_body(cfg: TransformerConfig, x: jax.Array, lp: dict,
     (pbs_tpu/parallel/pipeline._pipe_blocks); under annotation-driven
     sharding XLA inserts the same collectives itself and the default
     applies. Head reshapes use -1 so the body works on tp SHARDS
-    (n_heads/tp local heads) as well as full weights."""
+    (n_heads/tp local heads) as well as full weights.
+
+    ``attn`` (default: dispatch on ``cfg.attn_impl`` via
+    :func:`causal_attention`) is the attention seam — ``(q, k, v) ->
+    out``, all (B, S, H, hd) — for callers already inside a manual
+    ``shard_map`` region: the ring/ulysses impls wrap their own
+    shard_map (illegal to nest), so the pp pipeline passes their
+    per-device bodies here with its own mesh axes in scope."""
     B, S, _ = x.shape
     hd = cfg.head_dim
     dt = cfg.dtype
@@ -237,8 +244,11 @@ def layer_body(cfg: TransformerConfig, x: jax.Array, lp: dict,
     k = (h @ lp["wk"].astype(dt)).reshape(B, S, -1, hd)
     v = (h @ lp["wv"].astype(dt)).reshape(B, S, -1, hd)
     q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
-    attn = causal_attention(q, k, v, cfg, mesh).reshape(B, S, -1)
-    x = constrain(x + reduce(attn @ lp["wo"].astype(dt)))
+    if attn is None:
+        a = causal_attention(q, k, v, cfg, mesh)
+    else:
+        a = attn(q, k, v)
+    x = constrain(x + reduce(a.reshape(B, S, -1) @ lp["wo"].astype(dt)))
 
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     gate = jax.nn.silu(h @ lp["w1"].astype(dt))
