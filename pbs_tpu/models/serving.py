@@ -295,11 +295,13 @@ class ContinuousBatcher:
         # prompt's TTFT). A hit installs the KV into the slot and
         # samples the first token from the cached logits — zero
         # prefill compute. 0 = off.
-        if prefix_cache_size and mesh is not None:
-            raise ValueError(
-                "prefix_cache_size is not supported with a serving "
-                "mesh yet (cached windows would need resharding); "
-                "serve prefix-cached tenants single-device")
+        # Under a tp serving mesh the cached windows are sliced from
+        # the tp-sharded slot cache, so they arrive ALREADY sharded
+        # over the kv heads (the sliced dims — layer/slot/seq — are
+        # unsharded); _install re-pins the canonical layout with a
+        # sharding constraint below, so hits keep the KV on-device and
+        # tp-aligned (r5: the former mesh restriction is lifted — tp
+        # serving no longer loses the TTFT optimization).
         self.prefix_cache_size = prefix_cache_size
         self._prefix_cache: "OrderedDict[bytes, dict]" = OrderedDict()
         self.prefix_hits = 0
@@ -321,15 +323,32 @@ class ContinuousBatcher:
                             self.temperature)[0]
             return first, last_logits, cache, extra
 
+        if mesh is not None:
+            import jax.sharding as _jsh
+
+            _kv_sharding = _jsh.NamedSharding(
+                mesh, _jsh.PartitionSpec(None, None, None, "tp", None))
+        else:
+            _kv_sharding = None
+
         @jax.jit
         def _install(cache, slot, kwin, vwin, plen):
             """Prefix-cache hit: write the cached prompt-window KV
-            (L, 1, bucket, nkv, hd) into ``slot``; no forward at all."""
+            (L, 1, bucket, nkv, hd) into ``slot``; no forward at all.
+            Under a tp mesh the constraint pins the updated slabs back
+            to the canonical kv-head sharding (the window arrives
+            sharded the same way — the constraint is a no-op reshard
+            in the common case, a guard against layout drift always)."""
             cache = dict(cache)
-            cache["k"] = jax.lax.dynamic_update_slice(
+            k = jax.lax.dynamic_update_slice(
                 cache["k"], kwin, (0, slot, 0, 0, 0))
-            cache["v"] = jax.lax.dynamic_update_slice(
+            v = jax.lax.dynamic_update_slice(
                 cache["v"], vwin, (0, slot, 0, 0, 0))
+            if _kv_sharding is not None:
+                k = jax.lax.with_sharding_constraint(k, _kv_sharding)
+                v = jax.lax.with_sharding_constraint(v, _kv_sharding)
+            cache["k"] = k
+            cache["v"] = v
             cache["pos"] = cache["pos"].at[slot].set(plen)
             return cache
 

@@ -227,6 +227,36 @@ def test_prefix_cache_token_exact_and_skips_prefill():
     assert eng.stats()["prefix_hits"] == 1
 
 
+def test_prefix_cache_on_tp_mesh_token_exact(model):
+    """r5: prefix cache composes with tp serving (the restriction is
+    lifted). The cached window slices stay tp-sharded on device; a hit
+    installs with zero prefill dispatches and the greedy completion is
+    token-exact against the single-device gold."""
+    from pbs_tpu.parallel import make_mesh
+
+    cfg, params = model
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    eng = ContinuousBatcher(cfg, params, n_slots=2, prompt_bucket=16,
+                            mesh=mesh, prefix_cache_size=4)
+    prompt = [3, 1, 4]
+    gold = _gold(cfg, params, prompt, 6)
+
+    def run_one():
+        rid = eng.submit(prompt, max_new_tokens=6)
+        out = []
+        while not out:
+            out = [c for c in eng.step() if c.request_id == rid]
+        return out[0].tokens
+
+    t1 = run_one()
+    assert t1 == gold
+    assert eng.prefill_count == 1 and eng.prefix_hits == 0
+    t2 = run_one()
+    assert t2 == gold  # token-exact from the sharded cached window
+    assert eng.prefill_count == 1  # hit: no second prefill dispatch
+    assert eng.prefix_hits == 1
+
+
 def test_prefix_cache_lru_eviction():
     cfg = TransformerConfig(**TINY)
     params = init_params(cfg, jax.random.PRNGKey(0))
