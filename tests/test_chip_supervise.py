@@ -162,3 +162,51 @@ def test_oneshot_validates_and_makes_single_attempt(tmp_path):
     assert logs.count("runner attempt 1 (foreground") == 1
     assert "runner attempt 2 (foreground" not in logs
     assert "past the queue deadline" in logs
+
+
+def test_oneshot_driver_exclusion_window(tmp_path):
+    """r5 (VERDICT r4 weak-1): with the driver's bench epoch known, a
+    knock whose worst-case park would end inside the exclusion window
+    is REFUSED before any chip contact; a safely-early knock is not.
+    The r4 incident shape — knock 80 min before the bench — must be
+    rejected by default knobs."""
+    qdir = _setup(tmp_path, "echo UNAVAILABLE; exit 1\n")
+    dst = qdir / "chip_oneshot.sh"
+    dst.write_bytes(open(os.path.join(REPO, "chip_oneshot.sh"), "rb").read())
+    os.chmod(dst, 0o755)
+    now = int(time.time())
+
+    # The r4 shape: not_after ~80 min before the bench -> refused.
+    env = dict(os.environ)
+    env["PBST_DRIVER_BENCH_EPOCH"] = str(now + 80 * 60)
+    proc = subprocess.run(
+        ["bash", str(dst), str(now), str(now + 60)],
+        capture_output=True, text=True, timeout=30, env=env,
+        cwd=str(qdir))
+    assert proc.returncode == 3, proc.stderr
+    assert "REFUSED" in proc.stderr
+    assert "exclusion window" in proc.stderr
+
+    # Same knock with the bench far away (> exclusion + worst park):
+    # passes the gate and makes its single attempt.
+    env.update({
+        "PBST_DRIVER_BENCH_EPOCH": str(now + 4 * 3600),
+        "PBST_RUNNER_CMD": f"bash {qdir}/stub_runner.sh",
+        "PBST_QUEUE_DRYRUN": "1",
+        "PBST_QUEUE_DRYRUN_DIR": str(qdir),
+        "PBST_RETRY_QUIET_S": "3",
+    })
+    proc = subprocess.run(
+        ["bash", str(dst), str(now), str(now + 2)],
+        capture_output=True, text=True, timeout=60, env=env,
+        cwd=str(qdir))
+    assert proc.returncode == 0, proc.stderr
+
+    # Bad epoch knob: fail fast, no chip contact.
+    env["PBST_DRIVER_BENCH_EPOCH"] = "tonight"
+    proc = subprocess.run(
+        ["bash", str(dst), str(now), str(now + 2)],
+        capture_output=True, text=True, timeout=30, env=env,
+        cwd=str(qdir))
+    assert proc.returncode == 2
+    assert "unix epoch" in proc.stderr
