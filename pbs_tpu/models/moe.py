@@ -270,11 +270,16 @@ def moe_mlp(cfg: MoEConfig, x: jax.Array, lp: dict, constrain_ec):
 
 
 def moe_layer_body(cfg: MoEConfig, x: jax.Array, lp: dict, cos, sin,
-                   constrain, constrain_ec, mesh=None, mlp=None):
+                   constrain, constrain_ec, mesh=None, mlp=None,
+                   attn=None):
     """One MoE block. ``mlp`` (default: the full-E :func:`moe_mlp`)
     is the routed-FFN seam — ``(h, lp) -> (y, aux, drop)`` — so
     manual-collective callers (the pp x ep pipeline) swap in their
-    expert-sharded variant without duplicating the attention half."""
+    expert-sharded variant without duplicating the attention half.
+    ``attn`` is the attention seam (``(q, k, v) -> out``) mirroring
+    :func:`~pbs_tpu.models.transformer.layer_body`: manual-region
+    callers pass the ring/ulysses per-device bodies (their public
+    wrappers open their own shard_map, which cannot nest)."""
     B, S, _ = x.shape
     nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     dt = cfg.dtype
@@ -286,8 +291,11 @@ def moe_layer_body(cfg: MoEConfig, x: jax.Array, lp: dict, cos, sin,
     q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
     # mesh threads the sequence-parallel impls (ring/ulysses) through,
     # exactly like the dense flagship: long-context MoE is dp x ep x sp.
-    attn = causal_attention(q, k, v, cfg, mesh).reshape(B, S, nh * hd)
-    x = constrain(x + attn @ lp["wo"].astype(dt))
+    if attn is None:
+        a = causal_attention(q, k, v, cfg, mesh)
+    else:
+        a = attn(q, k, v)
+    x = constrain(x + a.reshape(B, S, nh * hd) @ lp["wo"].astype(dt))
 
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     if mlp is None:

@@ -371,6 +371,7 @@ def _moe_pipe_blocks(cfg, mesh: Mesh, n_micro: int):
 
     pp = mesh.shape["pp"]
     ep = mesh.shape.get("ep", 1)
+    sp = mesh.shape.get("sp", 1)
     if cfg.n_layers % pp != 0:
         raise ValueError(
             f"n_layers={cfg.n_layers} not divisible by pp={pp}"
@@ -379,25 +380,18 @@ def _moe_pipe_blocks(cfg, mesh: Mesh, n_micro: int):
         raise ValueError(
             f"ep={ep} must divide n_experts={cfg.n_experts}"
         )
-    if cfg.attn_impl not in ("xla", "pallas"):
-        # 'pallas' lifts straight through moe_layer_body (the flash
-        # kernel needs no mesh axes: Mosaic on chip, interpreter mode
-        # off-TPU). ring/ulysses additionally need the sequence
-        # sharded over an sp axis INSIDE this manual region — which
-        # also shards the router's token view; that composition is the
-        # dense pipe's (see _pipe_blocks) and is not wired through the
-        # expert dispatch yet.
-        raise ValueError(
-            "pipelined MoE stages support attn_impl='xla' or 'pallas' "
-            f"(got {cfg.attn_impl!r}; sequence-parallel attention does "
-            "not compose with the ep-sharded expert dispatch yet)"
-        )
+    # Same composition rules as the dense pipe; with an sp axis the
+    # router sees each device's LOCAL token chunk — routing is
+    # per-token so expert OUTPUTS are unaffected (exactly so in
+    # dropless mode, where capacity can never bind), only the
+    # grouping of the aux statistic changes (it is pmean'd over sp).
+    _validate_pipe_attn(cfg, tp=1, sp=sp)
     el = cfg.n_experts // ep
 
     def pipe(layers, xs):
         idx = jax.lax.axis_index("pp")
-        S = xs.shape[2]
-        cos, sin = rope_tables(cfg, S)
+        cos, sin = _pipe_rope(cfg, xs.shape[2], sp)
+        attn_fn = _pipe_attn_seam(cfg, sp)
         dt = cfg.dtype
 
         def sharded_ffn(h, lp):
@@ -429,7 +423,7 @@ def _moe_pipe_blocks(cfg, mesh: Mesh, n_micro: int):
         def block(x, lp):
             return moe_layer_body(
                 cfg, x, lp, cos, sin, lambda a: a, lambda a: a,
-                mesh=None, mlp=sharded_ffn)
+                mesh=None, mlp=sharded_ffn, attn=attn_fn)
 
         def stage(x):
             def scan_fn(carry, lp):
@@ -458,16 +452,23 @@ def _moe_pipe_blocks(cfg, mesh: Mesh, n_micro: int):
                 state = jax.lax.ppermute(y, "pp", perm)
         # Sum over stages = sum over ALL layers x microbatches; the
         # ep shards computed identical full-E routing, so no ep sum.
+        # With sp each shard routed its LOCAL chunk: average the aux
+        # statistic over sp so the output is genuinely replicated on
+        # that axis (its out spec claims so).
         aux_tot = jax.lax.psum(aux_acc, "pp")
         drop_tot = jax.lax.psum(drop_acc, "pp")
+        if sp > 1:
+            aux_tot = jax.lax.pmean(aux_tot, "sp")
+            drop_tot = jax.lax.pmean(drop_tot, "sp")
         return (outs, jnp.reshape(aux_tot, (1,)),
                 jnp.reshape(drop_tot, (1,)))
 
+    s = "sp" if sp > 1 else None
     kwargs = dict(
         mesh=mesh,
         in_specs=(moe_pipeline_layer_specs(ep > 1),
-                  P(None, "dp", None, None)),
-        out_specs=(P("pp", "dp", None, None), P("dp"), P("dp")),
+                  P(None, "dp", s, None)),
+        out_specs=(P("pp", "dp", s, None), P("dp"), P("dp")),
     )
     try:
         return shard_map(pipe, check_vma=False, **kwargs)
@@ -497,15 +498,22 @@ def make_pipelined_moe_train(
 
     key = key if key is not None else jax.random.PRNGKey(0)
     pipe = _moe_pipe_blocks(cfg, mesh, n_micro)
-    mb_spec = NamedSharding(mesh, P(None, "dp", None, None))
+    sp = mesh.shape.get("sp", 1)
+    s = "sp" if sp > 1 else None
+    mb_spec = NamedSharding(mesh, P(None, "dp", s, None))
     tx = default_optimizer(learning_rate)
 
     def loss_fn(params, tokens):
         B, S_full = tokens.shape
-        inp = tokens[:, :-1]
-        S = S_full - 1
         if B % n_micro != 0:
             raise ValueError(f"batch {B} not divisible by M={n_micro}")
+        # Same full-seq trick as the dense pipelined loss: with sp the
+        # in-graph sequence must divide the axis (S-1 rarely does).
+        full_seq = sp > 1
+        inp = tokens if full_seq else tokens[:, :-1]
+        S = S_full if full_seq else S_full - 1
+        if S % sp:
+            raise ValueError(f"seq {S} not divisible by sp={sp}")
         mb = B // n_micro
         dt = cfg.dtype
         x = params["embed"].astype(dt)[inp]
@@ -516,7 +524,18 @@ def make_pipelined_moe_train(
         y = ys[-n_micro:].reshape(B, S, cfg.d_model)
         y = _rms(y, params["final_norm"], cfg.norm_eps)
         logits = (y @ params["head"].astype(dt)).astype(jnp.float32)
-        lm = _xent(logits, tokens[:, 1:])
+        if full_seq:
+            from pbs_tpu.models.transformer import (
+                shift_targets_and_weights,
+            )
+
+            targets, weights = shift_targets_and_weights(tokens)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            ll = jnp.take_along_axis(
+                logp, targets[..., None], axis=-1)[..., 0]
+            lm = -jnp.sum(ll * weights) / jnp.sum(weights)
+        else:
+            lm = _xent(logits, tokens[:, 1:])
         aux = jnp.mean(aux_v) / (cfg.n_layers * n_micro)
         drop = jnp.mean(drop_v) / (cfg.n_layers * n_micro)
         return lm + cfg.aux_loss_weight * aux, (lm, aux, drop)
