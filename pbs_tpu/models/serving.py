@@ -217,11 +217,6 @@ class ContinuousBatcher:
         self.temperature = temperature
         self.eos_id = eos_id
         self.mesh = mesh
-        if mesh is not None and mlp_fn is not None:
-            raise ValueError(
-                "a custom mlp_fn (MoE serving) is not supported with a "
-                "tp serving mesh yet: param_specs only covers the dense "
-                "layer tree")
         cache = init_slot_cache(cfg, n_slots, self.max_len)
         if mesh is not None:
             # Tensor-parallel serving by PLACEMENT (the GSPMD recipe):
@@ -248,7 +243,28 @@ class ContinuousBatcher:
                 raise ValueError(
                     f"n_kv_heads={cfg.n_kv_heads} not divisible by "
                     f"tp={mesh.shape['tp']}")
-            params = shard_params(params, mesh, cfg)
+            if isinstance(params.get("layers"), dict) and \
+                    "router" in params["layers"]:
+                # MoE tree (served via the mlp_fn seam): same Megatron
+                # attention layout plus expert FFNs column/row-sharded
+                # over tp on d_ff (r5 — the former mlp_fn x mesh
+                # rejection is lifted).
+                import jax.sharding as _jsh
+
+                from pbs_tpu.parallel.expert import (
+                    moe_serving_param_specs,
+                )
+
+                shardings = jax.tree.map(
+                    lambda spec: _jsh.NamedSharding(mesh, spec),
+                    moe_serving_param_specs(cfg),
+                    is_leaf=lambda x: isinstance(
+                        x, _jsh.PartitionSpec),
+                )
+                params = jax.tree.map(jax.device_put, params,
+                                      shardings)
+            else:
+                params = shard_params(params, mesh, cfg)
             kv = jsh.NamedSharding(
                 mesh, jsh.PartitionSpec(None, None, None, "tp", None))
             rep = jsh.NamedSharding(mesh, jsh.PartitionSpec(None))

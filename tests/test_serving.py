@@ -227,6 +227,36 @@ def test_prefix_cache_token_exact_and_skips_prefill():
     assert eng.stats()["prefix_hits"] == 1
 
 
+def test_moe_serving_on_tp_mesh_token_exact():
+    """r5: the mlp_fn x mesh rejection is lifted — an MoE engine on a
+    tp mesh (Megatron attention + expert d_ff column/row shards,
+    moe_serving_param_specs) must produce token-exact greedy output vs
+    the single-device MoE engine, with zero drops (dropless)."""
+    from pbs_tpu.models import MoEConfig
+    from pbs_tpu.models.moe import init_moe_params, moe_slot_mlp
+    from pbs_tpu.parallel import make_mesh
+
+    mcfg = MoEConfig(
+        vocab=64, d_model=32, n_layers=2, n_heads=2, n_kv_heads=2,
+        d_ff=64, max_seq=128, dtype=jnp.float32, n_experts=4, top_k=2,
+        dropless=True, router_group_size=8,
+    )
+    params = init_moe_params(mcfg, jax.random.PRNGKey(0))
+    prompt = [5, 9, 2, 31, 7]
+
+    def run(mesh):
+        eng = ContinuousBatcher(
+            mcfg, params, n_slots=2, prompt_bucket=16,
+            mlp_fn=moe_slot_mlp(mcfg), mesh=mesh)
+        rid = eng.submit(prompt, max_new_tokens=8)
+        done = _drain(eng)
+        return done[rid].tokens
+
+    gold = run(None)
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    assert run(mesh) == gold
+
+
 def test_prefix_cache_on_tp_mesh_token_exact(model):
     """r5: prefix cache composes with tp serving (the restriction is
     lifted). The cached window slices stay tp-sharded on device; a hit
