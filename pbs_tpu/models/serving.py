@@ -225,46 +225,37 @@ class ContinuousBatcher:
             # propagates the shardings and inserts the collectives.
             import jax.sharding as jsh
 
-            from pbs_tpu.parallel.sharding import shard_params
+            from pbs_tpu.parallel.sharding import (
+                param_specs,
+                quant_aware_shardings,
+            )
 
             if "tp" not in mesh.axis_names:
                 raise ValueError(
                     f"serving mesh needs a 'tp' axis; got "
                     f"{mesh.axis_names}")
-            if isinstance(params.get("embed"), dict):
-                # shard_params maps fp-shaped specs over the tree; the
-                # {"q","s"} leaves would mismatch opaquely — reject
-                # until quantized sharding specs exist.
-                raise ValueError(
-                    "int8-quantized params are not supported with a "
-                    "tp serving mesh yet; serve quantized trees "
-                    "single-device (mesh=None)")
             if cfg.n_kv_heads % mesh.shape["tp"]:
                 raise ValueError(
                     f"n_kv_heads={cfg.n_kv_heads} not divisible by "
                     f"tp={mesh.shape['tp']}")
+            # One quant-aware sharding walk covers all four weight
+            # forms (r5 — the former MoE and int8 mesh rejections are
+            # both lifted): dense fp, dense int8, MoE fp, MoE int8.
+            # MoE trees take the Megatron-attention + expert-d_ff
+            # serving table; {"q","s"} leaves shard q like the fp
+            # weight and s with its size-1 reduced axis unsharded.
             if isinstance(params.get("layers"), dict) and \
                     "router" in params["layers"]:
-                # MoE tree (served via the mlp_fn seam): same Megatron
-                # attention layout plus expert FFNs column/row-sharded
-                # over tp on d_ff (r5 — the former mlp_fn x mesh
-                # rejection is lifted).
-                import jax.sharding as _jsh
-
                 from pbs_tpu.parallel.expert import (
                     moe_serving_param_specs,
                 )
 
-                shardings = jax.tree.map(
-                    lambda spec: _jsh.NamedSharding(mesh, spec),
-                    moe_serving_param_specs(cfg),
-                    is_leaf=lambda x: isinstance(
-                        x, _jsh.PartitionSpec),
-                )
-                params = jax.tree.map(jax.device_put, params,
-                                      shardings)
+                specs = moe_serving_param_specs(cfg)
             else:
-                params = shard_params(params, mesh, cfg)
+                specs = param_specs(cfg)
+            params = jax.tree.map(
+                jax.device_put, params,
+                quant_aware_shardings(specs, params, mesh))
             kv = jsh.NamedSharding(
                 mesh, jsh.PartitionSpec(None, None, None, "tp", None))
             rep = jsh.NamedSharding(mesh, jsh.PartitionSpec(None))

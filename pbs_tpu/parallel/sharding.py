@@ -75,6 +75,33 @@ def shard_params(params: dict, mesh: Mesh, cfg: TransformerConfig) -> dict:
     return jax.tree.map(jax.device_put, params, shardings)
 
 
+def quant_aware_shardings(specs: dict, params: dict, mesh: Mesh):
+    """Shardings for a param tree that may hold int8-quantized leaves.
+
+    A quantized leaf is ``{"q": int8 (same shape as the fp weight),
+    "s": fp32 scales (same RANK, size 1 on the reduced axis -2)}``
+    (models/quant._quantize_leaf). ``q`` takes the fp spec verbatim;
+    ``s`` takes the fp spec with any sharding on axis -2 dropped —
+    sharding a size-1 dimension is invalid, and the per-output-channel
+    scales live on the LAST axis, which keeps its sharding (so a
+    column-parallel weight's scales shard with its outputs and the
+    fused dequant stays local). Plain leaves map 1:1."""
+    def walk(spec, p):
+        if isinstance(p, dict) and set(p) == {"q", "s"}:
+            r = p["q"].ndim
+            se = list(spec) + [None] * (r - len(list(spec)))
+            se[r - 2] = None
+            return {
+                "q": NamedSharding(mesh, _mesh_spec(mesh, spec)),
+                "s": NamedSharding(mesh, _mesh_spec(mesh, P(*se))),
+            }
+        if isinstance(p, dict):
+            return {k: walk(spec[k], p[k]) for k in p}
+        return NamedSharding(mesh, _mesh_spec(mesh, spec))
+
+    return {k: walk(specs[k], params[k]) for k in params}
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Token batches: batch over dp; sequence over sp when the mesh has
     a ring-attention axis (long-context inputs arrive pre-sharded)."""

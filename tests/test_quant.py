@@ -107,9 +107,11 @@ def test_quant_moe_generate():
     assert toks.shape == (2, 4)
 
 
-def test_quant_tp_mesh_rejected():
-    """tp serving + quantized tree is rejected loudly (review finding:
-    shard_params would fail with an opaque pytree mismatch)."""
+def test_quant_tp_mesh_token_exact():
+    """r5: the former tp x quantized rejection is lifted — a quantized
+    tree on a tp serving mesh (quant-aware shardings: q like the fp
+    weight, scales with the size-1 reduced axis unsharded) produces
+    token-exact greedy output vs the single-device quantized engine."""
     import pytest
 
     from pbs_tpu.models.serving import ContinuousBatcher
@@ -117,11 +119,60 @@ def test_quant_tp_mesh_rejected():
 
     if len(jax.devices()) < 2:
         pytest.skip("needs 2 devices")
-    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
     qp = quantize_weights(_params())
-    with pytest.raises(ValueError, match="quantized"):
-        ContinuousBatcher(CFG, qp, n_slots=2, prompt_bucket=8,
-                          max_len=32, mesh=mesh)
+
+    def run(mesh):
+        eng = ContinuousBatcher(CFG, qp, n_slots=2, prompt_bucket=8,
+                                max_len=32, mesh=mesh)
+        rid = eng.submit([1, 2, 3], max_new_tokens=6)
+        done = []
+        for _ in range(30):
+            done += eng.step()
+            if done:
+                break
+        assert done and done[0].request_id == rid
+        return done[0].tokens
+
+    gold = run(None)
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    assert run(mesh) == gold
+
+
+def test_quant_moe_tp_mesh_token_exact():
+    """The fourth weight form x mesh cell: int8 MoE tree on a tp mesh
+    (expert q/s shards on d_ff, router fp32 replicated) — token-exact
+    vs single-device."""
+    import pytest
+
+    from pbs_tpu.models import MoEConfig, init_moe_params
+    from pbs_tpu.models.moe import moe_slot_mlp
+    from pbs_tpu.models.serving import ContinuousBatcher
+    from pbs_tpu.parallel import make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    mcfg = MoEConfig(
+        vocab=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq=64, dtype=jnp.float32, n_experts=4, top_k=2,
+        dropless=True, router_group_size=8)
+    qp = quantize_weights(init_moe_params(mcfg, jax.random.PRNGKey(0)))
+
+    def run(mesh):
+        eng = ContinuousBatcher(
+            mcfg, qp, n_slots=2, prompt_bucket=8, max_len=32,
+            mlp_fn=moe_slot_mlp(mcfg), mesh=mesh)
+        rid = eng.submit([1, 2, 3], max_new_tokens=5)
+        done = []
+        for _ in range(30):
+            done += eng.step()
+            if done:
+                break
+        assert done and done[0].request_id == rid
+        return done[0].tokens
+
+    gold = run(None)
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    assert run(mesh) == gold
 
 
 def test_quantize_cli_roundtrip(tmp_path):
