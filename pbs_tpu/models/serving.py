@@ -175,6 +175,48 @@ def ingest_slot_prompt(cfg: TransformerConfig, params: dict, cache: dict,
     return logits[0, plen - 1], cache, extra
 
 
+def _shard_serving_params(cfg, params: dict, mesh) -> dict:
+    """Place a serving param tree on a tp mesh. One quant-aware
+    sharding walk covers all four weight forms (r5 — the former MoE
+    and int8 mesh rejections are lifted): dense fp, dense int8, MoE
+    fp, MoE int8. MoE trees take the Megatron-attention +
+    expert-d_ff serving table; {"q","s"} leaves shard q like the fp
+    weight and s with its size-1 reduced axis unsharded."""
+    from pbs_tpu.parallel.sharding import (
+        param_specs,
+        quant_aware_shardings,
+    )
+
+    if cfg.n_kv_heads % mesh.shape["tp"]:
+        raise ValueError(
+            f"n_kv_heads={cfg.n_kv_heads} not divisible by "
+            f"tp={mesh.shape['tp']}")
+    if isinstance(params.get("layers"), dict) and \
+            "router" in params["layers"]:
+        from pbs_tpu.parallel.expert import moe_serving_param_specs
+
+        specs = moe_serving_param_specs(cfg)
+    else:
+        specs = param_specs(cfg)
+    return jax.tree.map(
+        jax.device_put, params,
+        quant_aware_shardings(specs, params, mesh))
+
+
+def _shard_slot_cache(cache: dict, mesh) -> dict:
+    """KV slabs sharded over the kv heads on tp; cursors replicated."""
+    import jax.sharding as jsh
+
+    kv = jsh.NamedSharding(
+        mesh, jsh.PartitionSpec(None, None, None, "tp", None))
+    rep = jsh.NamedSharding(mesh, jsh.PartitionSpec(None))
+    return {
+        "k": jax.device_put(cache["k"], kv),
+        "v": jax.device_put(cache["v"], kv),
+        "pos": jax.device_put(cache["pos"], rep),
+    }
+
+
 @dataclasses.dataclass
 class Completion:
     request_id: int
@@ -223,47 +265,12 @@ class ContinuousBatcher:
             # shard params Megatron-style and the KV slabs over the kv
             # heads; the two jitted programs below are unchanged — XLA
             # propagates the shardings and inserts the collectives.
-            import jax.sharding as jsh
-
-            from pbs_tpu.parallel.sharding import (
-                param_specs,
-                quant_aware_shardings,
-            )
-
             if "tp" not in mesh.axis_names:
                 raise ValueError(
                     f"serving mesh needs a 'tp' axis; got "
                     f"{mesh.axis_names}")
-            if cfg.n_kv_heads % mesh.shape["tp"]:
-                raise ValueError(
-                    f"n_kv_heads={cfg.n_kv_heads} not divisible by "
-                    f"tp={mesh.shape['tp']}")
-            # One quant-aware sharding walk covers all four weight
-            # forms (r5 — the former MoE and int8 mesh rejections are
-            # both lifted): dense fp, dense int8, MoE fp, MoE int8.
-            # MoE trees take the Megatron-attention + expert-d_ff
-            # serving table; {"q","s"} leaves shard q like the fp
-            # weight and s with its size-1 reduced axis unsharded.
-            if isinstance(params.get("layers"), dict) and \
-                    "router" in params["layers"]:
-                from pbs_tpu.parallel.expert import (
-                    moe_serving_param_specs,
-                )
-
-                specs = moe_serving_param_specs(cfg)
-            else:
-                specs = param_specs(cfg)
-            params = jax.tree.map(
-                jax.device_put, params,
-                quant_aware_shardings(specs, params, mesh))
-            kv = jsh.NamedSharding(
-                mesh, jsh.PartitionSpec(None, None, None, "tp", None))
-            rep = jsh.NamedSharding(mesh, jsh.PartitionSpec(None))
-            cache = {
-                "k": jax.device_put(cache["k"], kv),
-                "v": jax.device_put(cache["v"], kv),
-                "pos": jax.device_put(cache["pos"], rep),
-            }
+            params = _shard_serving_params(cfg, params, mesh)
+            cache = _shard_slot_cache(cache, mesh)
         self.params = params
         self.cache = cache
         self._key = jax.random.PRNGKey(seed)
@@ -617,10 +624,6 @@ class SpeculativeBatcher(ContinuousBatcher):
             raise ValueError(
                 "SpeculativeBatcher is greedy-only (temperature=0): "
                 "exact-match acceptance is the correctness contract")
-        if kw.get("mesh") is not None or kw.get("prefix_cache_size"):
-            raise ValueError(
-                "speculative serving does not compose with a tp mesh "
-                "or the prefix cache yet")
         if k < 1:
             raise ValueError(f"k must be >= 1, got {k}")
         if cfg.vocab != draft_cfg.vocab:
@@ -632,6 +635,16 @@ class SpeculativeBatcher(ContinuousBatcher):
         self.k = k
         self.dcache = init_slot_cache(draft_cfg, self.n_slots,
                                       self.max_len)
+        if self.mesh is not None:
+            # r5: speculative serving composes with the tp mesh — the
+            # parent sharded the target; the draft tree and its slot
+            # cache take the same placement. (The prefix cache also
+            # composes: a hit installs the TARGET window, and the
+            # _admitted hook below draft-prefills hits and misses
+            # alike, preserving the pos invariant.)
+            self.draft_params = _shard_serving_params(
+                draft_cfg, self.draft_params, self.mesh)
+            self.dcache = _shard_slot_cache(self.dcache, self.mesh)
         self.spec_proposed = 0
         self.spec_accepted = 0
         # Draft-side FFN telemetry (a starved MoE draft collapses

@@ -41,6 +41,52 @@ def drain(eng, max_ticks=300):
     return got
 
 
+def test_spec_serving_on_tp_mesh_token_exact(models):
+    """r5: speculative serving composes with the tp mesh — target AND
+    draft trees Megatron-sharded, both slot caches kv-head-sharded.
+    Outputs stay bit-identical to the single-device spec engine."""
+    from pbs_tpu.parallel import make_mesh
+
+    params, dparams = models
+    gold_eng = SpeculativeBatcher(CFG, params, CFG, dparams, k=3,
+                                  n_slots=2, prompt_bucket=8,
+                                  max_len=64)
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    mesh_eng = SpeculativeBatcher(CFG, params, CFG, dparams, k=3,
+                                  n_slots=2, prompt_bucket=8,
+                                  max_len=64, mesh=mesh)
+    for eng in (gold_eng, mesh_eng):
+        for p in PROMPTS[:2]:
+            eng.submit(p, max_new_tokens=8)
+    assert drain(gold_eng) == drain(mesh_eng)
+
+
+def test_spec_serving_with_prefix_cache_token_exact(models):
+    """r5: speculative serving composes with the prefix cache — a hit
+    installs the TARGET window while the draft still prefills (the
+    _admitted hook covers hits and misses), so the pos invariant holds
+    and outputs stay bit-identical with zero second target prefill."""
+    params, dparams = models
+    eng = SpeculativeBatcher(CFG, params, CFG, dparams, k=3, n_slots=2,
+                             prompt_bucket=8, max_len=64,
+                             prefix_cache_size=4)
+    prompt = [1, 2, 3]
+
+    def run_one():
+        rid = eng.submit(prompt, max_new_tokens=8)
+        out = []
+        while not out:
+            out = [c for c in eng.step() if c.request_id == rid]
+        return out[0].tokens
+
+    t1 = run_one()
+    assert eng.prefill_count == 1 and eng.prefix_hits == 0
+    t2 = run_one()
+    assert t2 == t1
+    assert eng.prefill_count == 1  # hit: no second target prefill
+    assert eng.prefix_hits == 1
+
+
 def test_spec_serving_token_exact_and_fewer_ticks(models):
     params, dparams = models
     plain = ContinuousBatcher(CFG, params, n_slots=2, prompt_bucket=8,
