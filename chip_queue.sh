@@ -16,7 +16,11 @@
 set -u
 cd "$(dirname "$0")"
 mkdir -p chip_logs
-TS=$(date +%H%M%S)
+# Date-bearing run id stamped on every stage artifact: it is the
+# run-identity key tools/flip_decision.py trusts to tie candidate
+# artifacts to their headline, so it must stay unique across days and
+# survive mtime-scrambling restores (container recycles reset mtimes).
+TS=$(date +%Y%m%d-%H%M%S)
 log() { echo "[chip_queue $(date +%H:%M:%S)] $*" | tee -a "chip_logs/queue_$TS.log"; }
 # Inter-stage gap: a client that connects the instant its predecessor
 # exits can race the lease release and end up waiting forever (r03

@@ -57,7 +57,7 @@ def test_dryrun_walks_every_stage(tmp_path):
                   if fnmatch.fnmatch(p.name, "bench_*.json")]
     assert bench_like, "stage 1/6 artifacts missing from the dryrun"
     for name in bench_like:
-        assert re.fullmatch(r"bench_(final_)?\d{6}\.json", name), (
+        assert re.fullmatch(r"bench_(final_)?\d{8}-\d{6}\.json", name), (
             f"{name} collides with chip_summarize's headline glob"
         )
     assert "queue complete" in out

@@ -1,0 +1,360 @@
+"""tools/flip_decision.py: measurement-gated default flips.
+
+The committed default config (bench.py DEFAULTS) may only move on a
+chip-measured win under the driver protocol (VERDICT r2-r4: the flip
+is "correctly gated on measurement").  These tests pin the gate with
+stub artifacts: no green headline -> no flip; degraded-protocol rows
+never flip; the margin absorbs jitter; --apply rewrites exactly the
+anchored line and the result still parses.
+
+Reference analog: defaults change only with measured evidence
+(xen-4.2.1/xen/arch/x86/perfctr.c:1547-1573 — the feedback loop's
+inputs are read counters, never estimates).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import flip_decision  # noqa: E402
+
+ROW = {"metric": "flagship_train_throughput", "unit": "tokens/s",
+       "vs_baseline": 1.0, "mu_dtype": "f32"}
+
+
+def _write(d, name, row):
+    with open(os.path.join(d, name), "w") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def _headline(value=19911.0, **kw):
+    return {**ROW, "value": value, **kw}
+
+
+def test_no_artifacts_no_flip(tmp_path):
+    d = str(tmp_path)
+    decision = flip_decision.decide(d, 0.02)
+    assert decision["flip"] is False
+    assert "no green non-degraded default-config headline" in (
+        decision["reason"])
+
+
+def test_red_headline_blocks_flip(tmp_path):
+    d = str(tmp_path)
+    _write(d, "bench_120000.json",
+           {**ROW, "value": 0.0, "error": "claim-unavailable"})
+    _write(d, "cand8p_120000.json",
+           _headline(25000.0, batch=8, attn="pallas", loss_chunks=8,
+                     mu_dtype="bf16"))
+    decision = flip_decision.decide(d, 0.02)
+    assert decision["flip"] is False, (
+        "a candidate must never flip against an unmeasured base")
+
+
+def test_degraded_candidate_never_flips(tmp_path):
+    d = str(tmp_path)
+    _write(d, "bench_120000.json", _headline())
+    _write(d, "cand6rn_120000.json",
+           _headline(30000.0, remat="none", degraded_protocol=True,
+                     bench_chunks=0))
+    decision = flip_decision.decide(d, 0.02)
+    assert decision["flip"] is False
+    assert "no green non-degraded candidate" in decision["reason"]
+
+
+def test_degraded_headline_blocks_flip(tmp_path):
+    """A degraded-protocol headline is a single-chunk noisy sample —
+    it must not serve as the bar either (review finding r5): an
+    artificially LOW bar would let any normal candidate flip."""
+    d = str(tmp_path)
+    _write(d, "bench_final_120000.json",
+           _headline(5000.0, degraded_protocol=True, bench_chunks=0))
+    _write(d, "cand8p_120000.json",
+           _headline(21000.0, batch=8, attn="pallas", mu_dtype="bf16"))
+    decision = flip_decision.decide(d, 0.02)
+    assert decision["flip"] is False
+    assert "no green non-degraded default-config headline" in (
+        decision["reason"])
+
+
+def test_stale_candidate_from_other_run_never_flips(tmp_path):
+    """Candidates are keyed to the headline's queue-run TS: a green
+    candidate from an earlier round (measured under old code) must not
+    decide today's flip (review finding r5)."""
+    d = str(tmp_path)
+    _write(d, "bench_140000.json", _headline(19911.0))
+    _write(d, "cand8p_093000.json",  # different run's artifact
+           _headline(25000.0, batch=8, attn="pallas", mu_dtype="bf16"))
+    # Legacy (undated) run ids order by mtime: yesterday's candidate
+    # is older on disk than today's headline.
+    os.utime(os.path.join(d, "cand8p_093000.json"), (1000, 1000))
+    os.utime(os.path.join(d, "bench_140000.json"), (2000, 2000))
+    decision = flip_decision.decide(d, 0.02)
+    assert decision["flip"] is False
+    assert "queue run (TS 140000)" in decision["reason"]
+
+
+def test_red_newest_run_never_falls_back_to_older_green_run(tmp_path):
+    """If today's queue failed, the answer is 'no flip' — the tool
+    must not walk past the red newest run to yesterday's green
+    artifacts, measured under older code (review finding r5)."""
+    d = str(tmp_path)
+    _write(d, "bench_093000.json", _headline(19000.0))
+    _write(d, "cand8p_093000.json",
+           _headline(25000.0, batch=8, attn="pallas", mu_dtype="bf16"))
+    _write(d, "bench_140000.json",
+           {**ROW, "value": 0.0, "error": "claim-unavailable"})
+    # Pin mtimes: the 14:00 run is the newest.
+    os.utime(os.path.join(d, "bench_093000.json"), (1000, 1000))
+    os.utime(os.path.join(d, "cand8p_093000.json"), (1001, 1001))
+    os.utime(os.path.join(d, "bench_140000.json"), (2000, 2000))
+    decision = flip_decision.decide(d, 0.02)
+    assert decision["flip"] is False
+    assert decision["run_ts"] == "140000"
+    assert "no green non-degraded default-config headline" in (
+        decision["reason"])
+
+
+def test_dated_run_ids_beat_scrambled_mtimes(tmp_path):
+    """A container-recycle checkout collapses chip_logs mtimes to one
+    instant; the date-bearing run ids chip_queue.sh stamps since r5
+    must still identify the newest run — so yesterday's green run
+    cannot decide a flip past today's red one (review finding r5)."""
+    d = str(tmp_path)
+    _write(d, "bench_20260731-090000.json", _headline(19000.0))
+    _write(d, "cand8p_20260731-090000.json",
+           _headline(25000.0, batch=8, attn="pallas", mu_dtype="bf16"))
+    _write(d, "bench_20260801-140000.json",
+           {**ROW, "value": 0.0, "error": "claim-unavailable"})
+    for name in os.listdir(d):  # mtime scramble: all equal
+        os.utime(os.path.join(d, name), (1000, 1000))
+    decision = flip_decision.decide(d, 0.02)
+    assert decision["run_ts"] == "20260801-140000"
+    assert decision["flip"] is False
+
+
+def test_candidates_only_partial_run_blocks_flip(tmp_path):
+    """A skip-bench resume that dies before stage 6 leaves only
+    cand*_<TS>.json for the newest run: that run has no headline, so
+    no flip — an older complete run must not decide it (review
+    finding r5)."""
+    d = str(tmp_path)
+    _write(d, "bench_20260731-090000.json", _headline(19000.0))
+    _write(d, "cand8p_20260731-090000.json",
+           _headline(25000.0, batch=8, attn="pallas", mu_dtype="bf16"))
+    _write(d, "cand8p_20260801-150000.json",  # newest, headline-less
+           _headline(26000.0, batch=8, attn="pallas", mu_dtype="bf16"))
+    decision = flip_decision.decide(d, 0.02)
+    assert decision["run_ts"] == "20260801-150000"
+    assert decision["flip"] is False
+    assert "no green non-degraded default-config headline" in (
+        decision["reason"])
+
+
+def test_margin_absorbs_jitter(tmp_path):
+    d = str(tmp_path)
+    _write(d, "bench_120000.json", _headline(19911.0))
+    _write(d, "cand8_120000.json", _headline(20100.0, batch=8))
+    decision = flip_decision.decide(d, 0.02)
+    assert decision["flip"] is False
+    assert "margin" in decision["reason"]
+
+
+def test_winning_candidate_flips_with_mapped_defaults(tmp_path):
+    d = str(tmp_path)
+    _write(d, "bench_120000.json", _headline(19911.0))
+    _write(d, "cand8_120000.json", _headline(20500.0, batch=8,
+                                             loss_chunks=8,
+                                             mu_dtype="bf16"))
+    _write(d, "cand8p_120000.json",
+           _headline(21400.0, batch=8, loss_chunks=8, attn="pallas",
+                     mu_dtype="bf16"))
+    decision = flip_decision.decide(d, 0.02)
+    assert decision["flip"] is True
+    # Best candidate (cand8p) wins; its measured knobs become DEFAULTS,
+    # absent knobs stay protocol-default (None).
+    assert decision["defaults"] == {
+        "batch": 8, "loss_chunks": 8, "attn": "pallas",
+        "mu_dtype": "bf16", "remat": None}
+
+
+def test_final_bench_preferred_when_better(tmp_path):
+    d = str(tmp_path)
+    _write(d, "bench_120000.json", _headline(19000.0))
+    _write(d, "bench_final_120000.json", _headline(19911.0))
+    _write(d, "cand8_120000.json", _headline(20100.0, batch=8))
+    # 20100 beats 19000+2% but not 19911+2%: the BEST green default-
+    # config sample is the bar, so no flip.
+    decision = flip_decision.decide(d, 0.02)
+    assert decision["flip"] is False
+
+
+def test_f32_label_maps_back_to_none():
+    row = {**ROW, "value": 1.0, "batch": 8}
+    assert flip_decision.defaults_from_row(row)["mu_dtype"] is None
+
+
+def test_apply_rewrites_anchor_and_still_parses(tmp_path):
+    d = str(tmp_path)
+    _write(d, "bench_120000.json", _headline(19911.0))
+    _write(d, "cand8p_120000.json",
+           _headline(21400.0, batch=8, loss_chunks=8, attn="pallas",
+                     mu_dtype="bf16"))
+    bench_copy = str(tmp_path / "bench_copy.py")
+    shutil.copyfile(os.path.join(REPO, "bench.py"), bench_copy)
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "flip_decision.py"),
+         d, "--apply", "--bench-path", bench_copy],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    decision = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert decision["flip"] is True
+    assert decision["applied_to"] == bench_copy
+
+    src = open(bench_copy).read()
+    ast.parse(src)  # flipped file is still valid Python
+    lines = re.findall(r"^DEFAULTS = \{.*$", src, re.MULTILINE)
+    assert len(lines) == 1
+    parsed = ast.literal_eval(
+        lines[0].split("=", 1)[1].split("#")[0].strip())
+    assert parsed == {"batch": 8, "loss_chunks": 8, "attn": "pallas",
+                      "mu_dtype": "bf16", "remat": None}
+
+
+def test_bench_worker_honors_committed_defaults(tmp_path):
+    """End-to-end: a flipped DEFAULTS line changes what the no-env
+    driver invocation measures (tiny mode, CPU).  Runs the real worker
+    against a flipped COPY of bench.py, so the repo file is untouched."""
+    bench_copy = str(tmp_path / "bench_flipped.py")
+    shutil.copyfile(os.path.join(REPO, "bench.py"), bench_copy)
+    flip_decision.apply_flip(
+        {"batch": 3, "loss_chunks": 4, "attn": None,
+         "mu_dtype": "bf16", "remat": None}, bench_copy)
+
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PBST_BENCH_")}
+    env["PBST_BENCH_TINY"] = "1"
+    # The copy runs outside the repo dir; sys.path[0] is tmp_path, so
+    # bench_common must come in via PYTHONPATH.
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, bench_copy, "--worker"], capture_output=True,
+        text=True, timeout=300, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("{")][-1]
+    row = json.loads(line)
+    assert row["value"] > 0
+    # The defaults took effect AND the artifact names them (so a
+    # flipped headline row is self-describing, like env-knob rows).
+    assert row["batch"] == 3
+    assert row["loss_chunks"] == 4
+    assert row["mu_dtype"] == "bf16"
+
+
+def test_committed_loss_chunks_never_bricks_tiny_smoke(tmp_path):
+    """A committed loss_chunks valid at the driver seq (1024) but with
+    no divisor at the tiny seq (128) must not kill the CPU smoke path
+    (review finding r5): tiny runs unchunked and says so."""
+    bench_copy = str(tmp_path / "bench_lc256.py")
+    shutil.copyfile(os.path.join(REPO, "bench.py"), bench_copy)
+    flip_decision.apply_flip(
+        {"batch": None, "loss_chunks": 256, "attn": None,
+         "mu_dtype": None, "remat": None}, bench_copy)
+
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PBST_BENCH_")}
+    env["PBST_BENCH_TINY"] = "1"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, bench_copy, "--worker"], capture_output=True,
+        text=True, timeout=300, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert "smoke runs unchunked" in proc.stderr
+    row = json.loads([ln for ln in proc.stdout.splitlines()
+                      if ln.startswith("{")][-1])
+    assert row["value"] > 0
+    assert "loss_chunks" not in row
+
+
+def test_env_zero_reopens_unchunked_path_over_committed_default(tmp_path):
+    """PBST_BENCH_LOSS_CHUNKS=0 is the explicit unchunked spelling:
+    after a flip commits loss_chunks, the pre-flip protocol must stay
+    expressible for re-measurement (review finding r5)."""
+    bench_copy = str(tmp_path / "bench_lc8.py")
+    shutil.copyfile(os.path.join(REPO, "bench.py"), bench_copy)
+    flip_decision.apply_flip(
+        {"batch": None, "loss_chunks": 4, "attn": None,
+         "mu_dtype": None, "remat": None}, bench_copy)
+
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PBST_BENCH_")}
+    env.update({"PBST_BENCH_TINY": "1", "PBST_BENCH_LOSS_CHUNKS": "0"})
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, bench_copy, "--worker"], capture_output=True,
+        text=True, timeout=300, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    row = json.loads([ln for ln in proc.stdout.splitlines()
+                      if ln.startswith("{")][-1])
+    assert row["value"] > 0
+    assert "loss_chunks" not in row  # unchunked, despite the default
+
+
+def test_committed_bad_attn_blames_defaults_not_env(tmp_path):
+    """String defaults get the same source-named fail-fast as the int
+    knobs (review finding r5): a bad committed attn must blame
+    DEFAULTS, not an env var that was never set."""
+    bench_copy = str(tmp_path / "bench_badattn.py")
+    src = open(os.path.join(REPO, "bench.py")).read()
+    src = re.sub(r"^DEFAULTS = \{.*$",
+                 'DEFAULTS = {"batch": None, "loss_chunks": None, '
+                 '"attn": "palas", "mu_dtype": None, "remat": None}',
+                 src, count=1, flags=re.MULTILINE)
+    open(bench_copy, "w").write(src)
+
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PBST_BENCH_")}
+    env["PBST_BENCH_TINY"] = "1"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, bench_copy, "--worker"], capture_output=True,
+        text=True, timeout=60, env=env, cwd=REPO)
+    assert proc.returncode != 0
+    assert 'DEFAULTS["attn"] must be xla|pallas: palas' in proc.stderr
+    assert "PBST_BENCH_ATTN" not in proc.stderr
+    assert "backend init" not in proc.stderr
+
+
+def test_committed_bad_batch_fails_fast(tmp_path):
+    """Validation parity (review finding r5): a non-int or sub-minimum
+    committed batch must die in milliseconds naming DEFAULTS, exactly
+    like a typo'd env knob — never after backend init."""
+    bench_copy = str(tmp_path / "bench_badbatch.py")
+    src = open(os.path.join(REPO, "bench.py")).read()
+    src = re.sub(r"^DEFAULTS = \{.*$",
+                 'DEFAULTS = {"batch": 8.0, "loss_chunks": None, '
+                 '"attn": None, "mu_dtype": None, "remat": None}',
+                 src, count=1, flags=re.MULTILINE)
+    open(bench_copy, "w").write(src)
+
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PBST_BENCH_")}
+    env["PBST_BENCH_TINY"] = "1"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, bench_copy, "--worker"], capture_output=True,
+        text=True, timeout=60, env=env, cwd=REPO)
+    assert proc.returncode != 0
+    assert 'DEFAULTS["batch"] must be an int >= 1' in proc.stderr
+    assert "backend init" not in proc.stderr

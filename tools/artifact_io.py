@@ -1,0 +1,54 @@
+"""Shared chip_logs artifact readers for the offline tools.
+
+One copy of the JSON-row parsing, newest-first globbing, and run-id
+extraction used by chip_summarize.py and flip_decision.py — two
+offline tools reading the same artifact families must never disagree
+about which rows or runs exist.  Purely offline: never imports jax,
+never touches the chip.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+
+# chip_queue.sh stamps one TS on every stage artifact of a run:
+# bench_<TS>.json, cand8p_<TS>.json, ...  Since round 5 the TS is
+# date-bearing (%Y%m%d-%H%M%S) so run identity survives cross-day
+# wall-clock collisions and mtime-scrambling restores; older rounds
+# used bare %H%M%S.
+DATED_TS = re.compile(r"^\d{8}-\d{6}$")
+
+
+def read_jsonl(path: str) -> list[dict]:
+    """Every parseable {...} line of ``path`` (bad lines skipped)."""
+    rows = []
+    try:
+        with open(path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if ln.startswith("{"):
+                    try:
+                        rows.append(json.loads(ln))
+                    except json.JSONDecodeError:
+                        pass
+    except OSError:
+        pass
+    return rows
+
+
+def last_row(path: str) -> dict | None:
+    rows = read_jsonl(path)
+    return rows[-1] if rows else None
+
+
+def newest(pattern: str) -> list[str]:
+    """Matches of ``pattern``, newest mtime first."""
+    return sorted(glob.glob(pattern), key=os.path.getmtime, reverse=True)
+
+
+def run_ts(path: str) -> str:
+    """The run id stamped in an artifact's filename suffix."""
+    return os.path.basename(path).rsplit("_", 1)[-1].split(".")[0]

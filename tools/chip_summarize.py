@@ -10,30 +10,15 @@ safe to run at any time, including while a chip client is live.
 
 from __future__ import annotations
 
-import glob
 import json
 import os
 import sys
 
-
-def _read_jsonl(path: str) -> list[dict]:
-    rows = []
-    try:
-        with open(path) as f:
-            for ln in f:
-                ln = ln.strip()
-                if ln.startswith("{"):
-                    try:
-                        rows.append(json.loads(ln))
-                    except json.JSONDecodeError:
-                        pass
-    except OSError:
-        pass
-    return rows
-
-
-def _newest(pattern: str) -> list[str]:
-    return sorted(glob.glob(pattern), key=os.path.getmtime, reverse=True)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from artifact_io import (  # noqa: E402
+    newest as _newest,
+    read_jsonl as _read_jsonl,
+)
 
 
 def _fmt(v) -> str:
