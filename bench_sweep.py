@@ -152,8 +152,9 @@ def main() -> int:
     results = []
     grid = list(itertools.product(REMAT, BATCHES, ATTN))
     for (rname, remat, policy), batch, attn in grid:
-        if attn == "pallas" and tiny:
-            continue  # interpreter-mode pallas is too slow to smoke
+        # Interpreter-mode pallas smokes fine at the tiny shape
+        # (~10 s/point on CPU) — the r2-era skip here would silently
+        # empty the pallas-only queue stages in tiny mode.
         try:
             r = run_point(cfg_base, rname, remat, policy, batch, attn,
                           mu_dtype=mu_dtype)
@@ -166,6 +167,14 @@ def main() -> int:
                  "error": f"{type(e).__name__}: {str(e)[:120]}"}
         print(json.dumps(r), flush=True)
         results.append(r)
+    if not results:
+        # A sweep that emitted NOTHING must say so on stdout — a
+        # silent rc=1 from a queue stage reads like a crash in
+        # chip_logs (r5 rehearsal finding: an in-loop skip left
+        # stages 4/4e/4f with zero rows for three rounds).
+        print(json.dumps({"error": "sweep emitted no points "
+                          f"(grid had {len(grid)})"}), flush=True)
+        return 1
     ok = [r for r in results if "error" not in r]
     if ok:
         best = max(ok, key=lambda r: r["tokens_per_s"])

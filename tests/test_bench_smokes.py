@@ -62,3 +62,62 @@ def test_bench_decompose_tiny_emits_sections():
     assert proc.returncode == 0, proc.stderr[-800:]
     sections = {r.get("section") for r in rows}
     assert len(rows) >= 3, rows
+
+
+def _queue_agenda(tmp_path):
+    """Every (env, argv) pair chip_queue.sh would run, parsed from its
+    own dry-run echo — the rehearsal below can never drift from the
+    real agenda."""
+    qdir = tmp_path / "q"
+    qdir.mkdir()
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PBST_")}
+    env.update({"PBST_QUEUE_DRYRUN": "1",
+                "PBST_QUEUE_DRYRUN_DIR": str(qdir)})
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "chip_queue.sh")],
+        capture_output=True, text=True, timeout=60, env=env,
+        cwd=str(qdir))
+    assert proc.returncode == 0, proc.stderr
+    agenda = []
+    for log in sorted((qdir / "chip_logs").glob("queue_*.log")):
+        for ln in log.read_text().splitlines():
+            if "DRYRUN: " not in ln:
+                continue
+            toks = ln.split("DRYRUN: ", 1)[1].split()
+            stage_env = {}
+            while toks and "=" in toks[0] and not toks[0].startswith(
+                    "python"):
+                k, v = toks.pop(0).split("=", 1)
+                stage_env[k] = v
+            agenda.append((stage_env, toks))
+    return agenda
+
+
+def test_queue_stage_rehearsal_tiny(tmp_path):
+    """Execute every sweep/candidate stage command from the REAL queue
+    agenda in tiny mode on CPU (r5: stage 4's pallas-only grid was
+    silently empty in tiny mode for three rounds — only echoed, never
+    executed; a stage-level bug like that on the chip burns the one
+    claim window).  Plain-bench and serving/longctx/decompose stages
+    are covered by the dedicated smokes above."""
+    agenda = _queue_agenda(tmp_path)
+    assert len(agenda) >= 14, agenda
+    rehearsed = 0
+    for stage_env, argv in agenda:
+        script = argv[-1] if argv[-1].endswith(".py") else None
+        if script == "bench_sweep.py":
+            tiny_knob = "PBST_SWEEP_TINY"
+        elif script == "bench.py" and any(
+                k.startswith("PBST_BENCH_") for k in stage_env):
+            tiny_knob = "PBST_BENCH_TINY"  # candidate stages 5c-5e
+        else:
+            continue  # chip-only (tpu_tests) or covered by other smokes
+        proc, rows = _run(script, {**stage_env, tiny_knob: "1"})
+        label = f"{stage_env} {argv}"
+        assert proc.returncode == 0, f"{label}: {proc.stderr[-800:]}"
+        ok = [r for r in rows if "error" not in r]
+        assert ok, f"{label}: no green rows ({rows})"
+        rehearsed += 1
+    # stages 4, 4c, 4d, 4e, 4f, 5c, 5d, 5e
+    assert rehearsed == 8, rehearsed
