@@ -22,6 +22,11 @@ set -u
 cd "$(dirname "$0")"
 mkdir -p chip_logs
 NOT_AFTER=${1:-$(($(date +%s) + 18000))}
+case "$NOT_AFTER" in
+    ''|*[!0-9]*)
+        echo "not_after must be a unix epoch (date +%s), got: $NOT_AFTER" >&2
+        exit 2;;
+esac
 # Quiet window between claim attempts (seconds). PBST_ prefix like
 # every other knob; legacy RETRY_QUIET_S still honored. Validated up
 # front: a non-numeric value would make `sleep` fail and turn the
@@ -33,6 +38,17 @@ case "$RETRY_QUIET" in
         echo "PBST_RETRY_QUIET_S must be a non-negative integer (seconds), got: $RETRY_QUIET" >&2
         exit 2;;
 esac
+# NOT_AFTER bounds ATTEMPTS; a SUCCESSFUL acquire gates the queue
+# start on the queue's own deadline instead (r5 incident, 10:32: a
+# 60 s one-attempt knock window meant the success landed past
+# NOT_AFTER and the old single-gate logic left a freshly-proven-free
+# chip idle).  Default: NOT_AFTER, the old behavior.
+QUEUE_DEADLINE=${PBST_QUEUE_DEADLINE:-$NOT_AFTER}
+case "$QUEUE_DEADLINE" in
+    ''|*[!0-9]*)
+        echo "PBST_QUEUE_DEADLINE must be a unix epoch (date +%s), got: $QUEUE_DEADLINE" >&2
+        exit 2;;
+esac
 START_MARK="chip_logs/.supervise_start_$$"
 touch "$START_MARK"
 LOG="chip_logs/supervise_$(date +%H%M%S).log"
@@ -42,11 +58,11 @@ fresh_result() {
         -newer "$START_MARK" | head -1
 }
 
-log "supervising; queue not-after $(date -d @"$NOT_AFTER" +%H:%M:%S)"
+log "supervising; knock window not-after $(date -d @"$NOT_AFTER" +%H:%M:%S); queue deadline $(date -d @"$QUEUE_DEADLINE" +%H:%M:%S)"
 ATTEMPT=0
 while :; do
     if [ "$(date +%s)" -ge "$NOT_AFTER" ]; then
-        log "past the queue deadline — no further claim attempts (chip left free for the driver)"
+        log "past the knock window — no further claim attempts (chip left free for the driver)"
         rm -f "$START_MARK"
         exit 0
     fi
@@ -69,7 +85,7 @@ while :; do
     # re-knocking every few minutes (the r02 watcher's tight cadence
     # is what kept its wedge alive).
     if [ "$(date +%s)" -ge "$NOT_AFTER" ]; then
-        log "past the queue deadline with no claim — stopping attempts (chip left free for the driver)"
+        log "past the knock window with no claim — stopping attempts (chip left free for the driver)"
         rm -f "$START_MARK"
         exit 0
     fi
@@ -77,7 +93,7 @@ while :; do
     sleep "$RETRY_QUIET"
 done
 rm -f "$START_MARK"
-if [ "$(date +%s)" -ge "$NOT_AFTER" ]; then
+if [ "$(date +%s)" -ge "$QUEUE_DEADLINE" ]; then
     log "past queue deadline: leaving the chip free for the driver's end-of-round bench"
     exit 0
 fi

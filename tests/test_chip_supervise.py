@@ -79,7 +79,7 @@ def test_deadline_stops_attempts_and_leaves_chip_free(tmp_path):
     qdir = _setup(tmp_path, "echo UNAVAILABLE; exit 1\n")
     rc, out = _run(qdir, int(time.time()) + 1, {})
     assert rc == 0, out
-    assert ("past the queue deadline" in out
+    assert ("past the knock window" in out
             or "no further claim attempts" in out)
     assert "starting chip_queue.sh" not in out
 
@@ -126,6 +126,27 @@ def test_success_after_deadline_skips_queue(tmp_path):
     assert "starting chip_queue.sh" not in out
 
 
+def test_success_past_not_after_still_runs_queue_before_deadline(
+        tmp_path):
+    """r5 incident (10:32): NOT_AFTER bounds ATTEMPTS — a one-attempt
+    window is deliberately tiny — but a SUCCESS inside that window
+    must still start the queue when the queue's own deadline
+    (PBST_QUEUE_DEADLINE, what chip_oneshot.sh passes) allows it."""
+    qdir = _setup(
+        tmp_path,
+        'sleep 3\n'
+        'echo \'{"value": 1.0}\' > chip_logs/runner_result_stub.json\n')
+    # not_after now+2: far enough out that spawn latency cannot eat
+    # the window before attempt 1 starts, yet the 3 s stub still
+    # finishes past it.
+    rc, out = _run(qdir, int(time.time()) + 2,
+                   {"PBST_QUEUE_DEADLINE": str(int(time.time()) + 3600)})
+    assert rc == 0, out
+    assert "runner attempt 1 succeeded" in out
+    assert "starting chip_queue.sh" in out
+    assert "queue complete" in out or "queue done" in out
+
+
 def test_oneshot_validates_and_makes_single_attempt(tmp_path):
     """chip_oneshot.sh: numeric-epoch validation, then exactly one
     supervisor attempt when the window is sized for one (the round-4
@@ -161,7 +182,7 @@ def test_oneshot_validates_and_makes_single_attempt(tmp_path):
         logs += p.read_text()
     assert logs.count("runner attempt 1 (foreground") == 1
     assert "runner attempt 2 (foreground" not in logs
-    assert "past the queue deadline" in logs
+    assert "past the knock window" in logs
 
 
 def test_oneshot_driver_exclusion_window(tmp_path):
