@@ -361,11 +361,17 @@ def _flash_padded(q, k, v, causal, block_q, block_k, interpret,
     Hkv = k.shape[2]
     if H % Hkv:
         raise ValueError(f"H={H} not a multiple of Hkv={Hkv}")
-    bq = min(block_q, max(S, 1))
-    bk = min(block_k, max(S, 1))
-    # Asymmetric clamped blocks (e.g. block_q=128, block_k=32 at S=100
-    # -> bq=100, bk=32): shrink the larger to a multiple of the smaller
-    # so the padded length is one small multiple, not an lcm blow-up.
+    # Mosaic tiling: position-dim loads index in sublane units of 8 and
+    # the (BQ, BK) score tiles want full lanes, so a short or ragged S
+    # pads UP to a 128-multiple tile — never clamp blocks down to S
+    # (r5 stage-2 on-chip finding: S=127 clamped bq/bk to 127 and
+    # Mosaic rejected the 127-row loads; interpret mode accepted them).
+    s_tile = -(-max(S, 1) // 128) * 128
+    bq = min(block_q, s_tile)
+    bk = min(block_k, s_tile)
+    # Asymmetric blocks (e.g. block_q=128, block_k=32 at S=100): shrink
+    # the larger to a multiple of the smaller so the padded length is
+    # one small multiple, not an lcm blow-up.
     if bq % bk and bk % bq:
         if bq > bk:
             bq = (bq // bk) * bk

@@ -27,6 +27,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK = 256
 
@@ -44,7 +45,12 @@ def _mm_kernel(a_ref, b_ref, o_ref, stats_ref, *, n_k: int):
 
     @pl.when(jnp.logical_and(i == 0, jnp.logical_and(j == 0, k == 0)))
     def _init_stats():
-        stats_ref[...] = jnp.zeros_like(stats_ref)
+        # Per-slot scalar stores: the stats ref lives in SMEM (the
+        # scalar memory — r5 stage-2 on-chip finding: Mosaic rejects
+        # scalar stores to VMEM, which interpret mode accepted), and
+        # SMEM takes scalar writes, not vector ones.
+        for t in range(N_STATS):
+            stats_ref[t] = 0
 
     @pl.when(k == 0)
     def _init_out():
@@ -136,7 +142,9 @@ def instrumented_matmul(
         ],
         out_specs=[
             pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
-            pl.BlockSpec((N_STATS,), lambda i, j, k: (0,)),
+            # Scalar counters accumulate in SMEM (Mosaic: VMEM takes
+            # vector stores only); whole array, every grid cell.
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((M, N), jnp.float32),

@@ -48,6 +48,15 @@ def test_bench_serving_tiny_covers_the_matrix():
     assert len(engine_rows) == 8, sorted(metrics)
     errs = [r for r in engine_rows if "error" in r]
     assert not errs, errs
+    # Self-draft spec rows (bf16 dense, dropless MoE) are exact on the
+    # CPU's deterministic f32 path: acceptance must be ~1.0.  This is
+    # the guard the r5 chip run showed was missing — the MoE spec rows
+    # silently drafted with unrelated dense weights and measured the
+    # acceptance FLOOR (0.0 over the real vocab).
+    for m in ("serving_spec_continuous_bf16_throughput",
+              "serving_spec_continuous_moe_dropless_throughput"):
+        row = next(r for r in engine_rows if r["metric"] == m)
+        assert row["acceptance"] >= 0.9, row
 
 
 def test_bench_longctx_tiny_emits_points():
