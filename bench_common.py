@@ -34,6 +34,37 @@ def parse_mu_dtype(raw: str | None):
                      "or f32/fp32/float32")
 
 
+def backend_unavailable(e: BaseException) -> bool:
+    """True when ``e`` is the TPU plugin's claim-held UNAVAILABLE from
+    backend INIT specifically (jax's "Unable to initialize backend"
+    wrapper) — not a transient mid-run RPC UNAVAILABLE, which stays a
+    point-level error.  Init failure is FATAL for a whole sweep-style
+    script: jax re-attempts plugin init on the next backend touch, so
+    a per-point retry loop becomes a 0-gap knock cascade — each point
+    parks ~25 min in the plugin's retry loop and that parked waiter
+    refreshes the hold (docs/OPS.md lifecycle point 3; observed live
+    in r5 stage 4c).  Callers stop the loop via
+    :func:`abandon_if_unavailable` after printing the point's own
+    error row."""
+    s = str(e)
+    return "UNAVAILABLE" in s and "Unable to initialize backend" in s
+
+
+def abandon_if_unavailable(e: BaseException, what: str) -> bool:
+    """One shared abandonment site: if ``e`` is a fatal backend-init
+    UNAVAILABLE, print a single error row saying ``what`` is being
+    abandoned and return True (caller breaks its loop)."""
+    import json
+
+    if not backend_unavailable(e):
+        return False
+    print(json.dumps({"error": (
+        f"backend unavailable: abandoning {what} (claim held; a "
+        "per-point retry would re-knock the lease with zero gap and "
+        "park ~25 min per point)")}), flush=True)
+    return True
+
+
 def setup_compilation_cache(log=None) -> None:
     """Point JAX at the repo-local persistent compile cache
     (best-effort: a backend that cannot serialize executables just

@@ -24,6 +24,7 @@ import sys
 import time
 
 from bench_common import PEAK_FLOPS  # bf16, TPU v5e — one copy
+from bench_common import abandon_if_unavailable
 
 # (seq, batch): batch shrinks as S grows to hold tokens/step roughly
 # constant and fit HBM; global batch is the dp axis's job in training.
@@ -109,13 +110,18 @@ def main() -> int:
 
     results = []
     for (seq, batch), attn in [(p, a) for p in POINTS for a in ATTN]:
+        fatal = None
         try:
             r = run_point(cfg_base, seq, batch, attn)
         except Exception as e:  # noqa: BLE001 — OOM etc. is a result
             r = {"seq": seq, "batch": batch, "attn": attn,
                  "error": f"{type(e).__name__}: {str(e)[:120]}"}
+            fatal = e
         print(json.dumps(r), flush=True)
         results.append(r)
+        if fatal is not None and abandon_if_unavailable(
+                fatal, "the remaining long-context points"):
+            break
     ok = [r for r in results if "error" not in r]
     for seq, _ in POINTS:
         cols = {r["attn"]: r for r in ok if r["seq"] == seq}

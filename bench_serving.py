@@ -28,7 +28,7 @@ def main() -> int:
     import jax
     import jax.numpy as jnp
 
-    from bench_common import setup_compilation_cache
+    from bench_common import abandon_if_unavailable, setup_compilation_cache
 
     setup_compilation_cache()
 
@@ -59,11 +59,11 @@ def main() -> int:
     def pre(params, toks):
         cache = init_cache(cfg, batch, max_len=prompt_len + new_tokens)
         logits, cache = prefill(cfg, params, toks, cache)
-        # Last position only: the sync still covers the whole prefill
-        # (logits depend on it) but the reduce itself is negligible,
-        # so the timed value is prefill + one RTT, matching what each
-        # timed gen iteration pays below.
-        return jnp.sum(logits[:, -1, :].astype(jnp.float32))
+        # prefill returns last-position logits, (B, vocab): the sync
+        # still covers the whole prompt pass (logits depend on it) and
+        # the reduce is negligible, so the timed value is prefill plus
+        # one RTT — matching what each timed gen iteration pays below.
+        return jnp.sum(logits.astype(jnp.float32))
 
     float(pre(params, prompt))  # compile + sync
     ttfts = []
@@ -214,10 +214,13 @@ def main() -> int:
     eng = None
     for name, make_eng in engines:
         # One engine failing (OOM, lowering) must not cost the other
-        # rows their chip time — an error row IS a result. Drop the
-        # previous engine BEFORE building the next so a dead engine's
-        # KV caches don't sit in HBM under the new allocation.
+        # rows their chip time — an error row IS a result (but a
+        # backend-INIT failure is fatal for the whole matrix: every
+        # further engine would re-knock a held lease with zero gap).
+        # Drop the previous engine BEFORE building the next so a dead
+        # engine's KV caches don't sit in HBM under the new allocation.
         eng = None
+        fatal = None
         try:
             eng = make_eng()
             for p in prompts:
@@ -243,7 +246,11 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001 — keep the matrix going
             row = {"metric": f"serving_{name}_throughput",
                    "error": f"{type(e).__name__}: {str(e)[:120]}"}
+            fatal = e
         print(json.dumps(row), flush=True)
+        if fatal is not None and abandon_if_unavailable(
+                fatal, "the remaining serving engines"):
+            break
     return 0 if any_engine_ok else 1
 
 

@@ -21,6 +21,7 @@ import sys
 import time
 
 from bench_common import PEAK_FLOPS  # bf16, TPU v5e — one copy
+from bench_common import abandon_if_unavailable
 
 REMAT = [("none", False, "full"), ("dots", True, "dots"),
          ("full", True, "full")]
@@ -155,6 +156,7 @@ def main() -> int:
         # Interpreter-mode pallas smokes fine at the tiny shape
         # (~10 s/point on CPU) — the r2-era skip here would silently
         # empty the pallas-only queue stages in tiny mode.
+        fatal = None
         try:
             r = run_point(cfg_base, rname, remat, policy, batch, attn,
                           mu_dtype=mu_dtype)
@@ -165,8 +167,12 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001 — a failing point (OOM,
             r = {"remat": rname, "batch": batch, "attn": attn,  # eg)
                  "error": f"{type(e).__name__}: {str(e)[:120]}"}
+            fatal = e
         print(json.dumps(r), flush=True)
         results.append(r)
+        if fatal is not None and abandon_if_unavailable(
+                fatal, "the remaining sweep points"):
+            break
     if not results:
         # A sweep that emitted NOTHING must say so on stdout — a
         # silent rc=1 from a queue stage reads like a crash in

@@ -1,0 +1,143 @@
+"""Sweep-style scripts abandon on a backend-init UNAVAILABLE.
+
+r5 stage 4c, live: the stage lost the lease-release race, point 1
+parked 25 min in the plugin's retry loop, and the per-point loop then
+re-knocked the held lease with ZERO gap — each further point another
+~25 min parked waiter, and a parked waiter's retry loop refreshes the
+hold (docs/OPS.md lifecycle point 3).  A backend-init UNAVAILABLE is
+therefore fatal for the whole script: emit the error row, say the
+sweep is abandoned, exit — the queue's inter-stage gap re-samples the
+lease cleanly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench_common import backend_unavailable  # noqa: E402
+
+UNAVAILABLE_MSG = (
+    "Unable to initialize backend 'axon': UNAVAILABLE: TPU backend "
+    "setup/compile error (Unavailable). (set JAX_PLATFORMS='' to "
+    "automatically choose an available backend)"
+)
+
+
+@pytest.mark.parametrize("exc,fatal", [
+    (RuntimeError(UNAVAILABLE_MSG), True),
+    # Point-level failures stay point-level: the sweep must keep going.
+    (RuntimeError("RESOURCE_EXHAUSTED: out of memory on HBM"), False),
+    (ValueError("shape mismatch"), False),
+    # A transient mid-run RPC UNAVAILABLE is NOT an init failure — the
+    # next point may run fine; only jax's init wrapper is fatal.
+    (RuntimeError("UNAVAILABLE: socket closed talking to TPU backend"),
+     False),
+])
+def test_backend_unavailable_classification(exc, fatal):
+    assert backend_unavailable(exc) is fatal
+
+
+def _clean_env(monkeypatch, prefix):
+    for k in list(os.environ):
+        if k.startswith(prefix):
+            monkeypatch.delenv(k)
+
+
+def test_sweep_abandons_after_first_unavailable(monkeypatch, capsys):
+    import bench_sweep
+
+    _clean_env(monkeypatch, "PBST_SWEEP_")
+    monkeypatch.setenv("PBST_SWEEP_TINY", "1")
+    for g in ("REMAT", "BATCHES", "ATTN", "SEQ", "STEPS"):
+        monkeypatch.setattr(bench_sweep, g, getattr(bench_sweep, g))
+    calls = []
+
+    def boom(*a, **k):
+        calls.append(1)
+        raise RuntimeError(UNAVAILABLE_MSG)
+
+    monkeypatch.setattr(bench_sweep, "run_point", boom)
+    rc = bench_sweep.main()
+    out = capsys.readouterr().out
+    assert rc == 1
+    # ONE knock, not one per grid point (tiny grid has 6 points).
+    assert len(calls) == 1, calls
+    rows = [json.loads(ln) for ln in out.splitlines()
+            if ln.startswith("{")]
+    assert any("abandoning the remaining sweep points" in
+               r.get("error", "") for r in rows), rows
+
+
+def test_sweep_keeps_going_after_point_level_failure(monkeypatch,
+                                                     capsys):
+    import bench_sweep
+
+    _clean_env(monkeypatch, "PBST_SWEEP_")
+    monkeypatch.setenv("PBST_SWEEP_TINY", "1")
+    for g in ("REMAT", "BATCHES", "ATTN", "SEQ", "STEPS"):
+        monkeypatch.setattr(bench_sweep, g, getattr(bench_sweep, g))
+    calls = []
+
+    def oom(*a, **k):
+        calls.append(1)
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+    monkeypatch.setattr(bench_sweep, "run_point", oom)
+    rc = bench_sweep.main()
+    out = capsys.readouterr().out
+    assert rc == 1  # no green rows
+    assert len(calls) == 6, calls  # every grid point still probed
+    assert "abandoning" not in out
+
+
+def test_serving_abandons_engines_after_first_unavailable(
+        monkeypatch, capsys):
+    """The engine matrix has the same keep-going loop; a backend-init
+    UNAVAILABLE from the first engine must not knock ~10 more times."""
+    import pbs_tpu.models as models_pkg
+
+    import bench_serving
+
+    _clean_env(monkeypatch, "PBST_BENCH_")
+    monkeypatch.setenv("PBST_BENCH_TINY", "1")
+    calls = []
+
+    class Boom:
+        def __init__(self, *a, **k):
+            calls.append(1)
+            raise RuntimeError(UNAVAILABLE_MSG)
+
+    monkeypatch.setattr(models_pkg, "ContinuousBatcher", Boom)
+    monkeypatch.setattr(models_pkg, "SpeculativeBatcher", Boom)
+    rc = bench_serving.main()
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert len(calls) == 1, calls  # not one knock per engine row
+    assert "abandoning the remaining serving engines" in out
+
+
+def test_longctx_abandons_after_first_unavailable(monkeypatch, capsys):
+    import bench_longctx
+
+    _clean_env(monkeypatch, "PBST_LONGCTX_")
+    for g in ("POINTS", "STEPS", "ATTN"):
+        monkeypatch.setattr(bench_longctx, g, getattr(bench_longctx, g))
+    calls = []
+
+    def boom(*a, **k):
+        calls.append(1)
+        raise RuntimeError(UNAVAILABLE_MSG)
+
+    monkeypatch.setattr(bench_longctx, "run_point", boom)
+    rc = bench_longctx.main()
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert len(calls) == 1, calls
+    assert "abandoning the remaining long-context points" in out
