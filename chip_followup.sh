@@ -1,0 +1,104 @@
+#!/bin/bash
+# Post-queue follow-ups for an r5-style claim window.  Run ONLY after
+# chip_queue.sh has logged "queue complete" (or stopped at its
+# deadline) — ONE chip client at a time, a lease-settle gap between
+# every pair, and NOTHING here runs under timeout(1) or signals a
+# client (docs/OPS.md wedge rule, inherited wholesale).
+#
+#   ./chip_followup.sh <run_ts> [not_after_epoch]
+#
+# run_ts — the queue run's artifact id (e.g. 20260801-103336): the
+# candidate benches below join THAT run's artifact family, so
+# tools/flip_decision.py can weigh them against the same run's
+# default-config headline (its same-run rule).
+# not_after — latest epoch to START a new stage (default: now + 2 h);
+# mirrors PBST_QUEUE_DEADLINE so the driver's end-of-round bench
+# always finds the chip free.
+set -u
+cd "$(dirname "$0")"
+mkdir -p chip_logs
+RUN_TS=${1:?usage: chip_followup.sh <run_ts> [not_after_epoch]}
+NOT_AFTER=${2:-$(($(date +%s) + 7200))}
+case "$NOT_AFTER" in
+    ''|*[!0-9]*)
+        echo "not_after must be a unix epoch (date +%s), got: $NOT_AFTER" >&2
+        exit 2;;
+esac
+TS=$(date +%Y%m%d-%H%M%S)
+log() { echo "[followup $(date +%H:%M:%S)] $*" | tee -a "chip_logs/followup_$TS.log"; }
+gate() {
+    if [ "$(date +%s)" -ge "$NOT_AFTER" ]; then
+        log "deadline passed before $1 — stopping (chip left free)"
+        exit 0
+    fi
+}
+GAP=${PBST_QUEUE_GAP_S:-45}
+case "$GAP" in
+    ''|*[!0-9]*)
+        # With no `set -e`, a bad GAP would make `sleep` error and the
+        # next chip client would launch with a 0 s gap — the exact
+        # lease-release race the gap exists to prevent.
+        echo "PBST_QUEUE_GAP_S must be a non-negative integer (seconds), got: $GAP" >&2
+        exit 2;;
+esac
+# Same dry-run seam as chip_queue.sh: PBST_QUEUE_DRYRUN=1 echoes every
+# stage command instead of launching a chip client, skips the lease
+# gaps (nothing to settle), and works in a scratch dir so the stage
+# redirections can never shadow real artifacts in chip_logs/.
+DRYRUN=${PBST_QUEUE_DRYRUN:-}
+if [ "$DRYRUN" = "1" ]; then
+    DRYDIR=${PBST_QUEUE_DRYRUN_DIR:-$(mktemp -d /tmp/pbst_followup_dry.XXXXXX)}
+    echo "[followup] DRYRUN artifacts under $DRYDIR" >&2
+    cd "$DRYDIR"
+    mkdir -p chip_logs
+fi
+gap() {
+    gate "the next stage's gap"
+    if [ "$DRYRUN" = "1" ]; then return 0; fi
+    log "inter-client gap ${GAP}s"
+    sleep "$GAP"
+}
+run() {
+    if [ "$DRYRUN" = "1" ]; then
+        local levers
+        levers=$(env | grep -E '^PBST_(SWEEP|TPU|BENCH)_' | sort | tr '\n' ' ')
+        echo "[followup $(date +%H:%M:%S)] DRYRUN: ${levers}$*" \
+            >> "chip_logs/followup_$TS.log"
+        return 0
+    fi
+    "$@"
+}
+
+# Stage F1: the flip candidate the stage-4 sweep selected — flash
+# attention at the protocol-default batch, under bench.py's EXACT
+# driver protocol. Joins run $RUN_TS so the flip decision can use it.
+gap
+log "F1: candidate bench attn=pallas (sweep best: dots/6/pallas)"
+PBST_BENCH_ATTN=pallas run python bench.py \
+    >"chip_logs/cand6p_${RUN_TS}.json" 2>"chip_logs/cand6p_${RUN_TS}.err"
+log "cand6p rc=$? ($(cat "chip_logs/cand6p_${RUN_TS}.json" 2>/dev/null))"
+if grep -qE "worker left running|claim-unavailable" \
+        "chip_logs/cand6p_${RUN_TS}.json" 2>/dev/null; then
+    log "F1 left a worker or found the claim held — stopping the followup"
+    exit 1
+fi
+
+# Stage F2: re-validate the kernel fixes stage 2 motivated (SMEM
+# stats, ragged-S tiling) on silicon.
+gap
+gate "stage F2"
+log "F2: tpu_tests re-run (kernel fixes)"
+PBST_TPU_TESTS=1 PYTHONUNBUFFERED=1 run python -u -m pytest tpu_tests/ -v \
+    >"chip_logs/tpu_tests_$TS.log" 2>&1
+log "tpu_tests rc=$? (tail: $(tail -1 "chip_logs/tpu_tests_$TS.log"))"
+
+# Stage F3: serving matrix re-run with honest timings and MoE
+# self-draft rows (the stage-3 artifact's two measurement bugs).
+gap
+gate "stage F3"
+log "F3: serving benchmark re-run"
+run python bench_serving.py \
+    >"chip_logs/serving_$TS.json" 2>"chip_logs/serving_$TS.err"
+log "serving rc=$? ($(cat "chip_logs/serving_$TS.json" 2>/dev/null | tr '\n' ' ' | head -c 600))"
+
+log "followup complete"
