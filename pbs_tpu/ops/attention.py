@@ -353,6 +353,46 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 # -- public API -------------------------------------------------------------
 
 
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def plan_blocks(S: int, block_q: int, block_k: int) -> tuple[int, int, int]:
+    """Mosaic-safe (bq, bk, S_pad) for the position dim.
+
+    The hardware contract this encodes (r5 stage-2 on-chip finding —
+    interpret mode accepts violations, Mosaic rejects them):
+    position-dim loads index in sublane units of 8, so bq (the score
+    tile's sublane dim) and every load offset must be a multiple of 8;
+    bk lands in the score tile's LANE dim, where the module keeps the
+    stricter full-lane contract its knob validator already asserts
+    (``_tile_checked`` mult=128 for K — only chip-validated at 128,
+    so the planner never emits less).  A short or ragged S therefore
+    pads UP to a 128-multiple tile rather than clamping blocks down to
+    S (S=127 clamped bq/bk to 127 and Mosaic refused the 127-row
+    loads).  Invariants (pinned host-side by
+    tests/test_attention.py::test_plan_blocks_mosaic_contract):
+    bq % 8 == 0; bk % 128 == 0; S_pad >= S; S_pad % bq == S_pad % bk
+    == 0.
+    """
+    s_tile = _round_up(max(S, 1), 128)
+    # API callers may pass any positive block knob; round up to each
+    # dim's quantum before fitting (the env knobs are pre-validated by
+    # _tile_checked, this covers direct callers).
+    bk = min(_round_up(max(block_k, 1), 128), s_tile)
+    bq = min(_round_up(max(block_q, 1), 8), s_tile)
+    # Mutual divisibility so one S_pad serves both grids: bq above bk
+    # rounds down to a bk multiple; bq below bk rounds down to a
+    # multiple-of-8 divisor of bk (floor 8 — bk is a 128 multiple).
+    if bq >= bk:
+        bq = (bq // bk) * bk
+    else:
+        while bk % bq:
+            bq -= 8
+    S_pad = _round_up(S, max(bq, bk))
+    return bq, bk, S_pad
+
+
 def _flash_padded(q, k, v, causal, block_q, block_k, interpret,
                   out_f32=False):
     """Shared pad/transpose plumbing; returns ((B,S,H,hd) o, (B,S,H,1)
@@ -361,24 +401,7 @@ def _flash_padded(q, k, v, causal, block_q, block_k, interpret,
     Hkv = k.shape[2]
     if H % Hkv:
         raise ValueError(f"H={H} not a multiple of Hkv={Hkv}")
-    # Mosaic tiling: position-dim loads index in sublane units of 8 and
-    # the (BQ, BK) score tiles want full lanes, so a short or ragged S
-    # pads UP to a 128-multiple tile — never clamp blocks down to S
-    # (r5 stage-2 on-chip finding: S=127 clamped bq/bk to 127 and
-    # Mosaic rejected the 127-row loads; interpret mode accepted them).
-    s_tile = -(-max(S, 1) // 128) * 128
-    bq = min(block_q, s_tile)
-    bk = min(block_k, s_tile)
-    # Asymmetric blocks (e.g. block_q=128, block_k=32 at S=100): shrink
-    # the larger to a multiple of the smaller so the padded length is
-    # one small multiple, not an lcm blow-up.
-    if bq % bk and bk % bq:
-        if bq > bk:
-            bq = (bq // bk) * bk
-        else:
-            bk = (bk // bq) * bq
-    blk = max(bq, bk)
-    S_pad = -(-S // blk) * blk
+    bq, bk, S_pad = plan_blocks(S, block_q, block_k)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 

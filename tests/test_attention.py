@@ -103,6 +103,38 @@ def test_flash_grad_matches_dense(S, causal, Hkv):
             a / scale, b / scale, atol=2e-5, err_msg=f"d{name}")
 
 
+def test_plan_blocks_mosaic_contract():
+    """The Mosaic position-dim tiling contract, pinned host-side (the
+    fake-backend pattern, SURVEY.md §4): interpret mode accepted the
+    S=127 clamp that Mosaic rejected on chip (r5 stage 2), so the
+    block plan's invariants are asserted here for every shape class —
+    sublane-multiple blocks, padded length covering S and divisible by
+    both block sizes (loads at j*bk offsets stay 8-aligned)."""
+    from pbs_tpu.ops.attention import plan_blocks
+
+    for S in (1, 7, 8, 100, 127, 128, 129, 255, 1023, 1024, 4095,
+              8192):
+        for block_q, block_k in ((128, 128), (128, 32), (32, 128),
+                                 (256, 512), (4, 128), (128, 4)):
+            bq, bk, S_pad = plan_blocks(S, block_q, block_k)
+            label = f"S={S} knobs=({block_q},{block_k})"
+            # bq: sublane quantum; bk: full-lane quantum (the stricter
+            # contract _tile_checked asserts for the K knob — the
+            # planner must never emit a bk silicon hasn't validated).
+            assert bq % 8 == 0 and bk % 128 == 0, (label, bq, bk)
+            assert S_pad >= S, (label, S_pad)
+            assert S_pad % bq == 0 and S_pad % bk == 0, (
+                label, bq, bk, S_pad)
+            # Padding stays bounded: never more than one tile beyond
+            # the 128-multiple roundup of S.
+            assert S_pad <= _round_up_ref(S) + max(bq, bk), (
+                label, S_pad)
+
+
+def _round_up_ref(S):
+    return -(-max(S, 1) // 128) * 128
+
+
 def test_flash_trains_flagship_shape():
     """attn_impl='pallas' end to end through a train step at a ragged
     sequence length — regression for the S=1023 sweep failure plus the
