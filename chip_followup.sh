@@ -16,7 +16,6 @@
 # always finds the chip free.
 set -u
 cd "$(dirname "$0")"
-mkdir -p chip_logs
 RUN_TS=${1:?usage: chip_followup.sh <run_ts> [not_after_epoch]}
 NOT_AFTER=${2:-$(($(date +%s) + 7200))}
 case "$NOT_AFTER" in
@@ -24,14 +23,6 @@ case "$NOT_AFTER" in
         echo "not_after must be a unix epoch (date +%s), got: $NOT_AFTER" >&2
         exit 2;;
 esac
-TS=$(date +%Y%m%d-%H%M%S)
-log() { echo "[followup $(date +%H:%M:%S)] $*" | tee -a "chip_logs/followup_$TS.log"; }
-gate() {
-    if [ "$(date +%s)" -ge "$NOT_AFTER" ]; then
-        log "deadline passed before $1 — stopping (chip left free)"
-        exit 0
-    fi
-}
 GAP=${PBST_QUEUE_GAP_S:-45}
 case "$GAP" in
     ''|*[!0-9]*)
@@ -43,15 +34,24 @@ case "$GAP" in
 esac
 # Same dry-run seam as chip_queue.sh: PBST_QUEUE_DRYRUN=1 echoes every
 # stage command instead of launching a chip client, skips the lease
-# gaps (nothing to settle), and works in a scratch dir so the stage
-# redirections can never shadow real artifacts in chip_logs/.
+# gaps (nothing to settle), and works in a scratch dir so a dry run
+# writes NOTHING into the real checkout — the cd happens before the
+# first mkdir/log so even the artifact directory is scratch-side.
 DRYRUN=${PBST_QUEUE_DRYRUN:-}
 if [ "$DRYRUN" = "1" ]; then
     DRYDIR=${PBST_QUEUE_DRYRUN_DIR:-$(mktemp -d /tmp/pbst_followup_dry.XXXXXX)}
     echo "[followup] DRYRUN artifacts under $DRYDIR" >&2
     cd "$DRYDIR"
-    mkdir -p chip_logs
 fi
+mkdir -p chip_logs
+TS=$(date +%Y%m%d-%H%M%S)
+log() { echo "[followup $(date +%H:%M:%S)] $*" | tee -a "chip_logs/followup_$TS.log"; }
+gate() {
+    if [ "$(date +%s)" -ge "$NOT_AFTER" ]; then
+        log "deadline passed before $1 — stopping (chip left free)"
+        exit 0
+    fi
+}
 gap() {
     gate "the next stage's gap"
     if [ "$DRYRUN" = "1" ]; then return 0; fi

@@ -1,0 +1,88 @@
+"""chip_followup.sh control logic, chip-free (PBST_QUEUE_DRYRUN=1).
+
+The follow-up script spends claim-window minutes directly; its gates
+(deadline, bad-knob fail-fast, claim-held abort) must be provably
+correct without a chip, like chip_queue.sh's
+(tests/test_chip_queue.py).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(tmp_path, args, extra_env=None):
+    qdir = tmp_path / "f"
+    qdir.mkdir(exist_ok=True)
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PBST_")}
+    env.update({"PBST_QUEUE_DRYRUN": "1",
+                "PBST_QUEUE_DRYRUN_DIR": str(qdir),
+                **(extra_env or {})})
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "chip_followup.sh"), *args],
+        capture_output=True, text=True, timeout=60, env=env,
+        cwd=str(qdir))
+    logs = ""
+    for p in sorted((qdir / "chip_logs").glob("followup_*.log")):
+        logs += p.read_text()
+    return proc, proc.stdout + proc.stderr + logs
+
+
+def test_dryrun_walks_all_stages_with_levers(tmp_path):
+    proc, out = _run(tmp_path, ["20260801-103336"])
+    assert proc.returncode == 0, out
+    # F1 carries its one knob — a dropped lever here would burn a real
+    # claim window on a duplicate default-config bench.
+    assert "PBST_BENCH_ATTN=pallas python bench.py" in out
+    assert "PBST_TPU_TESTS=1 python -u -m pytest tpu_tests/" in out
+    assert "python bench_serving.py" in out
+    assert "followup complete" in out
+
+
+def test_missing_run_ts_fails_fast(tmp_path):
+    proc, out = _run(tmp_path, [])
+    assert proc.returncode != 0
+    assert "usage" in out
+
+
+def test_bad_deadline_fails_fast(tmp_path):
+    proc, out = _run(tmp_path, ["20260801-103336", "tonight"])
+    assert proc.returncode == 2
+    assert "unix epoch" in out
+    assert "DRYRUN:" not in out  # no stage reached
+
+
+def test_bad_gap_fails_fast(tmp_path):
+    proc, out = _run(tmp_path, ["20260801-103336"],
+                     {"PBST_QUEUE_GAP_S": "45s"})
+    assert proc.returncode == 2
+    assert "PBST_QUEUE_GAP_S" in out
+    assert "DRYRUN" not in out
+
+
+def test_past_deadline_runs_nothing(tmp_path):
+    proc, out = _run(tmp_path,
+                     ["20260801-103336", str(int(time.time()) - 10)])
+    assert proc.returncode == 0, out
+    assert "deadline passed" in out
+    assert "DRYRUN:" not in out  # zero chip clients would have started
+
+
+def test_candidate_artifact_joins_the_given_run(tmp_path):
+    """F1's artifact name is derived from the run_ts argument — the
+    join tools/flip_decision.py's same-run rule depends on.  The dry
+    run still executes the stage redirections (in its scratch dir),
+    so the target's existence is a RUNTIME assertion of the
+    propagation, not a source grep."""
+    proc, out = _run(tmp_path, ["19990101-000000"])
+    assert proc.returncode == 0, out
+    assert (tmp_path / "f" / "chip_logs"
+            / "cand6p_19990101-000000.json").exists()
+    # And nothing leaked into the real checkout's artifact dir.
+    assert not os.path.exists(
+        os.path.join(REPO, "chip_logs", "cand6p_19990101-000000.json"))
