@@ -50,6 +50,8 @@ def main() -> int:
                            "cand8p_*.json"),
                           ("candidate bench (remat=none)",
                            "cand6rn_*.json"),
+                          ("candidate bench (flash only, followup F1)",
+                           "cand6p_*.json"),
                           ("final bench", "bench_final_*.json")):
         for path in _newest(os.path.join(d, pattern))[:2]:
             rows = _read_jsonl(path)
