@@ -55,18 +55,35 @@ def hbm(dev) -> str:
         return f"stats-err:{e}"
 
 
+def make_waiter_watchdog(backend_ready, self_exit_s: float,
+                         grace_s: float, log=mark, _exit=os._exit):
+    """Two-phase waiter self-exit (r5): the plugin's own ~25-min
+    UNAVAILABLE raise stopped firing on Aug 1 (the 04:52 driver
+    worker and the 06:10 runner both parked >45 min with no raise),
+    and a runner with no watchdog then parks FOREVER — keeping one
+    client on the lease continuously, the exact r3 all-day-wedge
+    shape.  Same design as bench.py's worker: the primary window sits
+    well past the plugin's raise so the clean-raise path wins whenever
+    it works; the grace window protects a lease granted late whose
+    devices() is still in flight (a waiter that never acquired is safe
+    to stop — docs/OPS.md; only exiting a HOLDER wedges).  jax-free
+    and injectable so tests pin the firing/suppression logic without a
+    chip (tests/test_chip_runner_watchdog.py)."""
+
+    def _watchdog():
+        if backend_ready.wait(self_exit_s):
+            return
+        log(f"no backend within {self_exit_s:.0f}s; self-exit in "
+            f"{grace_s:.0f}s unless the backend comes up")
+        if backend_ready.wait(grace_s):
+            return
+        log("claim-unavailable self-exit (waiter, never acquired)")
+        _exit(3)
+
+    return _watchdog
+
+
 def main() -> None:
-    # Waiter self-exit watchdog (r5): the plugin's own ~25-min
-    # UNAVAILABLE raise stopped firing on Aug 1 (the 04:52 driver
-    # worker and the 06:10 runner both parked >45 min with no raise),
-    # and a runner with no watchdog then parks FOREVER — keeping one
-    # client on the lease continuously, the exact r3 all-day-wedge
-    # shape.  Same two-phase design as bench.py's worker: the primary
-    # window sits well past the plugin's raise so the clean-raise path
-    # wins whenever it works; the grace window protects a lease
-    # granted late whose devices() is still in flight (a waiter that
-    # never acquired is safe to stop — docs/OPS.md; only exiting a
-    # HOLDER wedges).
     import threading
 
     def _f(name, dflt):
@@ -78,18 +95,9 @@ def main() -> None:
     self_exit_s = _f("PBST_RUNNER_SELF_EXIT_S", 3000.0)
     grace_s = _f("PBST_RUNNER_SELF_EXIT_GRACE_S", 300.0)
     backend_ready = threading.Event()
-
-    def _watchdog():
-        if backend_ready.wait(self_exit_s):
-            return
-        mark(f"no backend within {self_exit_s:.0f}s; self-exit in "
-             f"{grace_s:.0f}s unless the backend comes up")
-        if backend_ready.wait(grace_s):
-            return
-        mark("claim-unavailable self-exit (waiter, never acquired)")
-        os._exit(3)
-
-    threading.Thread(target=_watchdog, daemon=True).start()
+    threading.Thread(
+        target=make_waiter_watchdog(backend_ready, self_exit_s, grace_s),
+        daemon=True).start()
 
     mark("importing jax")
     import jax

@@ -1,0 +1,67 @@
+"""chip_runner's waiter self-exit watchdog, chip-free.
+
+The watchdog is the backstop for the plugin's unreliable ~25-min
+UNAVAILABLE raise (docs/OPS.md: parked waiters observed >45 min with
+no raise keep one client on the lease forever).  Its logic is
+injectable and jax-free, so the firing and both suppression windows
+are pinned here with tiny timeouts — no chip, no subprocess.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import chip_runner  # noqa: E402  (module top is jax-free by design)
+
+
+def _harness(self_exit_s, grace_s):
+    ready = threading.Event()
+    logs, exits = [], []
+    wd = chip_runner.make_waiter_watchdog(
+        ready, self_exit_s, grace_s, log=logs.append,
+        _exit=exits.append)
+    t = threading.Thread(target=wd, daemon=True)
+    return ready, logs, exits, t
+
+
+def test_never_acquired_fires_after_both_windows():
+    ready, logs, exits, t = _harness(0.05, 0.05)
+    t.start()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert exits == [3]
+    assert "no backend within" in logs[0]
+    assert "claim-unavailable self-exit" in logs[1]
+
+
+def test_acquire_in_primary_window_suppresses_everything():
+    ready, logs, exits, t = _harness(5.0, 5.0)
+    t.start()
+    ready.set()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert exits == [] and logs == []
+
+
+def test_acquire_in_grace_window_suppresses_exit():
+    """The kill-a-holder race the two-phase design narrows: a lease
+    granted AFTER the warning but inside the grace must not be exited
+    (exiting a holder wedges the claim for hours)."""
+    ready, logs, exits, t = _harness(0.05, 5.0)
+    t.start()
+    # Wait for the warning (primary window expired), then acquire.
+    deadline = time.monotonic() + 5.0
+    while not logs and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert logs and "no backend within" in logs[0]
+    ready.set()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert exits == []
+    assert len(logs) == 1  # warning only, no self-exit line
