@@ -220,6 +220,40 @@ def cmd_mon(args) -> int:
     return 0
 
 
+def cmd_oprofile(args) -> int:
+    """xenoprof/opreport analog over a live system's file-backed
+    ledger: passive-attach (zero cooperation from the profiled
+    process, like xenoprof passive domains —
+    xen-4.2.1/xen/common/xenoprof.c), sample for --seconds at
+    --period, then print the flat per-job profile."""
+    from pbs_tpu.obs.oprofile import ProfileSession
+
+    # Passive-only monitor session: no hosting partition, no timer —
+    # this loop drives sample_once with real timestamps.
+    sess = ProfileSession(None)
+    try:
+        sess.add_passive(args.name, args.ledger)
+        t_end = time.monotonic() + args.seconds
+        try:
+            while True:
+                sess.sample_once(time.monotonic_ns())
+                if time.monotonic() >= t_end:
+                    break
+                time.sleep(args.period / 1e3)
+        except KeyboardInterrupt:
+            pass  # partial profile is still a profile (cmd_top contract)
+        rep = sess.report()
+    finally:
+        sess.close()
+    print(f"{'job':<28} {'samples':>8} {'lost':>5} {'device_ms':>10} "
+          f"{'stall%':>7} {'coll_ms':>8} {'last_step':>9}")
+    for job, r in sorted(rep.items()):
+        print(f"{job:<28} {r['samples']:>8} {r['lost']:>5} "
+              f"{r['device_ms']:>10.3f} {r['stall_pct']:>7.2f} "
+              f"{r['collective_wait_ms']:>8.3f} {r['last_step']:>9}")
+    return 0
+
+
 def cmd_perf(args) -> int:
     """xenperf analog: format a published obs dump's software counters."""
     from pbs_tpu.obs.dumpfile import read_obs_dump
@@ -633,6 +667,19 @@ def main(argv=None) -> int:
     sp.add_argument("--iterations", type=int, default=0, help="0=forever")
     sp.add_argument("--clear", action="store_true")
     sp.set_defaults(fn=cmd_mon)
+
+    sp = sub.add_parser(
+        "oprofile",
+        help="passive sampling profile of a live ledger "
+             "(xenoprof/opreport)")
+    sp.add_argument("--ledger", required=True,
+                    help="file-backed ledger of the profiled partition")
+    sp.add_argument("--name", default="passive",
+                    help="label for the passive domain in the report")
+    sp.add_argument("--seconds", type=float, default=2.0)
+    sp.add_argument("--period", type=float, default=100.0,
+                    help="sampling period in ms")
+    sp.set_defaults(fn=cmd_oprofile)
 
     sp = sub.add_parser("perf", help="software counter dump (xenperf)")
     sp.add_argument("file", help="obs dump JSON (obs.dumpfile)")

@@ -94,10 +94,13 @@ class ProfileSession:
 
     def __init__(
         self,
-        partition: "Partition",
+        partition: "Partition | None",
         period_ns: int = 1 * MS,  # CSCHED_METRIC_TICK_PERIOD-class cadence
         max_samples_per_job: int = 4096,
     ):
+        """``partition=None`` makes a passive-only MONITOR session
+        (``pbst oprofile``): no active domains, no timer — the caller
+        drives :meth:`sample_once` with explicit timestamps."""
         self.partition = partition
         self.period_ns = period_ns
         self.max_samples = max_samples_per_job
@@ -105,16 +108,29 @@ class ProfileSession:
         self.lost: dict[str, int] = {}
         self._last: dict[str, tuple[int, int, int]] = {}  # ctx -> prev ctrs
         self._last_cw: dict[str, int] = {}  # ctx -> prev collective-wait
-        self._passive: list[tuple[str, Ledger, dict]] = []
+        self._passive: list[tuple[str, Ledger, str, dict]] = []
         self._passive_last: dict[str, dict[int, tuple[int, int, int]]] = {}
         self._timer = None
         # Unique per session: two sessions over the same partition must
         # still exclude each other.
-        self._token = f"oprofile:{partition.name}:{id(self)}"
+        self._token = (
+            f"oprofile:{partition.name if partition else 'monitor'}:"
+            f"{id(self)}")
         reserve(self._token)
         self.state = SessionState.INIT
 
     # -- passive domains (profiled without their cooperation) ------------
+
+    @staticmethod
+    def _read_meta(ledger_path: str) -> dict:
+        import json
+
+        try:
+            with open(ledger_path + ".meta.json") as f:
+                return json.load(f)
+        except (FileNotFoundError, ValueError):
+            # Missing or mid-rewrite: keep the previous slot view.
+            return {}
 
     def add_passive(self, name: str, ledger_path: str) -> None:
         """Attach another process's partition read-only through its
@@ -122,22 +138,29 @@ class ProfileSession:
         if self.state not in (SessionState.INIT, SessionState.READY):
             raise RuntimeError("passive domains attach before start")
         led = Ledger.file_backed(ledger_path, readonly=True)
-        import json
-
-        try:
-            with open(ledger_path + ".meta.json") as f:
-                meta = json.load(f)
-        except FileNotFoundError:
-            meta = {"slots": {}}
-        self._passive.append((name, led, meta))
+        meta = self._read_meta(ledger_path) or {"slots": {}}
+        self._passive.append((name, led, ledger_path, meta))
         self._passive_last[name] = {}
         self.state = SessionState.READY
+
+    def refresh_passive_meta(self) -> None:
+        """Re-read each passive domain's meta sidecar so jobs the live
+        producer admits AFTER attach get sampled too (the same
+        reload-per-iteration contract as ``pbst top``)."""
+        for i, (name, led, path, meta) in enumerate(self._passive):
+            fresh = self._read_meta(path)
+            if fresh:
+                self._passive[i] = (name, led, path, fresh)
 
     # -- lifecycle (xenoprof.c init/start/stop/close) --------------------
 
     def start(self) -> "ProfileSession":
         if self.state is SessionState.CLOSED:
             raise RuntimeError("session closed")
+        if self.partition is None:
+            raise RuntimeError(
+                "passive-only monitor sessions have no timer wheel; "
+                "drive them with sample_once()")
         self._prime()
         now = self.partition.clock.now_ns()
         self._timer = self.partition.timers.arm(
@@ -149,7 +172,7 @@ class ProfileSession:
     def _prime(self) -> None:
         """Capture counter baselines at start so the first sample covers
         only session time — never the job's whole pre-session history."""
-        for job in self.partition.jobs:
+        for job in (self.partition.jobs if self.partition else ()):
             for ctx in job.contexts:
                 self._last[ctx.name] = (
                     int(ctx.counters[Counter.STEPS_RETIRED]),
@@ -158,7 +181,7 @@ class ProfileSession:
                 )
                 self._last_cw[ctx.name] = int(
                     ctx.counters[Counter.COLLECTIVE_WAIT_NS])
-        for name, led, meta in self._passive:
+        for name, led, _path, meta in self._passive:
             last = self._passive_last[name]
             for slot_s in meta.get("slots", {}):
                 slot = int(slot_s)
@@ -168,6 +191,26 @@ class ProfileSession:
                     int(snap[Counter.DEVICE_TIME_NS]),
                     int(snap[Counter.HBM_STALL_NS]),
                 )
+
+    def sample_once(self, now_ns: int | None = None) -> None:
+        """One manual sampling tick — the monitor-side path used by
+        ``pbst oprofile`` to profile PASSIVE ledgers in real time
+        without arming any (virtual) timer wheel.  The first call
+        primes counter baselines, so the first window starts at attach
+        exactly like :meth:`start`; every call re-reads the producers'
+        meta so later-admitted jobs are sampled too."""
+        if self.state is SessionState.CLOSED:
+            raise RuntimeError("session closed")
+        if now_ns is None:
+            if self.partition is None:
+                raise ValueError(
+                    "passive-only sessions need an explicit now_ns")
+            now_ns = self.partition.clock.now_ns()
+        if self.state is not SessionState.RUNNING:
+            self._prime()
+            self.state = SessionState.RUNNING
+        self.refresh_passive_meta()
+        self._tick(now_ns)
 
     def stop(self) -> None:
         if self._timer is not None:
@@ -196,9 +239,17 @@ class ProfileSession:
             return
         buf.append(s)
 
+    @staticmethod
+    def _reset(cur, prev) -> bool:
+        """A producer restart zeroes its ledger slot (Partition.add_job
+        resets at admission): any counter moving BACKWARD means the
+        baseline belongs to a dead incarnation — re-baseline silently
+        instead of recording a negative delta."""
+        return any(c < p for c, p in zip(cur, prev))
+
     def _tick(self, now_ns: int) -> None:
         # Active domains: the hosting partition's own jobs.
-        for job in self.partition.jobs:
+        for job in (self.partition.jobs if self.partition else ()):
             for ctx in job.contexts:
                 cur = (
                     int(ctx.counters[Counter.STEPS_RETIRED]),
@@ -214,6 +265,10 @@ class ProfileSession:
                     # activity accrued across idle ticks lands on the
                     # next recorded sample rather than vanishing.
                     continue
+                if self._reset(cur, prev) or cw < prev_cw:
+                    self._last[ctx.name] = cur
+                    self._last_cw[ctx.name] = cw
+                    continue
                 self._last[ctx.name] = cur
                 self._last_cw[ctx.name] = cw
                 self._record(job.name, Sample(
@@ -224,7 +279,7 @@ class ProfileSession:
                 ))
         # Passive domains: lock-free ledger snapshots of foreign
         # partitions.
-        for name, led, meta in self._passive:
+        for name, led, _path, meta in self._passive:
             last = self._passive_last[name]
             for slot_s, info in meta.get("slots", {}).items():
                 slot = int(slot_s)
@@ -238,6 +293,8 @@ class ProfileSession:
                 if cur == prev:
                     continue
                 last[slot] = cur
+                if self._reset(cur, prev):
+                    continue  # producer restarted: window discarded
                 self._record(f"{name}/{info.get('job', slot)}", Sample(
                     ts_ns=now_ns, ctx=info.get("ctx", str(slot)),
                     step=cur[0],

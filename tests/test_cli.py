@@ -147,3 +147,31 @@ def test_serve_demo_cli(capsys):
     out = _json.loads(capsys.readouterr().out)
     assert out["completions"] == 6
     assert out["prefix_hits"] >= 3  # 3 distinct prompts, 6 requests
+
+
+def test_oprofile_passive_ledger(tmp_path, capsys):
+    """xenoprof analog: passive-attach a ledger another invocation
+    produced and print the flat report — zero cooperation from the
+    profiled side, like xenoprof passive domains.  Run concurrently
+    with a live demo so the sampled windows carry real deltas."""
+    import threading
+
+    ledger = str(tmp_path / "p.ledger")
+    main(["demo", "--seconds", "0.2", "--ledger", ledger])  # meta exists
+    capsys.readouterr()
+    t = threading.Thread(
+        target=main,
+        args=(["demo", "--seconds", "1.0", "--ledger", ledger],))
+    t.start()
+    try:
+        rc = main(["oprofile", "--ledger", ledger, "--name", "demo",
+                   "--seconds", "0.5", "--period", "20"])
+    finally:
+        t.join()
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "samples" in out and "device_ms" in out
+    # The active tenant appears with real sampled deltas; an idle
+    # tenant legitimately records no samples (PMU-sampling semantics:
+    # idle ticks are skipped), so only train is asserted.
+    assert "demo/train" in out

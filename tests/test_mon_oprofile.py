@@ -243,3 +243,87 @@ def test_passive_domain_profiling(tmp_path):
     key = "foreign/victim"
     assert key in rep and rep[key]["samples"] >= 1
     assert rep[key]["stall_pct"] == pytest.approx(25.0, abs=3.0)
+
+
+def test_passive_only_monitor_session(tmp_path):
+    """partition=None: the `pbst oprofile` shape — no hosting
+    partition, no timer wheel; the monitor drives sample_once with
+    explicit timestamps and still gets a real flat profile."""
+    ledger = str(tmp_path / "foreign.bin")
+    be = SimBackend()
+    foreign = Partition("foreign", source=be, ledger_path=ledger)
+    be.register("victim", SimProfile.steady(step_time_ns=1 * MS,
+                                            stall_frac=0.25))
+    foreign.add_job(Job("victim"))
+    foreign.run(until_ns=200 * MS)
+
+    sess = ProfileSession(None)
+    sess.add_passive("f", ledger)
+    with pytest.raises(RuntimeError):
+        sess.start()  # monitor sessions have no timer to arm
+    sess.sample_once(1)  # primes baselines
+    foreign.run(until_ns=600 * MS)
+    sess.sample_once(2)
+    sess.close()
+    rep = sess.report()
+    assert rep["f/victim"]["samples"] >= 1
+    assert rep["f/victim"]["device_ms"] > 0
+    assert rep["f/victim"]["stall_pct"] == pytest.approx(25.0, abs=3.0)
+
+
+def test_passive_reset_never_records_negative_deltas(tmp_path):
+    """A producer restart zeroes its ledger slots (Partition.add_job
+    resets at admission); the sampler must re-baseline, not record a
+    negative window (r5 review finding)."""
+    ledger = str(tmp_path / "foreign.bin")
+    be = SimBackend()
+    foreign = Partition("foreign", source=be, ledger_path=ledger)
+    be.register("victim", SimProfile.steady(step_time_ns=1 * MS))
+    foreign.add_job(Job("victim"))
+    foreign.run(until_ns=400 * MS)
+
+    sess = ProfileSession(None)
+    sess.add_passive("f", ledger)
+    sess.sample_once(1)  # baselines at the old incarnation's counters
+
+    # Producer restarts: same ledger path, counters start from zero.
+    be2 = SimBackend()
+    reborn = Partition("foreign", source=be2, ledger_path=ledger)
+    be2.register("victim", SimProfile.steady(step_time_ns=1 * MS))
+    reborn.add_job(Job("victim"))
+    reborn.run(until_ns=100 * MS)  # less device time than the baseline
+
+    sess.sample_once(2)  # backward counters: window discarded
+    reborn.run(until_ns=250 * MS)
+    sess.sample_once(3)  # post-reset delta: recorded
+    sess.close()
+    rep = sess.report()
+    row = rep.get("f/victim")
+    assert row is not None, rep
+    assert row["device_ms"] > 0  # never negative
+    for s in sess.samples["f/victim"]:
+        assert s.device_dns >= 0 and s.stall_dns >= 0
+
+
+def test_passive_meta_refresh_sees_late_jobs(tmp_path):
+    """Jobs the live producer admits AFTER attach must still be
+    sampled: sample_once re-reads the meta sidecar every tick, like
+    `pbst top` reloads it every iteration (r5 review finding)."""
+    ledger = str(tmp_path / "foreign.bin")
+    be = SimBackend()
+    foreign = Partition("foreign", source=be, ledger_path=ledger)
+    be.register("early", SimProfile.steady(step_time_ns=1 * MS))
+    be.register("late", SimProfile.steady(step_time_ns=1 * MS))
+    foreign.add_job(Job("early"))
+    foreign.run(until_ns=100 * MS)
+
+    sess = ProfileSession(None)
+    sess.add_passive("f", ledger)
+    sess.sample_once(1)
+    foreign.add_job(Job("late"))  # admitted after attach
+    foreign.run(until_ns=400 * MS)
+    sess.sample_once(2)
+    sess.close()
+    rep = sess.report()
+    assert "f/early" in rep
+    assert "f/late" in rep, rep  # invisible before the refresh fix
