@@ -519,6 +519,57 @@ def cmd_demo(args) -> int:
     return 0
 
 
+def cmd_sim(args) -> int:
+    """Trace-driven scheduler simulation (pbs_tpu.sim): one policy run
+    with metrics + trace digest, or --policy all for the comparison
+    harness across every registered policy. No platform pin: pbs_tpu.sim
+    is jax-free, host-side virtual time only."""
+    from pbs_tpu.sim import compare, format_report, run_policy
+    from pbs_tpu.sim.engine import policy_names
+    from pbs_tpu.sim.workload import workload_names
+
+    horizon_ns = int(args.seconds * 1e9)
+    if args.workload not in workload_names():
+        print(f"pbst: unknown workload {args.workload!r}; "
+              f"available: {workload_names()}", file=sys.stderr)
+        return 2
+    if args.policy == "all":
+        # --trace becomes a per-policy prefix: <trace>.<policy>.jsonl.
+        cmp = compare(args.workload, seed=args.seed,
+                      n_tenants=args.tenants, n_executors=args.executors,
+                      horizon_ns=horizon_ns, trace_prefix=args.trace)
+        if args.json:
+            print(json.dumps(cmp, indent=1))
+        else:
+            print(format_report(cmp))
+        return 0
+    if args.policy not in policy_names():
+        print(f"pbst: unknown policy {args.policy!r}; "
+              f"available: {policy_names()} or 'all'", file=sys.stderr)
+        return 2
+    report = run_policy(args.workload, args.policy, seed=args.seed,
+                        n_tenants=args.tenants, n_executors=args.executors,
+                        horizon_ns=horizon_ns, trace_path=args.trace)
+    if not args.json:
+        # Default output is itself deterministic: the digest line is the
+        # byte-identical witness two runs are compared on.
+        print(f"workload={report['workload']} policy={report['policy']} "
+              f"seed={report['seed']}")
+        print(f"quanta={report['quanta']} switches={report['switches']} "
+              f"jain={report['jain_fairness']} "
+              f"p50_wait_us={report['wait_p50_us']} "
+              f"p99_wait_us={report['wait_p99_us']}")
+        for name, t in report["tenants"].items():
+            print(f"  {name:<12} steps={t['steps']:>8} "
+                  f"dev_ms={t['device_ns'] / 1e6:>9.1f} "
+                  f"tslice_us={t['tslice_us']:>5} "
+                  f"p99_wait_us={t['wait_p99_us']:>8}")
+        print(f"trace_digest={report['trace_digest']}")
+    else:
+        print(json.dumps(report, indent=1))
+    return 0
+
+
 def cmd_quantize(args) -> int:
     """Offline int8 weight-only quantization of a param checkpoint:
     reads a checkpoint holding a transformer/MoE param tree, writes a
@@ -773,6 +824,24 @@ def main(argv=None) -> int:
     sp.add_argument("--spec", default=None,
                     help="override spec JSON (default: from save record)")
     sp.set_defaults(fn=cmd_migrate)
+
+    sp = sub.add_parser(
+        "sim", help="trace-driven scheduler simulation (pbs_tpu.sim)")
+    sp.add_argument("--workload", default="mixed",
+                    help="workload mix (see docs/SIM.md)")
+    sp.add_argument("--policy", default="feedback",
+                    help="policy name, or 'all' for the comparison harness")
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--seconds", type=float, default=2.0,
+                    help="virtual-time horizon")
+    sp.add_argument("--tenants", type=int, default=4)
+    sp.add_argument("--executors", type=int, default=1)
+    sp.add_argument("--trace", default=None,
+                    help="write the JSONL trace here (with --policy all: "
+                         "per-policy prefix, <trace>.<policy>.jsonl)")
+    sp.add_argument("--json", action="store_true",
+                    help="full JSON report instead of the summary")
+    sp.set_defaults(fn=cmd_sim)
 
     sp = sub.add_parser("demo", help="run the two-tenant sim demo")
     sp.add_argument("--scheduler", default="credit")

@@ -145,6 +145,9 @@ class Executor:
 
         end = part.clock.now_ns()
         part.trace_emit(self.index, Ev.SCHED_DESCHED, ctx.ledger_slot, ran_ns)
+        if part.recorder is not None:
+            part.recorder.on_quantum(
+                self.index, ctx, quantum_ns, n_units, deltas, now, end)
         part.timers.fire_due(end)
         part.scheduler.descheduled(self, ctx, ran_ns, end)
         # Overflow check at the quantum boundary (pmu_ihandler analog):

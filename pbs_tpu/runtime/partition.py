@@ -77,6 +77,10 @@ class Partition:
         from pbs_tpu.telemetry.sampler import OverflowSampler
 
         self.sampler = OverflowSampler(self.events)
+        # Optional quantum/tick recorder (pbs_tpu.sim.trace.TraceRecorder):
+        # when set, every dispatched quantum and feedback tick is appended
+        # as a JSONL record so the run can be replayed in the simulator.
+        self.recorder = None
         # Optional HBM accounting/admission (runtime.memory).
         self.memory = memory
         # Optional compile-cache admission (runtime.compile_gate): the

@@ -139,6 +139,11 @@ class FeedbackPolicy:
         if int(steps) > 0:
             job.nspi = float(int(dev_ns)) / float(int(steps))
         self._submilli_update(job, st, float(int(coll_ns)), int(steps))
+        # Tick record for the sim trace (pbs_tpu.sim.trace): captures the
+        # adaptation decision stream so live runs replay offline.
+        rec = getattr(self.partition, "recorder", None)
+        if rec is not None:
+            rec.on_feedback(self.partition.clock.now_ns(), job, st)
 
     # -- csched_submilli_metric_update (s_c.c:302-389) -------------------
 
@@ -191,8 +196,11 @@ class FeedbackPolicy:
             if rising:
                 self._shrink(job, st)
 
+    def _clamp(self, us: int) -> int:
+        return max(self.min_us, min(self.max_us, us))
+
     def _grow(self, job: "Job", st: JobMetricState) -> None:
-        new = min(self.max_us, job.params.tslice_us + GROW_STEP_US)
+        new = self._clamp(job.params.tslice_us + GROW_STEP_US)
         if new != job.params.tslice_us:
             st.grows += 1
         job.params.tslice_us = new
@@ -201,7 +209,12 @@ class FeedbackPolicy:
         cur = job.params.tslice_us
         third = cur // 3
         new = third if third >= self.min_us else cur - SHRINK_SUB_US
-        new = max(self.min_us, new)
+        # Both arms need the full clamp: a slice pushed above the cap
+        # out-of-band (operator sched-credit -t, restore from an old
+        # save) has cur//3 possibly still above max_us, so the old
+        # floor-only max() let the slice sit outside the band for a
+        # whole shrink cascade.
+        new = self._clamp(new)
         if new != cur:
             st.shrinks += 1
         job.params.tslice_us = new

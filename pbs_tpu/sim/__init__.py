@@ -1,0 +1,56 @@
+"""pbs_tpu.sim — trace-driven discrete-event scheduler simulator.
+
+Runs the *real* ``pbs_tpu.sched`` policies against synthetic or recorded
+workloads on a virtual clock: ``engine`` (event core + policy probes),
+``workload`` (tenant generator catalog), ``trace`` (JSONL record/replay),
+``harness`` (policy regression comparisons). See docs/SIM.md.
+"""
+
+from pbs_tpu.sim.engine import (
+    POLICIES,
+    SchedulerProbe,
+    SimEngine,
+    jain_index,
+    policy_names,
+)
+from pbs_tpu.sim.harness import DEFAULT_POLICIES, compare, format_report, run_policy
+from pbs_tpu.sim.trace import (
+    ReplayBackend,
+    ReplayError,
+    TraceRecorder,
+    digest_of,
+    load_trace,
+    recorded_steps,
+    replay_partition,
+    trace_meta,
+)
+from pbs_tpu.sim.workload import (
+    WORKLOADS,
+    TenantSpec,
+    build_workload,
+    workload_names,
+)
+
+__all__ = [
+    "POLICIES",
+    "SchedulerProbe",
+    "SimEngine",
+    "jain_index",
+    "policy_names",
+    "DEFAULT_POLICIES",
+    "compare",
+    "format_report",
+    "run_policy",
+    "ReplayBackend",
+    "ReplayError",
+    "TraceRecorder",
+    "digest_of",
+    "load_trace",
+    "recorded_steps",
+    "replay_partition",
+    "trace_meta",
+    "WORKLOADS",
+    "TenantSpec",
+    "build_workload",
+    "workload_names",
+]

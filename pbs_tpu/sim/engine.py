@@ -1,0 +1,313 @@
+"""Discrete-event scheduler simulator driving the real policy stack.
+
+The partition/executor/timer machinery already runs deterministically
+under a ``VirtualClock`` (the x86_emulator fake-backend pattern,
+SURVEY.md §4); what this engine adds is everything needed to turn that
+substrate into an offline policy-evaluation instrument:
+
+- **Policy adapters** — the *unmodified* schedulers from the
+  ``pbs_tpu.sched`` registry, wrapped in a :class:`SchedulerProbe` that
+  observes the ``sched.base`` interface from outside: runqueue wait per
+  dispatch (filling the so-far-unused ``RUNQ_WAIT_NS`` counter),
+  context-switch counts, and the dispatched-quantum timeline per job.
+  ``feedback``/``atc`` are credit plus the corresponding adaptive-quantum
+  policy armed on the partition.
+- **Workloads** — tenant specs from ``pbs_tpu.sim.workload`` executed by
+  ``telemetry.source.SimBackend`` (seeded; all noise via its Generator),
+  with arrival schedules realized as virtual-time sleep/wake timers.
+- **Recording** — a ``sim.trace.TraceRecorder`` hooked into the
+  partition so every run yields a canonical JSONL trace and a stable
+  digest: two runs with equal (workload, policy, seed) are byte-equal.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+from pbs_tpu.runtime.job import Job
+from pbs_tpu.runtime.partition import Partition
+from pbs_tpu.sched.atc import AtcFeedbackPolicy
+from pbs_tpu.sched.base import Decision, scheduler_names
+from pbs_tpu.sched.feedback import FeedbackPolicy
+from pbs_tpu.sim.trace import TraceRecorder
+from pbs_tpu.sim.workload import TenantSpec, build_workload
+from pbs_tpu.telemetry.counters import Counter
+from pbs_tpu.telemetry.source import SimBackend
+from pbs_tpu.utils.clock import SEC, VirtualClock
+
+#: policy name -> (scheduler registry name, adaptive-quantum policy class)
+POLICIES: dict[str, tuple[str, type | None]] = {
+    "credit": ("credit", None),
+    "credit2": ("credit2", None),
+    "sedf": ("sedf", None),
+    "arinc653": ("arinc653", None),
+    "feedback": ("credit", FeedbackPolicy),
+    "atc": ("credit", AtcFeedbackPolicy),
+}
+
+
+def policy_names() -> list[str]:
+    """Schedulers usable as-is plus the adaptive-policy composites."""
+    return sorted(set(scheduler_names()) | set(POLICIES))
+
+
+def resolve_policy(policy: str) -> tuple[str, type | None]:
+    if policy in POLICIES:
+        return POLICIES[policy]
+    if policy in scheduler_names():
+        return policy, None
+    raise KeyError(
+        f"unknown policy {policy!r}; available: {policy_names()}")
+
+
+@dataclasses.dataclass
+class TenantStats:
+    """Per-tenant observations accumulated by the probe."""
+
+    waits: list[tuple[int, int]] = dataclasses.field(default_factory=list)
+    dispatches: int = 0
+    # (t_ns, quantum_us) appended only on change — the adaptation timeline.
+    quantum_timeline: list[tuple[int, int]] = dataclasses.field(
+        default_factory=list)
+
+
+class SchedulerProbe:
+    """Transparent wrapper around a real scheduler instance.
+
+    Forwards the full ``sched.base`` interface unmodified (lifecycle and
+    control-plane calls via ``__getattr__``) and instruments the three
+    run-state edges the metrics need: wake/requeue (enqueue timestamp),
+    pick (wait sample + dispatch count + quantum timeline), deschedule
+    (requeue timestamp). The wait each context experienced also lands in
+    its ``RUNQ_WAIT_NS`` counter, so waits show up in ledgers, dumps and
+    recorded traces like any other telemetry.
+    """
+
+    def __init__(self, inner, clock):
+        # Bypass __setattr__-free plain attrs; keep names private enough
+        # not to shadow anything on the inner scheduler.
+        self.inner = inner
+        self.clock = clock
+        self.stats: dict[str, TenantStats] = {}
+        self.switches = 0
+        self._enqueued: dict[Any, int] = {}
+        self._last_pick: dict[int, Any] = {}
+
+    def _stats(self, job_name: str) -> TenantStats:
+        st = self.stats.get(job_name)
+        if st is None:
+            st = self.stats[job_name] = TenantStats()
+        return st
+
+    # -- instrumented edges ---------------------------------------------
+
+    def wake(self, ctx) -> None:
+        self._enqueued.setdefault(ctx, self.clock.now_ns())
+        self.inner.wake(ctx)
+
+    def sleep(self, ctx) -> None:
+        self._enqueued.pop(ctx, None)
+        self.inner.sleep(ctx)
+
+    def do_schedule(self, ex, now_ns: int) -> Decision:
+        d = self.inner.do_schedule(ex, now_ns)
+        ctx = d.ctx
+        if ctx is not None:
+            wait = max(0, now_ns - self._enqueued.pop(ctx, now_ns))
+            ctx.counters[Counter.RUNQ_WAIT_NS] += np.uint64(wait)
+            st = self._stats(ctx.job.name)
+            st.waits.append((now_ns, wait))
+            st.dispatches += 1
+            q_us = int(d.quantum_ns) // 1000
+            if not st.quantum_timeline or st.quantum_timeline[-1][1] != q_us:
+                st.quantum_timeline.append((now_ns, q_us))
+            if self._last_pick.get(ex.index) is not ctx:
+                self.switches += 1
+            self._last_pick[ex.index] = ctx
+        return d
+
+    def descheduled(self, ex, ctx, ran_ns: int, now_ns: int) -> None:
+        self.inner.descheduled(ex, ctx, ran_ns, now_ns)
+        if ctx.runnable():
+            self._enqueued[ctx] = now_ns
+
+    # -- everything else is the real scheduler --------------------------
+
+    def __getattr__(self, name: str):
+        return getattr(self.inner, name)
+
+
+class SimEngine:
+    """One simulated run: workload × policy × seed → metrics + trace."""
+
+    def __init__(
+        self,
+        workload: str = "mixed",
+        policy: str = "feedback",
+        seed: int = 0,
+        n_tenants: int = 4,
+        n_executors: int = 1,
+        horizon_ns: int = 2 * SEC,
+        trace_path: str | None = None,
+        record: bool = True,
+        keep_lines: bool = True,
+        warmup_frac: float = 0.1,
+    ):
+        self.workload = workload
+        self.policy = policy
+        self.seed = int(seed)
+        self.horizon_ns = int(horizon_ns)
+        self.warmup_frac = float(warmup_frac)
+        sched_name, policy_cls = resolve_policy(policy)
+
+        self.clock = VirtualClock()
+        self.backend = SimBackend(self.clock, seed=self.seed)
+        self.partition = Partition(
+            f"sim-{workload}", source=self.backend, scheduler=sched_name,
+            n_executors=n_executors)
+        self.probe = SchedulerProbe(self.partition.scheduler, self.clock)
+        self.partition.scheduler = self.probe
+        self.feedback = (policy_cls(self.partition)
+                         if policy_cls is not None else None)
+
+        self.specs: list[TenantSpec] = build_workload(
+            workload, seed=self.seed, n_tenants=n_tenants,
+            horizon_ns=self.horizon_ns)
+        self.jobs: list[Job] = []
+        self._start_ns = self.clock.now_ns()
+        for spec in self.specs:
+            self.backend.register(spec.name, spec.profile)
+            job = Job(spec.name, params=spec.params,
+                      max_steps=spec.max_steps)
+            for ctx in job.contexts:
+                ctx.avg_step_ns = float(spec.profile.phases[0].step_time_ns)
+            self.partition.add_job(job)
+            self.jobs.append(job)
+            if spec.arrival:
+                self._arm_arrivals(job, spec.arrival)
+
+        self.recorder: TraceRecorder | None = None
+        if record or trace_path:
+            self.recorder = TraceRecorder(trace_path, keep_lines=keep_lines)
+            self.recorder.meta(
+                workload=workload, policy=policy, seed=self.seed,
+                scheduler=sched_name, n_tenants=len(self.specs),
+                n_executors=n_executors, horizon_ns=self.horizon_ns,
+                jobs=[{
+                    "name": j.name,
+                    "weight": j.params.weight,
+                    "cap": j.params.cap,
+                    "tslice_us": j.params.tslice_us,
+                    "n_contexts": len(j.contexts),
+                    "avg_step_ns": int(j.contexts[0].avg_step_ns),
+                } for j in self.jobs],
+            )
+            self.partition.recorder = self.recorder
+        self._report: dict | None = None
+
+    def _arm_arrivals(self, job: Job, arrival) -> None:
+        part = self.partition
+        for t_ns, awake in arrival:
+            fn = ((lambda now, j=job: part.wake_job(j, notify=False))
+                  if awake else
+                  (lambda now, j=job: part.sleep_job(j, notify=False)))
+            part.timers.arm(self._start_ns + int(t_ns), fn,
+                            name="sim_arrival")
+        # If the first flip is a wake, the tenant starts asleep until its
+        # first burst arrives (first flip = sleep means it starts awake).
+        if arrival and arrival[0][1]:
+            part.sleep_job(job, notify=False)
+
+    # -- run + metrics ---------------------------------------------------
+
+    def run(self) -> dict:
+        try:
+            self.partition.run(until_ns=self._start_ns + self.horizon_ns)
+        finally:
+            # Close on failure too: a policy raising mid-run must still
+            # flush the on-disk JSONL for the post-mortem.
+            if self.recorder is not None:
+                self.recorder.close()
+        self._report = self._gather()
+        return self._report
+
+    def elapsed_ns(self) -> int:
+        return self.clock.now_ns() - self._start_ns
+
+    def _gather(self) -> dict:
+        warmup_at = self._start_ns + int(self.warmup_frac * self.horizon_ns)
+        tenants: dict[str, dict] = {}
+        device_ns: list[int] = []
+        all_waits: list[int] = []
+        for job in self.jobs:
+            dev = sum(int(c.counters[Counter.DEVICE_TIME_NS])
+                      for c in job.contexts)
+            st = self.probe.stats.get(job.name, TenantStats())
+            waits = [w for (t, w) in st.waits if t >= warmup_at]
+            all_waits.extend(waits)
+            device_ns.append(dev)
+            tenants[job.name] = {
+                "device_ns": dev,
+                "steps": job.steps_retired(),
+                "stall_ns": sum(int(c.counters[Counter.HBM_STALL_NS])
+                                for c in job.contexts),
+                "collective_wait_ns": sum(
+                    int(c.counters[Counter.COLLECTIVE_WAIT_NS])
+                    for c in job.contexts),
+                "runq_wait_ns": sum(int(c.counters[Counter.RUNQ_WAIT_NS])
+                                    for c in job.contexts),
+                "sched_count": sum(c.sched_count for c in job.contexts),
+                "dispatches": st.dispatches,
+                "wait_p99_us": _pct_us(waits, 99),
+                "tslice_us": job.params.tslice_us,
+                "quantum_timeline_us": [
+                    [int(t - self._start_ns), q]
+                    for t, q in st.quantum_timeline],
+            }
+        busy = sum(device_ns)
+        elapsed = self.elapsed_ns()
+        n_ex = len(self.partition.executors)
+        report = {
+            "workload": self.workload,
+            "policy": self.policy,
+            "seed": self.seed,
+            "horizon_ns": self.horizon_ns,
+            "elapsed_ns": elapsed,
+            "busy_ns": busy,
+            "utilization": round(busy / max(1, elapsed * n_ex), 4),
+            "quanta": sum(ex.dispatch_count
+                          for ex in self.partition.executors),
+            "switches": self.probe.switches,
+            "jain_fairness": round(jain_index(device_ns), 4),
+            "wait_p50_us": _pct_us(all_waits, 50),
+            "wait_p99_us": _pct_us(all_waits, 99),
+            "tenants": tenants,
+        }
+        if self.feedback is not None:
+            report["feedback"] = self.feedback.dump()
+        if self.recorder is not None:
+            report["trace_digest"] = self.recorder.digest()
+            report["trace_records"] = self.recorder.records_emitted
+        return report
+
+
+def jain_index(xs: list[int]) -> float:
+    """Jain's fairness index over per-tenant service: (Σx)²/(n·Σx²);
+    1.0 = perfectly even, 1/n = one tenant got everything."""
+    xs = [x for x in xs if x >= 0]
+    if not xs:
+        return 1.0
+    sq = sum(float(x) * float(x) for x in xs)
+    if sq == 0:
+        return 1.0
+    s = float(sum(xs))
+    return (s * s) / (len(xs) * sq)
+
+
+def _pct_us(waits_ns: list[int], pct: float) -> float:
+    if not waits_ns:
+        return 0.0
+    return round(float(np.percentile(np.asarray(waits_ns), pct)) / 1000.0, 1)

@@ -76,6 +76,10 @@ class SimPhase:
     collective_wait_ns: int = 0  # spin-latency analog per step
     flops: int = 1 << 30
     tokens: int = 0
+    # Relative half-width of per-step noise on step time and collective
+    # wait (0.1 = ±10%). Drawn from the backend's own seeded Generator —
+    # never module-level RNG state — so runs replay bit-for-bit.
+    jitter: float = 0.0
 
 
 @dataclasses.dataclass
@@ -100,12 +104,40 @@ class SimBackend:
 
     Jobs registered here need no real step function — the backend *is*
     the device. This is the CPU-CI substrate mandated by SURVEY.md §4.
+
+    Every stochastic choice (phase jitter) routes through explicit
+    seeded ``np.random.Generator``s — one per job, keyed (seed, job
+    name) and advanced only by that job's own execution. Two backends
+    built with the same seed produce byte-identical telemetry (the
+    ``pbs_tpu.sim`` trace-digest determinism gate), and the noise a job
+    experiences is a function of its own step sequence alone, not of
+    scheduler dispatch order — so policy comparisons over the same
+    (workload, seed) are noise-controlled.
     """
 
-    def __init__(self, clock: VirtualClock | None = None):
+    def __init__(self, clock: VirtualClock | None = None, seed: int = 0):
         self.clock: VirtualClock = clock or VirtualClock()
+        self.seed = int(seed)
+        self._rngs: dict[str, np.random.Generator] = {}
         self._profiles: dict[str, SimProfile] = {}
         self._steps_done: dict[str, int] = {}
+
+    def _rng_for(self, job_name: str) -> np.random.Generator:
+        rng = self._rngs.get(job_name)
+        if rng is None:
+            import zlib
+
+            rng = self._rngs[job_name] = np.random.default_rng(
+                [self.seed, zlib.crc32(job_name.encode())])
+        return rng
+
+    @staticmethod
+    def _jittered(rng: np.random.Generator, value: int,
+                  jitter: float) -> int:
+        """±jitter noise on ``value`` via the job's seeded Generator."""
+        if jitter <= 0.0 or value <= 0:
+            return value
+        return max(1, int(value * (1.0 + jitter * (2.0 * rng.random() - 1.0))))
 
     def register(self, job_name: str, profile: SimProfile) -> None:
         self._profiles[job_name] = profile
@@ -122,26 +154,28 @@ class SimBackend:
         return self._steps_done.get(job_name, 0)
 
     def _charge_phase(self, deltas: np.ndarray, ph: SimPhase,
-                      k: int) -> int:
+                      k: int, rng: np.random.Generator) -> int:
         """Advance the clock by 1/k of the phase's step and charge the
         proportional traffic; returns the advanced nanoseconds."""
-        t = max(1, ph.step_time_ns // k)
+        t = self._jittered(rng, max(1, ph.step_time_ns // k), ph.jitter)
         self.clock.advance(t)
         deltas[Counter.DEVICE_TIME_NS] += t
         deltas[Counter.HBM_BYTES] += ph.hbm_bytes // k
         deltas[Counter.HBM_STALL_NS] += int(t * ph.stall_frac)
-        deltas[Counter.COLLECTIVE_WAIT_NS] += ph.collective_wait_ns // k
+        deltas[Counter.COLLECTIVE_WAIT_NS] += self._jittered(
+            rng, ph.collective_wait_ns // k, ph.jitter)
         deltas[Counter.DEVICE_FLOPS] += ph.flops // k
         return t
 
     def execute(self, ctx: Any, n_steps: int) -> np.ndarray:
         name = ctx.job.name
         prof = self._profiles[name]
+        rng = self._rng_for(name)
         deltas = np.zeros(NUM_COUNTERS, dtype=np.uint64)
         for _ in range(n_steps):
             step = self._steps_done[name]
             ph = prof.phase_at(step)
-            self._charge_phase(deltas, ph, 1)
+            self._charge_phase(deltas, ph, 1, rng)
             deltas[Counter.STEPS_RETIRED] += 1
             deltas[Counter.TOKENS] += ph.tokens
             self._steps_done[name] = step + 1
@@ -155,11 +189,12 @@ class SimBackend:
         name = ctx.job.name
         K = ctx.job.micro_per_step
         prof = self._profiles[name]
+        rng = self._rng_for(name)
         deltas = np.zeros(NUM_COUNTERS, dtype=np.uint64)
         for _ in range(n_micro):
             step = self._steps_done[name]
             ph = prof.phase_at(step)
-            self._charge_phase(deltas, ph, K)
+            self._charge_phase(deltas, ph, K, rng)
             ctx.micro_progress += 1
             if ctx.micro_progress >= K:
                 ctx.micro_progress = 0

@@ -1,0 +1,139 @@
+"""Feedback window-reset/clamping audit (ISSUE 1 satellites).
+
+Property-style phase-transition tests driven *through the simulator*
+(stable+stall≥100 → grow; stable+stall<100 → shrink ÷3; unstable →
+reset), plus the adversarial clamping proof: the slice never escapes
+[TSLICE_MIN_US, TSLICE_MAX_US] no matter what contention sequence or
+out-of-band tslice write hits the policy. Covers the fixed ``_shrink``
+overshoot (cur//3 could land above the cap when cur was pushed past
+3×max out-of-band).
+"""
+
+import numpy as np
+
+from pbs_tpu.runtime import Job, Partition, SchedParams
+from pbs_tpu.sched.feedback import (
+    FeedbackPolicy,
+    JobMetricState,
+    TSLICE_MAX_US,
+    TSLICE_MIN_US,
+)
+from pbs_tpu.sim import SimEngine, TraceRecorder
+from pbs_tpu.telemetry import SimBackend, SimPhase, SimProfile
+from pbs_tpu.utils.clock import MS
+
+
+def _tick_values(engine_or_rec, job=None):
+    rec = (engine_or_rec.recorder
+           if isinstance(engine_or_rec, SimEngine) else engine_or_rec)
+    return [(r["job"], r["tslice_us"]) for r in rec.records()
+            if r["kind"] == "tick" and (job is None or r["job"] == job)]
+
+
+# -- phase-transition properties, observed via the simulator trace ---------
+
+
+def test_stable_high_stall_grows_monotonically_to_cap():
+    eng = SimEngine(workload="stable", policy="feedback", seed=3,
+                    n_tenants=3, horizon_ns=500 * MS)
+    eng.run()
+    for job in eng.jobs:
+        vals = [v for _, v in _tick_values(eng, job.name)]
+        assert vals, job.name
+        # grow-only: the timeline never decreases and ends at the cap.
+        assert all(b >= a for a, b in zip(vals, vals[1:])), job.name
+        assert vals[-1] == TSLICE_MAX_US
+
+
+def test_stable_low_stall_shrinks_by_thirds_to_floor():
+    """The ÷3 law: from the 900 µs start the first shrink lands exactly
+    at 300, the second at the 100 µs floor (sched_credit.c:360-369)."""
+    eng = SimEngine(workload="contended", policy="feedback", seed=7,
+                    n_tenants=4, horizon_ns=200 * MS)
+    eng.run()
+    for job in eng.jobs:
+        vals = [v for _, v in _tick_values(eng, job.name)]
+        distinct = [v for i, v in enumerate(vals)
+                    if i == 0 or v != vals[i - 1]]
+        assert distinct == [900, 300, 100], (job.name, distinct[:5])
+
+
+def test_unstable_contention_resets_window_via_sim():
+    be = SimBackend(seed=0)
+    part = Partition("t", source=be, scheduler="credit")
+    fb = FeedbackPolicy(part)
+    phases = [SimPhase(steps=20, step_time_ns=100_000, stall_frac=0.3,
+                       collective_wait_ns=(100 if i % 2 == 0 else 1_000_000))
+              for i in range(50)]
+    phases.append(SimPhase(steps=-1, step_time_ns=100_000))
+    be.register("osc", SimProfile(phases))
+    job = Job("osc", params=SchedParams(tslice_us=500), max_steps=100_000)
+    job.contexts[0].avg_step_ns = 100_000.0
+    part.add_job(job)
+    part.run(until_ns=100 * MS)
+    assert fb.state_of(job).resets > 0
+    assert TSLICE_MIN_US <= job.params.tslice_us <= TSLICE_MAX_US
+
+
+# -- clamping: adversarial sequences + out-of-band writes -------------------
+
+
+def test_shrink_clamps_overshoot_above_cap():
+    """Regression for the fixed bug: tslice pushed to 5000 µs out-of-band
+    (operator / restored save) must come back INTO the band on the first
+    shrink, not to 5000//3 = 1666 > cap."""
+    be = SimBackend()
+    part = Partition("t", source=be, scheduler="credit")
+    fb = FeedbackPolicy(part)
+    job = Job("j", params=SchedParams(tslice_us=5_000))
+    st = JobMetricState()
+    fb._shrink(job, st)
+    assert job.params.tslice_us == TSLICE_MAX_US
+    # And growing from below the floor clamps up into the band.
+    job.params.tslice_us = 0
+    fb._grow(job, st)
+    assert job.params.tslice_us >= TSLICE_MIN_US
+
+
+def test_adversarial_contention_never_escapes_band():
+    """Seeded-random contention storms + mid-run out-of-band tslice
+    writes: after every adaptation tick the slice is in band."""
+    rng = np.random.default_rng(42)
+    be = SimBackend(seed=1)
+    part = Partition("t", source=be, scheduler="credit")
+    FeedbackPolicy(part)
+    rec = TraceRecorder()
+    part.recorder = rec
+    phases = []
+    for _ in range(60):
+        phases.append(SimPhase(
+            steps=int(rng.integers(5, 20)),
+            step_time_ns=int(rng.integers(50, 200)) * 1000,
+            stall_frac=float(rng.uniform(0.0, 0.9)),
+            collective_wait_ns=int(rng.integers(0, 500_000)),
+        ))
+    # Stable memory-bound tail: once the storm is consumed the policy
+    # must pull any injected out-of-band value back into the band.
+    phases.append(SimPhase(steps=-1, step_time_ns=100_000, stall_frac=0.5,
+                           collective_wait_ns=1_000))
+    be.register("adv", SimProfile(phases))
+    job = Job("adv", params=SchedParams(tslice_us=400), max_steps=10**9)
+    job.contexts[0].avg_step_ns = 100_000.0
+    part.add_job(job)
+    # Out-of-band writes land between run segments, like an operator
+    # racing the policy.
+    for injected in (5_000, 1, 3_333, 50):
+        part.run(until_ns=part.clock.now_ns() + 50 * MS)
+        job.params.tslice_us = injected
+    part.run(until_ns=part.clock.now_ns() + 200 * MS)
+    ticks = [r["tslice_us"] for r in rec.records() if r["kind"] == "tick"]
+    assert ticks
+    # Every adaptation that actually moved the slice kept it in band;
+    # a tick may still *observe* a fresh injected value before the
+    # window refills, so compare against the previous tick: any change
+    # made by the policy ends inside the band.
+    for prev, cur in zip(ticks, ticks[1:]):
+        if cur != prev:
+            assert TSLICE_MIN_US <= cur <= TSLICE_MAX_US or cur in (
+                5_000, 1, 3_333, 50), (prev, cur)
+    assert TSLICE_MIN_US <= job.params.tslice_us <= TSLICE_MAX_US
