@@ -1,0 +1,32 @@
+"""Static invariant checker suite (``pbst check``).
+
+See docs/ANALYSIS.md for the checker list, suppression syntax, and how
+to add a pass. Import surface mirrors the other subsystems: the
+framework types, the suite registry, and the entry points the CLI and
+tests drive.
+"""
+
+from pbs_tpu.analysis.core import CheckContext, Finding, Pass, SourceFile
+from pbs_tpu.analysis.runner import (
+    ALL_PASSES,
+    CheckResult,
+    check_paths,
+    format_human,
+    iter_py_files,
+    load_dynamic_graph,
+    pass_ids,
+)
+
+__all__ = [
+    "ALL_PASSES",
+    "CheckContext",
+    "CheckResult",
+    "Finding",
+    "Pass",
+    "SourceFile",
+    "check_paths",
+    "format_human",
+    "iter_py_files",
+    "load_dynamic_graph",
+    "pass_ids",
+]

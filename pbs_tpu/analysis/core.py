@@ -1,0 +1,217 @@
+"""Static invariant checking framework (the ``pbst check`` core).
+
+PR 1 gated policy *behavior* offline (the sim regression harness); this
+subsystem gates policy *code* the same way: a repo-aware AST analysis
+pass suite that enforces the invariants the runtime/sched/telemetry
+layers already rely on implicitly — lock discipline (lockdep's static
+twin), time-unit suffix consistency, scheduler-ops conformance, and
+counter-API usage. The framework is deliberately small: passes visit
+parsed files and emit :class:`Finding` records; the runner collects,
+filters suppressions, and formats.
+
+Suppression syntax (reviewed escapes, never silent):
+
+- line:  ``# pbst: ignore[rule-id] -- justification``
+- file:  ``# pbst: ignore-file[rule-id] -- justification``
+
+A suppression **must** carry a justification after ``--`` or it is
+itself reported (rule ``bad-suppression``). Rule ``*`` matches every
+rule (use sparingly).
+
+No dependency on jax/numpy: ``pbst check`` must run anywhere the repo
+checks out, including CI images with no accelerator stack.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from typing import Any
+
+#: Time-unit suffixes the taxonomy uses (clock.py: ns is canonical).
+UNIT_SUFFIXES = ("ns", "us", "ms")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One checker hit, anchored to file:line:col with a fix hint."""
+
+    check: str  # rule id, e.g. "lock-raw"
+    path: str
+    line: int
+    col: int
+    message: str
+    hint: str = ""
+
+    def sort_key(self) -> tuple:
+        return (self.path, self.line, self.col, self.check, self.message)
+
+    def as_dict(self) -> dict[str, Any]:
+        d = {
+            "check": self.check,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+        if self.hint:
+            d["hint"] = self.hint
+        return d
+
+    def format(self) -> str:
+        s = f"{self.path}:{self.line}:{self.col}: [{self.check}] {self.message}"
+        if self.hint:
+            s += f"\n    hint: {self.hint}"
+        return s
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*pbst:\s*(ignore|ignore-file)\[([A-Za-z0-9_*,\s-]+)\]"
+    r"(?:\s*--\s*(.*))?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Suppression:
+    rules: tuple[str, ...]
+    line: int  # comment line (line-level applies to this physical line)
+    file_wide: bool
+    justification: str
+
+    def matches(self, rule: str, line: int) -> bool:
+        if rule == "bad-suppression":
+            return False  # the escape hatch cannot hide its own misuse
+        if not any(r == "*" or r == rule for r in self.rules):
+            return False
+        return self.file_wide or line == self.line
+
+
+class SourceFile:
+    """One parsed source file: AST + per-line suppression table."""
+
+    def __init__(self, path: str, text: str, rel_path: str | None = None):
+        self.path = path
+        #: Path as reported in findings (relative to the check root).
+        self.rel_path = rel_path if rel_path is not None else path
+        self.text = text
+        self.tree: ast.AST | None = None
+        self.parse_error: Finding | None = None
+        self.suppressions: list[Suppression] = []
+        self.bad_suppressions: list[Finding] = []
+        try:
+            self.tree = ast.parse(text, filename=path)
+        except SyntaxError as e:
+            self.parse_error = Finding(
+                "parse-error", self.rel_path, e.lineno or 1, e.offset or 0,
+                f"cannot parse: {e.msg}")
+        self._scan_comments()
+
+    def _scan_comments(self) -> None:
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(self.text).readline)
+            comments = [(t.start[0], t.string) for t in toks
+                        if t.type == tokenize.COMMENT]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            comments = [
+                (i + 1, ln[ln.index("#"):])
+                for i, ln in enumerate(self.text.splitlines()) if "#" in ln
+            ]
+        for line, comment in comments:
+            m = _SUPPRESS_RE.search(comment)
+            if m is None:
+                if "pbst:" in comment and "ignore" in comment:
+                    self.bad_suppressions.append(Finding(
+                        "bad-suppression", self.rel_path, line, 0,
+                        f"unparseable suppression comment: {comment.strip()!r}",
+                        hint="syntax: # pbst: ignore[rule-id] -- justification"))
+                continue
+            kind, rules_s, just = m.group(1), m.group(2), m.group(3)
+            rules = tuple(r.strip() for r in rules_s.split(",") if r.strip())
+            if not (just or "").strip():
+                self.bad_suppressions.append(Finding(
+                    "bad-suppression", self.rel_path, line, 0,
+                    "suppression without a justification",
+                    hint="append ' -- why this is safe' to the comment"))
+                continue
+            self.suppressions.append(Suppression(
+                rules=rules, line=line, file_wide=(kind == "ignore-file"),
+                justification=just.strip()))
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        return any(s.matches(rule, line) for s in self.suppressions)
+
+
+class CheckContext:
+    """Shared state for one ``pbst check`` run (all files + options)."""
+
+    def __init__(self, files: list[SourceFile],
+                 dynamic_lock_edges: set[tuple[str, str]] | None = None):
+        self.files = files
+        #: Dynamic lock-order graph edges (from ``pbst lockdep
+        #: --dump-graph``) merged into the static cross-check.
+        self.dynamic_lock_edges = dynamic_lock_edges or set()
+        #: Scratch space for passes that accumulate across files.
+        self.state: dict[str, Any] = {}
+
+
+class Pass:
+    """One checker. Subclasses set ``id``/``rules`` and override
+    :meth:`run` (per file) and optionally :meth:`finalize` (after every
+    file was visited — cross-file analyses report here)."""
+
+    id: str = "abstract"
+    #: Rule ids this pass can emit (drives --list-passes and docs).
+    rules: tuple[str, ...] = ()
+    description: str = ""
+
+    def run(self, src: SourceFile, ctx: CheckContext) -> list[Finding]:
+        return []
+
+    def finalize(self, ctx: CheckContext) -> list[Finding]:
+        return []
+
+
+# -- shared AST helpers -----------------------------------------------------
+
+
+def qualified_name(node: ast.AST) -> str | None:
+    """Dotted name for Name/Attribute chains (``time.sleep`` ->
+    "time.sleep"); None for anything dynamic."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = qualified_name(node.value)
+        return f"{base}.{node.attr}" if base is not None else None
+    return None
+
+
+def identifier_of(node: ast.AST) -> str | None:
+    """The trailing identifier a human would read a unit suffix off:
+    ``job.params.tslice_us`` -> "tslice_us"; ``Counter.RUNQ_WAIT_NS``
+    -> "RUNQ_WAIT_NS"; subscripts defer to the index when it carries a
+    suffix (``snap[Counter.DEVICE_TIME_NS]``)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Subscript):
+        idx = node.slice
+        ident = identifier_of(idx)
+        if ident is not None and unit_of_identifier(ident) is not None:
+            return ident
+        return identifier_of(node.value)
+    if isinstance(node, ast.UnaryOp):
+        return identifier_of(node.operand)
+    return None
+
+
+def unit_of_identifier(ident: str) -> str | None:
+    low = ident.lower()
+    for suf in UNIT_SUFFIXES:
+        if low.endswith("_" + suf):
+            return suf
+    return None
+
+

@@ -1,0 +1,196 @@
+"""``pbst check`` driver: walk, parse, run passes, filter, format.
+
+The runner owns everything pass-agnostic: file discovery, suppression
+filtering (passes emit every hit; the escape hatch is applied in ONE
+place so no pass can forget it), deterministic ordering, and the two
+output formats. Exit-code contract (CI gates on it):
+
+- 0: clean tree (possibly via justified suppressions)
+- 1: findings
+- 2: usage error (no files, unknown pass, unreadable graph)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Iterable
+
+from pbs_tpu.analysis.core import CheckContext, Finding, Pass, SourceFile
+from pbs_tpu.analysis.counterapi import CounterApiPass
+from pbs_tpu.analysis.locks import LockDisciplinePass
+from pbs_tpu.analysis.schedops import SchedOpsPass
+from pbs_tpu.analysis.units import TimeUnitPass
+
+#: The suite, in report order. Adding a pass = append here + docs.
+ALL_PASSES: tuple[type[Pass], ...] = (
+    LockDisciplinePass,
+    TimeUnitPass,
+    SchedOpsPass,
+    CounterApiPass,
+)
+
+
+def pass_ids() -> list[str]:
+    return [p.id for p in ALL_PASSES]
+
+
+@dataclasses.dataclass
+class CheckResult:
+    findings: list[Finding]
+    suppressed: list[tuple[Finding, str]]  # (finding, justification)
+    files_scanned: int
+    passes_run: list[str]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.findings else 0
+
+    def as_dict(self) -> dict[str, Any]:
+        return {
+            "version": 1,
+            "passes": self.passes_run,
+            "files_scanned": self.files_scanned,
+            "findings": [f.as_dict() for f in self.findings],
+            "suppressed": [
+                {**f.as_dict(), "justification": j}
+                for f, j in self.suppressed
+            ],
+            "counts": self.counts(),
+        }
+
+    def counts(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for f in self.findings:
+            out[f.check] = out.get(f.check, 0) + 1
+        return out
+
+
+def iter_py_files(paths: Iterable[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if not d.startswith((".", "__pycache__")))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+    return sorted(dict.fromkeys(out))
+
+
+def load_dynamic_graph(path: str) -> set[tuple[str, str]]:
+    """Edges from a ``pbst lockdep --dump-graph`` artifact. Accepts the
+    stable export shape ({"edges": [["a","b"], ...]}), the raw snapshot
+    shape ({"edges": {"a": ["b", ...]}}), a whole obs dump (descends
+    into its "lockdep" section), and a bare pair list. Anything else
+    is a ValueError — fabricating edges from an unrelated dict would
+    silently disable the cross-check."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict) and isinstance(data.get("lockdep"), dict):
+        data = data["lockdep"]  # obs dump artifact: use its section
+    if isinstance(data, dict):
+        if "edges" not in data:
+            raise ValueError("dict artifact has no 'edges' key — not a "
+                             "lock-order graph")
+        edges = data["edges"]
+    else:
+        edges = data
+    out: set[tuple[str, str]] = set()
+    if isinstance(edges, dict):
+        for a, bs in edges.items():
+            if not isinstance(bs, list) or \
+                    not all(isinstance(b, str) for b in bs):
+                raise ValueError(f"edges[{a!r}] is not a list of class "
+                                 "names")
+            for b in bs:
+                out.add((str(a), b))
+    elif isinstance(edges, list):
+        for pair in edges:
+            if not isinstance(pair, (list, tuple)) or len(pair) != 2:
+                raise ValueError(f"edge {pair!r} is not a [holder, taken] "
+                                 "pair")
+            out.add((str(pair[0]), str(pair[1])))
+    else:
+        raise ValueError("graph holds no edges dict or pair list")
+    return out
+
+
+def check_paths(paths: Iterable[str],
+                passes: Iterable[str] | None = None,
+                dynamic_graph: set[tuple[str, str]] | None = None,
+                root: str | None = None) -> CheckResult:
+    """Run the suite over ``paths``. ``root`` (default cwd) anchors the
+    relative paths findings report, so golden outputs are stable."""
+    root = root or os.getcwd()
+    selected = list(ALL_PASSES)
+    if passes is not None:
+        wanted = set(passes)
+        unknown = wanted - set(pass_ids())
+        if unknown:
+            raise KeyError(
+                f"unknown pass(es) {sorted(unknown)}; "
+                f"available: {pass_ids()}")
+        selected = [p for p in ALL_PASSES if p.id in wanted]
+
+    files: list[SourceFile] = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        rel = os.path.relpath(os.path.abspath(path), root)
+        files.append(SourceFile(path, text, rel_path=rel.replace(os.sep, "/")))
+
+    ctx = CheckContext(files, dynamic_lock_edges=dynamic_graph)
+    instances = [cls() for cls in selected]
+    raw: list[Finding] = []
+    for src in files:
+        if src.parse_error is not None:
+            raw.append(src.parse_error)
+        raw.extend(src.bad_suppressions)
+        if src.tree is None:
+            continue
+        for inst in instances:
+            raw.extend(inst.run(src, ctx))
+    for inst in instances:
+        raw.extend(inst.finalize(ctx))
+
+    by_rel = {src.rel_path: src for src in files}
+    findings: list[Finding] = []
+    suppressed: list[tuple[Finding, str]] = []
+    for f in sorted(raw, key=Finding.sort_key):
+        src = by_rel.get(f.path)
+        if src is not None and src.suppressed(f.check, f.line):
+            just = next((s.justification for s in src.suppressions
+                         if s.matches(f.check, f.line)), "")
+            suppressed.append((f, just))
+        else:
+            findings.append(f)
+    return CheckResult(findings=findings, suppressed=suppressed,
+                       files_scanned=len(files),
+                       passes_run=[p.id for p in instances])
+
+
+def format_human(result: CheckResult) -> str:
+    lines = []
+    for f in result.findings:
+        lines.append(f.format())
+    counts = result.counts()
+    summary = (
+        f"pbst check: {len(result.findings)} finding(s) in "
+        f"{result.files_scanned} file(s)"
+        + (f" [{', '.join(f'{k}={v}' for k, v in sorted(counts.items()))}]"
+           if counts else "")
+        + (f"; {len(result.suppressed)} suppressed"
+           if result.suppressed else "")
+        + f" (passes: {', '.join(result.passes_run)})"
+    )
+    lines.append(summary)
+    return "\n".join(lines)

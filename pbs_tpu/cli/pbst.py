@@ -11,6 +11,7 @@ Reference surface being re-expressed (``tools/libxl/xl_cmdimpl.c``,
     pbst store      hierarchical store ops (xenstore-ls / -read / -write)
     pbst ckpt-info  inspect a checkpoint directory (xl save artifacts)
     pbst sched-credit  adjust weight/cap in a store db (xl sched-credit)
+    pbst check      static invariant checker suite (docs/ANALYSIS.md)
     pbst demo       run the two-tenant sim demo end to end
 
 Monitors attach to artifacts (ledger file, store db, trace dump), not to
@@ -176,9 +177,14 @@ def cmd_sched_credit(args) -> int:
         return 0
     # Validate everything before writing anything: a rejected update
     # must leave the store untouched (operators assume all-or-nothing).
-    if args.tslice_us is not None and not (100 <= args.tslice_us <= 1_000_000):
-        print("pbst: tslice out of bounds [100, 1000000] us",
-              file=sys.stderr)
+    # Bounds are the dispatch-legal band (sched/base.py) so the CLI can
+    # never store a slice the schedulers would clamp away.
+    from pbs_tpu.sched.base import TSLICE_MAX_US, TSLICE_MIN_US
+
+    if args.tslice_us is not None and not (
+            TSLICE_MIN_US <= args.tslice_us <= TSLICE_MAX_US):
+        print(f"pbst: tslice out of bounds "
+              f"[{TSLICE_MIN_US}, {TSLICE_MAX_US}] us", file=sys.stderr)
         return 1
     t = s.transaction()
     if args.weight is not None:
@@ -284,6 +290,13 @@ def cmd_lockdep(args) -> int:
     from pbs_tpu.obs.dumpfile import read_obs_dump
 
     snap = read_obs_dump(args.file).get("lockdep", {})
+    if getattr(args, "dump_graph", False):
+        from pbs_tpu.obs.lockdep import export_graph
+
+        # Stable export for static/dynamic cross-checking
+        # (pbst check --lockdep-graph): an artifact, not a gate.
+        print(json.dumps(export_graph(snap), indent=1, sort_keys=True))
+        return 0
     print(f"classes: {len(snap.get('classes', []))}  "
           f"checked edges: {snap.get('checked_edges', 0)}  "
           f"violations: {len(snap.get('violations', []))}")
@@ -294,6 +307,52 @@ def cmd_lockdep(args) -> int:
               f"{v['holding']!r}; established "
               f"{' -> '.join(v['established_order'])}")
     return 1 if snap.get("violations") else 0
+
+
+def cmd_check(args) -> int:
+    """Static invariant checker suite (docs/ANALYSIS.md): lock
+    discipline, time-unit consistency, scheduler-ops conformance,
+    counter-API usage. Exit 0 clean / 1 findings / 2 usage error."""
+    from pbs_tpu.analysis import (
+        ALL_PASSES,
+        check_paths,
+        format_human,
+        load_dynamic_graph,
+    )
+
+    if args.list_passes:
+        for cls in ALL_PASSES:
+            print(f"{cls.id:<16} rules: {', '.join(cls.rules)}")
+            print(f"{'':<16} {cls.description}")
+        return 0
+    dynamic = None
+    if args.lockdep_graph:
+        try:
+            dynamic = load_dynamic_graph(args.lockdep_graph)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"pbst: bad --lockdep-graph {args.lockdep_graph!r}: {e}",
+                  file=sys.stderr)
+            return 2
+    try:
+        result = check_paths(args.paths, passes=args.passes,
+                             dynamic_graph=dynamic)
+    except KeyError as e:
+        print(f"pbst: {e.args[0]}", file=sys.stderr)
+        return 2
+    if result.files_scanned == 0:
+        print(f"pbst: no python files under {args.paths}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(json.dumps(result.as_dict(), indent=1, sort_keys=True))
+    else:
+        print(format_human(result))
+    return result.exit_code
+
+
+def check_entry() -> None:
+    """Console entry ``pbst-check`` (CI convenience: exactly
+    ``pbst check ...`` without the subcommand word)."""
+    sys.exit(main(["check", *sys.argv[1:]]))
 
 
 def cmd_selftest(args) -> int:
@@ -743,7 +802,26 @@ def main(argv=None) -> int:
     sp = sub.add_parser("lockdep",
                         help="lock-order violations (lockdep)")
     sp.add_argument("file", help="obs dump artifact")
+    sp.add_argument("--dump-graph", action="store_true", dest="dump_graph",
+                    help="print the order graph in its stable JSON form "
+                         "(consumed by pbst check --lockdep-graph)")
     sp.set_defaults(fn=cmd_lockdep)
+
+    sp = sub.add_parser(
+        "check", help="static invariant checkers (docs/ANALYSIS.md)")
+    sp.add_argument("paths", nargs="*", default=["pbs_tpu"],
+                    help="files/dirs to check (default: pbs_tpu)")
+    sp.add_argument("--format", choices=["text", "json"], default="text")
+    sp.add_argument("--pass", dest="passes", action="append",
+                    metavar="PASS-ID",
+                    help="run only this pass (repeatable; default: all)")
+    sp.add_argument("--list-passes", action="store_true",
+                    help="list passes and rule ids, then exit")
+    sp.add_argument("--lockdep-graph", metavar="GRAPH.json",
+                    help="dynamic lock-order graph (pbst lockdep "
+                         "--dump-graph) to cross-check static edges "
+                         "against")
+    sp.set_defaults(fn=cmd_check)
 
     sp = sub.add_parser("selftest",
                         help="hot-path perf canary (x86_tests.c)")

@@ -27,6 +27,8 @@ import struct
 import threading
 from typing import Any, Callable
 
+from pbs_tpu.obs.lockprof import ProfiledLock
+
 MAX_MSG_BYTES = 64 << 20
 _LEN = struct.Struct(">I")
 
@@ -90,10 +92,10 @@ class RpcServer:
         self.ops: dict[str, Callable[..., Any]] = {}
         self.auth_token = auth_token
         self.privileged_subjects = privileged_subjects
-        self._lock = threading.Lock()
+        self._lock = ProfiledLock("rpc_dispatch")
         # Connection bookkeeping must never wait on the dispatch lock,
         # or a fresh ping connection blocks behind a long-running op.
-        self._conns_lock = threading.Lock()
+        self._conns_lock = ProfiledLock("rpc_conns")
         self._conns: set[socket.socket] = set()
         # Liveness probes must answer while a long op holds the dispatch
         # lock — otherwise a busy host reads as dead and gets its jobs
@@ -244,7 +246,11 @@ class RpcClient:
         self.timeout_s = timeout_s
         self.auth_token = auth_token
         self._sock: socket.socket | None = None
-        self._lock = threading.Lock()
+        # Serializes request/response pairs on the one socket; held
+        # across the round trip BY DESIGN (framing would interleave
+        # otherwise) — visible to lockprof as "rpc_client" so that
+        # wait time shows up in contention stats instead of hiding.
+        self._lock = ProfiledLock("rpc_client")
 
     def _ensure(self) -> socket.socket:
         if self._sock is None:

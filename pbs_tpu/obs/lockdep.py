@@ -179,6 +179,27 @@ def dump() -> dict:
     return _graph.snapshot()
 
 
+def export_graph(snapshot: dict | None = None) -> dict:
+    """The dynamic order graph in its *stable public* JSON form — the
+    interface static/dynamic cross-checking consumes (``pbst lockdep
+    --dump-graph`` producing, ``pbst check --lockdep-graph``
+    consuming). Edges are sorted ``[holder, taken]`` pairs so two
+    exports of the same graph are byte-identical; ``version`` gates
+    schema evolution."""
+    snap = snapshot if snapshot is not None else dump()
+    edges = snap.get("edges", {})
+    return {
+        "version": 1,
+        "classes": sorted(snap.get("classes", [])),
+        "edges": sorted([a, b] for a, bs in edges.items() for b in bs),
+        "violations": sorted(
+            ({"holding": v["holding"], "taking": v["taking"],
+              "count": v.get("count", 1)}
+             for v in snap.get("violations", [])),
+            key=lambda v: (v["holding"], v["taking"])),
+    }
+
+
 def reset() -> None:
     _graph.reset()
 

@@ -28,8 +28,16 @@ import time
 
 import numpy as np
 
+from pbs_tpu.utils.clock import SEC, US
+
 HEADER_WORDS = 4
 _MAGIC = 0x70627374_6462  # "pbstdb"
+
+# Pure-Python wait() poll period. The native path blocks in the
+# library; the fallback polls the notify sequence at this cadence — a
+# named constant so the unit checker (and future tuning, e.g. an
+# adaptive backoff param) can see it instead of a bare sleep literal.
+DOORBELL_POLL_NS = 500 * US
 
 
 class Doorbell:
@@ -160,7 +168,7 @@ class Doorbell:
             s = int(self._arr[2])
             if s != last_seq:
                 return s
-            time.sleep(0.0005)
+            time.sleep(DOORBELL_POLL_NS / SEC)
         return int(self._arr[2])
 
 

@@ -14,14 +14,15 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 
 import numpy as np
+
+from pbs_tpu.obs.lockprof import ProfiledLock
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libpbst_runtime.so"))
 
-_lock = threading.Lock()
+_lock = ProfiledLock("native_load")
 _lib: ctypes.CDLL | None = None
 _tried = False
 

@@ -21,9 +21,9 @@ just physically true.
 from __future__ import annotations
 
 import dataclasses
-import threading
 from typing import Any
 
+from pbs_tpu.obs.lockprof import ProfiledLock
 from pbs_tpu.obs.perfc import perfc
 from pbs_tpu.runtime.memory import nbytes_of
 
@@ -37,7 +37,7 @@ SHARED_PREFIX = "shared:"
 # registered id can never be recycled onto an unrelated object while
 # it is in the map (id() reuse after gc was a real bug here).
 _shared_leaves: dict[int, tuple[Any, int]] = {}
-_shared_ids_lock = threading.Lock()
+_shared_ids_lock = ProfiledLock("shared_leaves")
 
 
 def is_shared_leaf(leaf: Any) -> bool:
@@ -92,7 +92,7 @@ class WeightsRegistry:
     def __init__(self, memory=None):
         self.memory = memory
         self._sets: dict[str, SharedWeights] = {}
-        self._lock = threading.Lock()
+        self._lock = ProfiledLock("weights_registry")
 
     def publish(self, name: str, params: Any) -> SharedWeights:
         """Register a weight set (claims its HBM once). Publishing an

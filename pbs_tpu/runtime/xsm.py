@@ -20,7 +20,8 @@ from __future__ import annotations
 
 import dataclasses
 import fnmatch
-import threading
+
+from pbs_tpu.obs.lockprof import ProfiledLock
 
 #: The all-powerful subject (dom0 / system_u in FLASK terms).
 SYSTEM = "system"
@@ -98,7 +99,7 @@ class LabelPolicy:
         return self.default_allow
 
 
-_lock = threading.Lock()
+_lock = ProfiledLock("xsm_policy")
 _policy = DummyPolicy()
 
 

@@ -23,6 +23,23 @@ if TYPE_CHECKING:
     from pbs_tpu.runtime.job import ExecutionContext, Job
     from pbs_tpu.runtime.partition import Partition
 
+# Dispatch-legal slice band. Distinct from the feedback policy's
+# *adaptation* band (sched/feedback.py: 100 µs – 1.1 ms): this is the
+# outer envelope any policy may hand the executor — the CSCHED floor
+# (sched_credit.c:286-300) to the sysctl ceiling (public/sysctl.h:571).
+# Out-of-band writes (operator `pbst sched-credit -t`, restore of an
+# old save record) can land ``params.tslice_us`` anywhere; every
+# ``do_schedule`` clamps at the Decision site so a bad stored value
+# can never become a dispatched quantum (the bug class PR 1's
+# ``_shrink`` clamp fixed — enforced by ``pbst check`` sched-ops).
+TSLICE_MIN_US = 100
+TSLICE_MAX_US = 1_000_000
+
+
+def clamp_tslice_us(us: int) -> int:
+    """Clamp a per-job slice into the dispatch-legal band."""
+    return max(TSLICE_MIN_US, min(TSLICE_MAX_US, int(us)))
+
 
 @dataclasses.dataclass
 class Decision:

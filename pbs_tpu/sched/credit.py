@@ -38,7 +38,12 @@ import dataclasses
 from typing import TYPE_CHECKING
 
 from pbs_tpu.runtime.job import ContextState
-from pbs_tpu.sched.base import Decision, Scheduler, register_scheduler
+from pbs_tpu.sched.base import (
+    Decision,
+    Scheduler,
+    clamp_tslice_us,
+    register_scheduler,
+)
 from pbs_tpu.sched.placement import anti_stack_pick, holds_sibling
 from pbs_tpu.utils.clock import US
 
@@ -199,8 +204,10 @@ class CreditScheduler(Scheduler):
         if ctx in q:
             q.remove(ctx)
         # Per-job adaptive slice applied at schedule exit
-        # (sched_credit.c:1796-1805): THE research mechanism.
-        return Decision(ctx, ctx.job.params.tslice_us * US)
+        # (sched_credit.c:1796-1805): THE research mechanism. Clamped
+        # at the Decision site: tslice_us may have been written
+        # out-of-band (operator store write, restored save record).
+        return Decision(ctx, clamp_tslice_us(ctx.job.params.tslice_us) * US)
 
     def _pick_local(self, q):
         return q[0] if q else None

@@ -43,7 +43,12 @@ from __future__ import annotations
 
 import dataclasses
 
-from pbs_tpu.sched.base import Decision, Scheduler, register_scheduler
+from pbs_tpu.sched.base import (
+    Decision,
+    Scheduler,
+    clamp_tslice_us,
+    register_scheduler,
+)
 from pbs_tpu.utils.clock import US
 
 CREDIT_INIT = 10_000.0  # µs at the runqueue's max weight
@@ -219,7 +224,9 @@ class Credit2Scheduler(Scheduler):
         # bounded carryover (spacing survives, debt doesn't).
         if self._cc(ctx).credit <= RESET_THRESHOLD:
             self._reset(rq, including=ctx)
-        return Decision(ctx, ctx.job.params.tslice_us * US)
+        # Clamped at the Decision site (see sched/base.py): out-of-band
+        # writes must not dispatch an out-of-band quantum.
+        return Decision(ctx, clamp_tslice_us(ctx.job.params.tslice_us) * US)
 
     def _reset(self, rq: RunQueue, including=None) -> None:
         """reset_credit: every context ASSIGNED to the runqueue —

@@ -1,0 +1,16 @@
+"""Seeded counter-API violations (pbst check fixture — never
+imported)."""
+
+
+class StepWatcher:
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.last_steps = 0
+
+    def poll(self, limit):
+        # counter-raw-cache: absolute counter value kept across calls.
+        self.last_steps = int(self.ctx.counters[0])
+        # counter-raw-threshold: inline threshold on a raw read.
+        if self.ctx.counters[0] >= limit:
+            return True
+        return False

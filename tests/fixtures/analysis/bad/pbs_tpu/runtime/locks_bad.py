@@ -1,0 +1,29 @@
+"""Seeded lock-discipline violations (pbst check fixture — never
+imported; its twin is ../../../clean/pbs_tpu/runtime/locks_clean.py)."""
+
+import threading
+import time
+
+from pbs_tpu.obs.lockprof import ProfiledLock
+
+_raw = threading.Lock()  # lock-raw: invisible to lockprof/lockdep
+
+a = ProfiledLock("fixture_a")
+b = ProfiledLock("fixture_b")
+
+
+def take_ab():
+    with a:
+        with b:  # establishes a -> b
+            pass
+
+
+def take_ba():
+    with b:
+        with a:  # lock-order: inverts a -> b (AB-BA)
+            pass
+
+
+def slow_critical_section():
+    with a:
+        time.sleep(0.1)  # lock-blocking: sleep with 'fixture_a' held

@@ -1,0 +1,17 @@
+"""Seeded time-unit violations (pbst check fixture — never imported)."""
+
+TIMEOUT_MS = 5
+
+
+def schedule(period_ns=0):
+    return period_ns
+
+
+def mix(wait_ns, budget_us):
+    total_ns = wait_ns + budget_us  # unit-mix: ns + us, no conversion
+    if wait_ns > TIMEOUT_MS:  # unit-mix: ns compared against ms
+        pass
+    deadline_us = wait_ns  # unit-mix: ns stored under a _us name
+    floor = min(wait_ns, budget_us)  # unit-mix: min() across units
+    schedule(period_ns=budget_us)  # unit-mix: us into a _ns keyword
+    return total_ns, deadline_us, floor

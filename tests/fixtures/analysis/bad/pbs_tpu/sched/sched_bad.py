@@ -1,0 +1,18 @@
+"""Seeded scheduler-ops violations (pbst check fixture — never
+imported, so the bogus policy never reaches the live registry)."""
+
+from pbs_tpu.sched.base import Decision, Scheduler, register_scheduler
+
+US = 1_000
+
+
+@register_scheduler
+class BadScheduler(Scheduler):
+    name = "fixture_bad"
+
+    # sched-ops-missing: no wake() implementation.
+
+    def do_schedule(self, executor, t_ns):  # sched-ops-signature
+        ctx = self.partition.jobs[0].contexts[0]
+        # sched-ops-clamp: raw tslice_us dispatched unclamped.
+        return Decision(ctx, ctx.job.params.tslice_us * US)

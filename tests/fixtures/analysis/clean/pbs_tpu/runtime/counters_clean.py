@@ -1,0 +1,19 @@
+"""Clean twin of counters_bad.py: deltas and sampler thresholds (pbst
+check fixture — never imported)."""
+
+
+class StepWatcher:
+    def __init__(self, ctx, sampler, limit):
+        self.ctx = ctx
+        self.sampler = sampler
+        # Threshold bookkeeping delegated to the sampler (rearm owns
+        # the window baseline).
+        self.sample_id = sampler.arm(ctx, 0, period=limit)
+
+    def poll(self):
+        # Deltas: raw reads never cross the window boundary.
+        delta = self.ctx.counters - self.ctx.prev_counters
+        self.ctx.prev_counters = self.ctx.counters.copy()
+        fired = [e for e in self.sampler.drain()
+                 if e.sample_id == self.sample_id]
+        return delta, fired

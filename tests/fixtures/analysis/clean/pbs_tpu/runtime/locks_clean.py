@@ -1,0 +1,31 @@
+"""Clean twin of locks_bad.py: same shapes, disciplined (pbst check
+fixture — never imported)."""
+
+import threading
+import time
+
+from pbs_tpu.obs.lockprof import ProfiledLock
+
+# Suppression with justification: accounted, not reported.
+_boot = threading.Lock()  # pbst: ignore[lock-raw] -- interpreter-boot guard, taken once before any thread exists
+
+a = ProfiledLock("fixture_clean_a")
+b = ProfiledLock("fixture_clean_b")
+
+
+def take_ab():
+    with a:
+        with b:  # one global order: a before b, everywhere
+            pass
+
+
+def take_ab_again():
+    with a:
+        with b:
+            pass
+
+
+def sleep_outside():
+    with a:
+        pass
+    time.sleep(0.1)  # blocking work after the critical section

@@ -1,0 +1,21 @@
+"""Clean twin of units_bad.py: explicit conversions everywhere (pbst
+check fixture — never imported)."""
+
+US = 1_000
+MS = 1_000_000
+
+TIMEOUT_MS = 5
+
+
+def schedule(period_ns=0):
+    return period_ns
+
+
+def mix(wait_ns, budget_us):
+    total_ns = wait_ns + budget_us * US  # converted before the add
+    if wait_ns > TIMEOUT_MS * MS:  # converted before the compare
+        pass
+    deadline_us = wait_ns // US  # converted before the store
+    floor_ns = min(wait_ns, budget_us * US)
+    schedule(period_ns=budget_us * US)
+    return total_ns, deadline_us, floor_ns

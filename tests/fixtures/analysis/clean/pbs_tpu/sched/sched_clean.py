@@ -1,0 +1,30 @@
+"""Clean twin of sched_bad.py: full ops-table conformance (pbst check
+fixture — never imported)."""
+
+from pbs_tpu.sched.base import (
+    Decision,
+    Scheduler,
+    clamp_tslice_us,
+    register_scheduler,
+)
+
+US = 1_000
+
+
+@register_scheduler
+class GoodScheduler(Scheduler):
+    name = "fixture_good"
+
+    def __init__(self, partition):
+        super().__init__(partition)
+        self.queue = []
+
+    def wake(self, ctx):
+        if ctx not in self.queue:
+            self.queue.append(ctx)
+
+    def do_schedule(self, ex, now_ns):
+        if not self.queue:
+            return Decision(None, 0)
+        ctx = self.queue.pop(0)
+        return Decision(ctx, clamp_tslice_us(ctx.job.params.tslice_us) * US)

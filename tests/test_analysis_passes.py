@@ -1,0 +1,287 @@
+"""pbs_tpu.analysis: the four checker passes against seeded fixtures.
+
+Layout: ``tests/fixtures/analysis/bad/`` holds one file per pass with
+known violations; ``clean/`` holds behavior-twin files that follow the
+convention; ``golden_bad.json`` is the full expected findings list for
+the bad tree (regenerate by running the snippet in docs/ANALYSIS.md
+after an intentional checker change and reviewing the diff).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from pbs_tpu.analysis import check_paths, load_dynamic_graph
+from pbs_tpu.cli.pbst import main
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "fixtures", "analysis")
+BAD = os.path.join(FIXTURES, "bad")
+CLEAN = os.path.join(FIXTURES, "clean")
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _bad_result():
+    return check_paths([BAD], root=BAD)
+
+
+def test_bad_tree_matches_golden():
+    with open(os.path.join(FIXTURES, "golden_bad.json")) as f:
+        golden = json.load(f)
+    got = [fi.as_dict() for fi in _bad_result().findings]
+    assert got == golden
+
+
+def test_all_rules_fire_on_bad_tree():
+    # Every rule of every pass has at least one seeded violation, so a
+    # pass silently going blind shows up as a missing key here.
+    counts = _bad_result().counts()
+    assert set(counts) == {
+        "lock-raw", "lock-order", "lock-blocking",
+        "unit-mix",
+        "sched-ops-missing", "sched-ops-signature", "sched-ops-clamp",
+        "counter-raw-cache", "counter-raw-threshold",
+    }
+
+
+def test_clean_twins_are_clean():
+    r = check_paths([CLEAN], root=CLEAN)
+    assert [fi.as_dict() for fi in r.findings] == []
+    # The one deliberate suppression is accounted, with justification.
+    assert [(fi.check, j) for fi, j in r.suppressed] == [
+        ("lock-raw",
+         "interpreter-boot guard, taken once before any thread exists")]
+
+
+def test_pass_selection():
+    r = check_paths([BAD], root=BAD, passes=["time-units"])
+    assert r.passes_run == ["time-units"]
+    assert set(r.counts()) == {"unit-mix"}
+    with pytest.raises(KeyError):
+        check_paths([BAD], passes=["nonesuch"])
+
+
+def test_suppression_requires_justification(tmp_path):
+    f = tmp_path / "pbs_tpu" / "runtime" / "m.py"
+    f.parent.mkdir(parents=True)
+    f.write_text(
+        "import threading\n"
+        "_a = threading.Lock()  # pbst: ignore[lock-raw]\n"
+        "_b = threading.Lock()  # pbst: ignore-file[lock-raw] -- "
+        "fixture-wide escape, reviewed\n")
+    r = check_paths([str(tmp_path)], root=str(tmp_path))
+    checks = [fi.check for fi in r.findings]
+    # Justified file-wide suppression swallows both lock-raw hits, but
+    # the justification-less comment is itself reported.
+    assert checks == ["bad-suppression"]
+    assert len(r.suppressed) == 2
+
+
+def test_cli_check_bad_tree_exits_nonzero(capsys):
+    assert main(["check", BAD]) == 1
+    out = capsys.readouterr().out
+    assert "lock-order" in out and "finding(s)" in out
+
+
+def test_cli_check_json_format(capsys):
+    assert main(["check", BAD, "--format", "json"]) == 1
+    d = json.loads(capsys.readouterr().out)
+    assert d["version"] == 1
+    assert d["counts"]["unit-mix"] == 5
+    assert all({"check", "path", "line", "col", "message"} <= set(f)
+               for f in d["findings"])
+
+
+def test_cli_unknown_pass_is_usage_error(capsys):
+    assert main(["check", BAD, "--pass", "nonesuch"]) == 2
+    assert "unknown pass" in capsys.readouterr().err
+
+
+def test_cli_list_passes(capsys):
+    assert main(["check", "--list-passes"]) == 0
+    out = capsys.readouterr().out
+    for pid in ("lock-discipline", "time-units", "sched-ops",
+                "counter-api"):
+        assert pid in out
+
+
+def test_static_dynamic_crosscheck(tmp_path, capsys):
+    """The lockdep bridge: a dynamic A->B edge exported via ``pbst
+    lockdep --dump-graph`` makes a static B->A nesting a finding."""
+    from pbs_tpu.obs import lockdep
+    from pbs_tpu.obs.dumpfile import write_obs_dump
+
+    lockdep.lockdep.set("1")
+    lockdep.reset()
+    try:
+        outer = lockdep.OrderedLock("dyn_outer")
+        inner = lockdep.OrderedLock("dyn_inner")
+        with outer:
+            with inner:  # dynamic edge dyn_outer -> dyn_inner
+                pass
+        dump_path = str(tmp_path / "obs.json")
+        write_obs_dump(dump_path)
+    finally:
+        lockdep.lockdep.reset()
+        lockdep.reset()
+
+    assert main(["lockdep", dump_path, "--dump-graph"]) == 0
+    graph = json.loads(capsys.readouterr().out)
+    assert graph["version"] == 1
+    assert ["dyn_outer", "dyn_inner"] in graph["edges"]
+    graph_path = tmp_path / "graph.json"
+    graph_path.write_text(json.dumps(graph))
+    assert ("dyn_outer", "dyn_inner") in load_dynamic_graph(str(graph_path))
+
+    # Static code nesting the two in the INVERTED order: clean on its
+    # own, an AB-BA finding once the dynamic graph joins the check.
+    mod = tmp_path / "pbs_tpu" / "runtime" / "inverted.py"
+    mod.parent.mkdir(parents=True)
+    mod.write_text(
+        "from pbs_tpu.obs.lockprof import ProfiledLock\n"
+        "x = ProfiledLock('dyn_inner')\n"
+        "y = ProfiledLock('dyn_outer')\n"
+        "def f():\n"
+        "    with x:\n"
+        "        with y:\n"
+        "            pass\n")
+    assert main(["check", str(tmp_path / "pbs_tpu")]) == 0
+    capsys.readouterr()
+    assert main(["check", str(tmp_path / "pbs_tpu"),
+                 "--lockdep-graph", str(graph_path)]) == 1
+    assert "AB-BA" in capsys.readouterr().out
+
+
+def test_purely_static_cycle_needs_no_dynamic_graph(tmp_path):
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "from pbs_tpu.obs.lockprof import ProfiledLock\n"
+        "a = ProfiledLock('s_a')\n"
+        "b = ProfiledLock('s_b')\n"
+        "def f():\n"
+        "    with a:\n"
+        "        with b: pass\n"
+        "def g():\n"
+        "    with b:\n"
+        "        with a: pass\n")
+    r = check_paths([str(tmp_path)], root=str(tmp_path))
+    assert [fi.check for fi in r.findings] == ["lock-order", "lock-order"]
+
+
+def test_blocking_in_with_item_is_caught(tmp_path):
+    # `with lock:` + `with open(...)` — the common file-I/O idiom puts
+    # the blocking call in the with-ITEM, not the body.
+    mod = tmp_path / "pbs_tpu" / "runtime" / "m.py"
+    mod.parent.mkdir(parents=True)
+    mod.write_text(
+        "from pbs_tpu.obs.lockprof import ProfiledLock\n"
+        "mu = ProfiledLock('itemlock')\n"
+        "def f(path):\n"
+        "    with mu:\n"
+        "        with open(path) as fh:\n"
+        "            return fh.read()\n")
+    r = check_paths([str(tmp_path)], root=str(tmp_path))
+    assert [fi.check for fi in r.findings] == ["lock-blocking"]
+
+
+def test_deferred_callback_under_lock_not_flagged(tmp_path):
+    # A function BODY defined under a lock runs later, not under it.
+    mod = tmp_path / "pbs_tpu" / "runtime" / "m.py"
+    mod.parent.mkdir(parents=True)
+    mod.write_text(
+        "import time\n"
+        "from pbs_tpu.obs.lockprof import ProfiledLock\n"
+        "mu = ProfiledLock('cb_lock')\n"
+        "cbs = []\n"
+        "def register():\n"
+        "    with mu:\n"
+        "        def cb(now):\n"
+        "            time.sleep(1)\n"
+        "        cbs.append(cb)\n")
+    r = check_paths([str(tmp_path)], root=str(tmp_path))
+    assert r.findings == []
+
+
+def test_cli_malformed_graph_is_usage_error(tmp_path, capsys):
+    bad_graph = tmp_path / "graph.json"
+    for payload in ('{"edges": [["a"]]}', '"just a string"', "[1, 2]"):
+        bad_graph.write_text(payload)
+        assert main(["check", BAD, "--lockdep-graph",
+                     str(bad_graph)]) == 2
+        assert "bad --lockdep-graph" in capsys.readouterr().err
+    # The bare pair-list shorthand is accepted.
+    bad_graph.write_text('[["a", "b"]]')
+    assert load_dynamic_graph(str(bad_graph)) == {("a", "b")}
+
+
+def test_parse_error_is_reported(tmp_path):
+    (tmp_path / "broken.py").write_text("def f(:\n")
+    r = check_paths([str(tmp_path)], root=str(tmp_path))
+    assert [fi.check for fi in r.findings] == ["parse-error"]
+
+
+def test_lock_raw_catches_imported_and_aliased_ctors(tmp_path):
+    mod = tmp_path / "pbs_tpu" / "runtime" / "m.py"
+    mod.parent.mkdir(parents=True)
+    mod.write_text(
+        "from threading import Lock, RLock as RL\n"
+        "_a = Lock()\n"
+        "_b = RL()\n")
+    r = check_paths([str(tmp_path)], root=str(tmp_path))
+    assert [fi.check for fi in r.findings] == ["lock-raw", "lock-raw"]
+
+
+def test_sched_clamp_catches_keyword_and_qualified_decision(tmp_path):
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "from pbs_tpu.sched import base\n"
+        "from pbs_tpu.sched.base import Scheduler, register_scheduler\n"
+        "@register_scheduler\n"
+        "class Kw(Scheduler):\n"
+        "    name = 'kw'\n"
+        "    def wake(self, ctx):\n"
+        "        pass\n"
+        "    def do_schedule(self, ex, now_ns):\n"
+        "        ctx = self.q.pop()\n"
+        "        return base.Decision(\n"
+        "            ctx=ctx, quantum_ns=ctx.job.params.tslice_us * 1000)\n")
+    r = check_paths([str(tmp_path)], root=str(tmp_path))
+    assert [fi.check for fi in r.findings] == ["sched-ops-clamp"]
+
+
+def test_counter_cache_not_fooled_by_unrelated_prev_names(tmp_path):
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "class C:\n"
+        "    def f(self, ctx, prev_offset):\n"
+        "        self.base = int(ctx.counters[0]) + prev_offset\n"
+        "    def g(self, ctx):\n"
+        "        return int(ctx.counters[0] - ctx.prev_counters[0])\n")
+    r = check_paths([str(tmp_path)], root=str(tmp_path))
+    # f caches a raw absolute read (prev_offset is not a baseline);
+    # g is the sanctioned delta idiom.
+    assert [fi.check for fi in r.findings] == ["counter-raw-cache"]
+
+
+def test_obs_dump_accepted_as_lockdep_graph(tmp_path):
+    # Operators will pass the obs dump artifact itself; descend into
+    # its lockdep section instead of fabricating edges from the dump.
+    dump = tmp_path / "obs.json"
+    dump.write_text(json.dumps({
+        "perfc": {"x": 1},
+        "lockprof": [],
+        "lockdep": {"classes": ["a", "b"], "edges": {"a": ["b"]},
+                    "violations": [], "checked_edges": 1},
+        "params": {},
+    }))
+    assert load_dynamic_graph(str(dump)) == {("a", "b")}
+    # A dict with no edges/lockdep key is rejected, not misread.
+    dump.write_text(json.dumps({"perfc": {"x": 1}}))
+    with pytest.raises(ValueError):
+        load_dynamic_graph(str(dump))
