@@ -20,6 +20,7 @@ from typing import Any, Iterable
 from pbs_tpu.analysis.core import CheckContext, Finding, Pass, SourceFile
 from pbs_tpu.analysis.counterapi import CounterApiPass
 from pbs_tpu.analysis.locks import LockDisciplinePass
+from pbs_tpu.analysis.netdiscipline import NetDisciplinePass
 from pbs_tpu.analysis.schedops import SchedOpsPass
 from pbs_tpu.analysis.units import TimeUnitPass
 
@@ -29,6 +30,7 @@ ALL_PASSES: tuple[type[Pass], ...] = (
     TimeUnitPass,
     SchedOpsPass,
     CounterApiPass,
+    NetDisciplinePass,
 )
 
 

@@ -29,6 +29,9 @@ from typing import Any
 
 import numpy as np
 
+from pbs_tpu.faults import injector as _faults
+from pbs_tpu.faults.injector import InjectedFault
+
 MANIFEST = "manifest.json"
 
 import itertools
@@ -102,8 +105,6 @@ def save_checkpoint(path: str, state: Any, metadata: dict | None = None,
     ``path``. Returns the manifest."""
     import jax
 
-    import jax
-
     # One traversal yields leaves, treedef, and key paths. Key paths
     # enable template-free load_checkpoint for plain dict/list trees
     # (param trees); custom pytree nodes, tuples, and bare-leaf states
@@ -117,10 +118,25 @@ def save_checkpoint(path: str, state: Any, metadata: dict | None = None,
     parent = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(parent, exist_ok=True)
     tmp = tempfile.mkdtemp(prefix=".ckpt_tmp_", dir=parent)
+    # ``ckpt.write`` injection point (stream key = checkpoint basename,
+    # logical and run-stable): 'torn' dies mid-serialization — half the
+    # leaves written, no manifest, nothing published — which is exactly
+    # the crash the atomic symlink-swap design defends against; any
+    # previously published generation at ``path`` must remain loadable.
+    # 'delay' stretches the write (a slow disk under the async saver).
+    fault = _faults.consult("ckpt.write", os.path.basename(path))
     try:
+        if fault is not None and fault.fault == "delay":
+            time.sleep(float(fault.args.get("delay_s", 0.001)))
+        tear_at = len(leaves) // 2 if (
+            fault is not None and fault.fault == "torn") else None
         entries = []
         total = 0
         for i, leaf in enumerate(leaves):
+            if tear_at is not None and i >= tear_at:
+                raise InjectedFault(
+                    f"injected torn checkpoint write at leaf {i}/"
+                    f"{len(leaves)} ({os.path.basename(path)})")
             arr = np.asarray(leaf)
             fname = f"leaf_{i:05d}.npy"
             np.save(os.path.join(tmp, fname), arr)
@@ -130,6 +146,12 @@ def save_checkpoint(path: str, state: Any, metadata: dict | None = None,
                 entry["path"] = paths[i]
             entries.append(entry)
             total += arr.nbytes
+        if tear_at is not None:
+            # Leafless state (empty tree): the tear still has to fire
+            # before the manifest makes the write look complete.
+            raise InjectedFault(
+                f"injected torn checkpoint write (pre-manifest, "
+                f"{os.path.basename(path)})")
         if telemetry is not None:
             np.save(os.path.join(tmp, "telemetry.npy"),
                     np.asarray(telemetry))

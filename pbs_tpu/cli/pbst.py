@@ -629,6 +629,71 @@ def cmd_sim(args) -> int:
     return 0
 
 
+def cmd_chaos(args) -> int:
+    """Seeded chaos run (pbs_tpu.faults): controller + agents over the
+    sim workload catalog under an armed FaultPlan, end-state invariants
+    checked, fault-trace digest printed (the determinism witness).
+    ``--selfcheck`` runs the scenario twice and requires identical
+    digests. Exit 0 = every invariant held."""
+    from pbs_tpu.faults import FaultPlan, run_chaos
+
+    if args.plan == "chaos":
+        plan = FaultPlan.chaos(args.seed)
+    elif args.plan == "rpc":
+        plan = FaultPlan.rpc_chaos(args.seed)
+    elif args.plan == "none":
+        plan = FaultPlan(seed=args.seed)  # dry run: seams armed, no rules
+    else:
+        try:
+            with open(args.plan) as f:
+                plan = FaultPlan.from_dict(json.load(f))
+        except (OSError, ValueError, KeyError) as e:
+            print(f"pbst: bad fault plan {args.plan!r}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    kw = dict(workload=args.workload, seed=args.seed,
+              n_agents=args.agents, n_tenants=args.tenants,
+              rounds=args.rounds, plan=plan, trace_path=args.trace,
+              replicate=not args.no_replication)
+    report = run_chaos(**kw)
+    ok = report["ok"]
+    if args.selfcheck:
+        again = run_chaos(**kw)
+        match = again["trace_digest"] == report["trace_digest"]
+        report["selfcheck"] = {
+            "digest_match": match, "second_ok": again["ok"],
+            "second_digest": again["trace_digest"],
+        }
+        ok = ok and match and again["ok"]
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        print(f"workload={report['workload']} seed={report['seed']} "
+              f"agents={report['agents']} rounds={report['rounds']}")
+        print(f"faults_fired={sum(report['faults_fired'].values())} "
+              f"retries={report['client_retries']} "
+              f"idem_hits={report['idem_hits']} "
+              f"round_errors={report['round_errors']}")
+        for k, v in report["faults_fired"].items():
+            print(f"  {k:<32} {v}")
+        for prob in report["problems"]:
+            print(f"  INVARIANT VIOLATED: {prob}")
+        if args.selfcheck:
+            sc = report["selfcheck"]
+            print(f"selfcheck: digest_match={sc['digest_match']} "
+                  f"second_ok={sc['second_ok']}")
+        print(f"trace_digest={report['trace_digest']}")
+        print("ok" if ok else "FAILED")
+    return 0 if ok else 1
+
+
+def chaos_entry() -> None:
+    """Console entry ``pbst-chaos`` (CI convenience: exactly
+    ``pbst chaos ...`` without the subcommand word)."""
+    sys.exit(main(["chaos", *sys.argv[1:]]))
+
+
 def cmd_quantize(args) -> int:
     """Offline int8 weight-only quantization of a param checkpoint:
     reads a checkpoint holding a transformer/MoE param tree, writes a
@@ -920,6 +985,24 @@ def main(argv=None) -> int:
     sp.add_argument("--json", action="store_true",
                     help="full JSON report instead of the summary")
     sp.set_defaults(fn=cmd_sim)
+
+    sp = sub.add_parser(
+        "chaos", help="seeded fault-injection run (pbs_tpu.faults)")
+    sp.add_argument("--workload", default="mixed",
+                    help="workload mix (see docs/SIM.md)")
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--agents", type=int, default=3)
+    sp.add_argument("--tenants", type=int, default=4)
+    sp.add_argument("--rounds", type=int, default=5)
+    sp.add_argument("--plan", default="chaos",
+                    help="'chaos', 'rpc', 'none', or a FaultPlan JSON path")
+    sp.add_argument("--trace", default=None,
+                    help="write the fault trace JSONL here")
+    sp.add_argument("--no-replication", action="store_true")
+    sp.add_argument("--selfcheck", action="store_true",
+                    help="run twice; digests must match")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_chaos)
 
     sp = sub.add_parser("demo", help="run the two-tenant sim demo")
     sp.add_argument("--scheduler", default="credit")

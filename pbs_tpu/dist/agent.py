@@ -16,6 +16,8 @@ import time as _time
 from typing import Any, Callable
 
 from pbs_tpu.dist.rpc import RpcServer
+from pbs_tpu.faults import injector as faults
+from pbs_tpu.faults.injector import InjectedFault
 from pbs_tpu.runtime.xsm import XsmDenied, xsm_check
 from pbs_tpu.runtime.job import ContextState, Job, SchedParams
 from pbs_tpu.runtime.partition import Partition
@@ -83,7 +85,8 @@ class Agent:
             "image": image_workload,
         }
         self.workloads.update(workloads or {})
-        self.server = RpcServer(host=host, port=port, auth_token=auth_token)
+        self.server = RpcServer(host=host, port=port, auth_token=auth_token,
+                                fault_key=name)
         self._auth_token = auth_token
         # Remus surfaces: replicas this host holds for OTHER hosts' jobs
         # (job -> {"epoch", "saved", "source", "received_at"}) and the
@@ -96,12 +99,35 @@ class Agent:
                    "get_replica", "list_replicas", "drop_replica",
                    "replicate_start", "replicate_stop", "replicate_status",
                    "console"):
-            self.server.register(op, getattr(self, "op_" + op))
+            self.server.register(op, self._faulted(op, getattr(self,
+                                                               "op_" + op)))
         # info answers without the dispatch lock: it only reads counts
         # (torn reads are fine for a placement heuristic) and the
         # controller ranks hosts with it while long `run` ops hold the
         # lock — blocking would freeze placement cluster-wide.
         self.server.register("info", self.op_info, lockfree=True)
+
+    def _faulted(self, op_name: str, fn: Callable[..., Any]):
+        """Dispatch seam: the ``agent.op`` injection point (stream key
+        ``<agent>:<op>``). 'crash' raises :class:`InjectedFault` out of
+        the op mid-dispatch — marshalled to the caller exactly like a
+        real agent failure; 'slow' stretches the op (the lock-holder
+        preemption analog the controller's breaker must tolerate).
+        ``info`` is registered unwrapped: liveness/placement probes
+        must stay transport-only signals."""
+        key = f"{self.name}:{op_name}"
+
+        def dispatch(**kwargs: Any) -> Any:
+            f = faults.consult("agent.op", key)
+            if f is not None:
+                if f.fault == "crash":
+                    raise InjectedFault(f"injected agent crash in {key}")
+                if f.fault == "slow":
+                    _time.sleep(float(f.args.get("delay_s", 0.001)))
+            return fn(**kwargs)
+
+        dispatch.__name__ = f"op_{op_name}"
+        return dispatch
 
     # -- ops (the per-host hypercall surface) ----------------------------
 

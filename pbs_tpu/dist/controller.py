@@ -46,6 +46,14 @@ class AgentHandle:
     alive: bool = True
     missed: int = 0
     info: dict = dataclasses.field(default_factory=dict)
+    # Circuit breaker (degraded-mode quarantine): a host that answers
+    # pings but keeps faulting ops is ALIVE-but-untrustworthy — killing
+    # it would re-place jobs that are fine; keeping it in rounds burns
+    # every round on its failures. 'open' = quarantined (no ops, no
+    # placement), 'half_open' = one probe round decides.
+    consecutive_faults: int = 0
+    breaker: str = "closed"  # closed | open | half_open
+    breaker_cooldown: int = 0
 
 
 @dataclasses.dataclass
@@ -71,10 +79,17 @@ class JobRecord:
 class Controller:
     def __init__(self, dead_after_missed: int = 2,
                  subject: str = "controller",
-                 auth_token: str | None = None):
+                 auth_token: str | None = None,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown: int = 2):
         self.agents: dict[str, AgentHandle] = {}
         self.jobs: dict[str, JobRecord] = {}
         self.dead_after_missed = dead_after_missed
+        #: Consecutive op faults before an agent is quarantined, and how
+        #: many healthy heartbeats an open breaker waits before the
+        #: half-open probe round.
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown = breaker_cooldown
         self.last_round_errors: dict[str, Exception] = {}
         # XSM identity presented on every job-mutating agent op; under
         # an enforcing agent policy, grant this label (or pass your own).
@@ -96,9 +111,16 @@ class Controller:
 
     def add_agent(self, name: str, address: tuple[str, int]) -> AgentHandle:
         self._check_name("agent", name)
-        h = AgentHandle(name, RpcClient(address, auth_token=self.auth_token),
+        # fault_key: LOGICAL labels (ports are ephemeral — chaos streams
+        # keyed by them would reseed every run). Probes never retry: a
+        # missed ping must stay a missed ping or dead-host detection
+        # stretches by the whole retry budget.
+        h = AgentHandle(name, RpcClient(address, auth_token=self.auth_token,
+                                        fault_key=name),
                         probe=RpcClient(address, timeout_s=2.0,
-                                        auth_token=self.auth_token),
+                                        auth_token=self.auth_token,
+                                        fault_key=f"{name}/probe",
+                                        max_retries=0),
                         address=(address[0], int(address[1])))
         h.info = h.client.call("info")
         self.agents[name] = h
@@ -106,6 +128,42 @@ class Controller:
 
     def live_agents(self) -> list[AgentHandle]:
         return [h for h in self.agents.values() if h.alive]
+
+    def available_agents(self) -> list[AgentHandle]:
+        """Live agents not quarantined by the circuit breaker.
+        Half-open agents are included — they carry the probe op that
+        decides whether the breaker closes."""
+        return [h for h in self.live_agents() if h.breaker != "open"]
+
+    # -- circuit breaker (degraded-mode quarantine) ----------------------
+
+    def _op_fault(self, h: AgentHandle) -> None:
+        """An op on ``h`` failed (in-band error or transport gave up
+        after retries). Enough consecutive faults — or one fault on a
+        half-open probe — quarantines the host."""
+        h.consecutive_faults += 1
+        if (h.breaker == "half_open"
+                or h.consecutive_faults >= self.breaker_threshold):
+            h.breaker = "open"
+            h.breaker_cooldown = self.breaker_cooldown
+
+    def _op_ok(self, h: AgentHandle) -> None:
+        h.consecutive_faults = 0
+        h.breaker = "closed"
+
+    def _op(self, h: AgentHandle, op: str, **kwargs: Any) -> Any:
+        """A mutating agent op with breaker bookkeeping: EVERY op path
+        feeds the quarantine, not just run_round — a host whose
+        create_job/migrate/replicate keep faulting must stop taking
+        placements just like one whose rounds fault. Re-raises
+        unchanged, so call-site error semantics are untouched."""
+        try:
+            r = h.client.call(op, **kwargs)
+        except Exception:
+            self._op_fault(h)
+            raise
+        self._op_ok(h)
+        return r
 
     # -- failure detection (xenwatchdogd analog) -------------------------
 
@@ -124,6 +182,13 @@ class Controller:
                     return
                 h.missed = 0
                 h.alive = True
+                if h.breaker == "open":
+                    # Healthy transport ticks the quarantine down; at
+                    # zero the breaker half-opens and the next round
+                    # carries the probe op.
+                    h.breaker_cooldown -= 1
+                    if h.breaker_cooldown <= 0:
+                        h.breaker = "half_open"
             else:
                 h.missed += 1
                 if h.missed >= self.dead_after_missed:
@@ -194,8 +259,9 @@ class Controller:
     def place(self, n: int, distinct: bool = False) -> list[AgentHandle]:
         """Pick n target agents, least-loaded first. ``distinct`` forces
         n different hosts (gang anti-stacking); otherwise hosts repeat in
-        load order."""
-        live = self.live_agents()
+        load order. Quarantined (breaker-open) hosts never take new
+        placements."""
+        live = self.available_agents()
         if not live:
             raise RuntimeError("no live agents")
         ranked = self._ranked_live(live)
@@ -229,17 +295,17 @@ class Controller:
         try:
             for i, h in enumerate(targets):
                 member_name = name if n_members == 1 else f"{name}.{i}"
-                h.client.call("create_job", job=member_name,
-                              workload=workload, spec=spec,
-                              subject=self.subject)
+                self._op(h, "create_job", job=member_name,
+                         workload=workload, spec=spec,
+                         subject=self.subject)
                 members.append(MemberRef(h.name, member_name))
         except Exception:
             # Roll back already-placed members so a failed fan-out
             # leaves no orphans and the name stays retryable.
             for m in members:
                 try:
-                    self.agents[m.agent].client.call(
-                        "remove_job", job=m.job, subject=self.subject)
+                    self._op(self.agents[m.agent],
+                             "remove_job", job=m.job, subject=self.subject)
                 except Exception:  # noqa: BLE001 — host may be dead too
                     pass
             raise
@@ -256,7 +322,7 @@ class Controller:
             if h is None or not h.alive:
                 continue
             try:
-                h.client.call("remove_job", job=m.job, subject=self.subject)
+                self._op(h, "remove_job", job=m.job, subject=self.subject)
             except Exception:  # noqa: BLE001 — host may have just died
                 pass
 
@@ -306,19 +372,20 @@ class Controller:
                 if rec.gang:
                     exclude |= {mm.agent for mm in rec.members}
                 ranked = self._ranked_live(
-                    [h for h in self.live_agents() if h.name not in exclude])
+                    [h for h in self.available_agents()
+                     if h.name not in exclude])
                 if not ranked:
                     raise RuntimeError(f"no live migration target for "
                                        f"{rec.name}/{m.job}")
                 dst = ranked[0]
             if dst.name == m.agent:
                 continue
-            saved = src.client.call("save_job", job=m.job,
-                                    subject=self.subject)
+            saved = self._op(src, "save_job", job=m.job,
+                             subject=self.subject)
             try:
-                dst.client.call("restore_job", job=m.job,
-                                workload=rec.workload, spec=rec.spec,
-                                saved=saved, subject=self.subject)
+                self._op(dst, "restore_job", job=m.job,
+                         workload=rec.workload, spec=rec.spec,
+                         saved=saved, subject=self.subject)
             except Exception:
                 # Abort: resume the source copy (xl migrate's abort path
                 # leaves the domain running at the source).
@@ -326,8 +393,8 @@ class Controller:
                                 subject=self.subject)
                 raise
             try:
-                src.client.call("remove_job", job=m.job,
-                                subject=self.subject)
+                self._op(src, "remove_job", job=m.job,
+                         subject=self.subject)
             except Exception:  # noqa: BLE001 — source may have died; the
                 pass  # reconcile fence removes the stale copy later
             m.agent = dst.name
@@ -396,15 +463,15 @@ class Controller:
                 exclude |= {p for j, p in rec.replica_peers.items()
                             if j != m.job}
             ranked = self._ranked_live(
-                [h for h in self.live_agents() if h.name not in exclude])
+                [h for h in self.available_agents()
+                 if h.name not in exclude])
             if not ranked:
                 raise RuntimeError(
                     f"no live backup host for {rec.name}/{m.job}")
             dst = ranked[0]
-        src.client.call(
-            "replicate_start", job=m.job, peer_host=dst.address[0],
-            peer_port=dst.address[1], period_s=period_s,
-            subject=self.subject)
+        self._op(src, "replicate_start", job=m.job, peer_host=dst.address[0],
+                 peer_port=dst.address[1], period_s=period_s,
+                 subject=self.subject)
         rec.replica_peers[m.job] = dst.name
         return dst.name
 
@@ -481,13 +548,24 @@ class Controller:
             try:
                 quanta[h.name] = h.client.call(
                     "run", _timeout=600.0, max_rounds=max_rounds)
+                self._op_ok(h)
+            except RpcError as e:
+                # The host ANSWERED: it is alive, the op faulted. Only
+                # the breaker reacts — counting this toward liveness
+                # would re-place jobs off a host that is still running
+                # them (the split-brain the reconcile fence exists for).
+                errs[h.name] = e
+                self._op_fault(h)
             except Exception as e:  # noqa: BLE001 — surfaced below
                 errs[h.name] = e
+                self._op_fault(h)
                 h.missed += 1
                 if h.missed >= self.dead_after_missed:
                     h.alive = False
 
-        self._fanout(self.live_agents(), _one)  # join = the barrier
+        # Quarantined agents sit rounds out (their jobs stall — the
+        # degraded mode); half-open agents run as their own probe.
+        self._fanout(self.available_agents(), _one)  # join = the barrier
         self.last_round_errors = errs
         if errs and strict:
             raise ClusterRoundError(errs, quanta)
@@ -520,7 +598,7 @@ class Controller:
                 h = self.agents.get(m.agent)
                 if h is not None and h.alive:
                     continue
-                live = self.live_agents()
+                live = self.available_agents()
                 if not live:
                     raise RuntimeError(f"no live host for {rec.name}/{m.job}")
 
@@ -548,10 +626,9 @@ class Controller:
                                     f"no anti-stacking host for "
                                     f"{rec.name}/{m.job}")
                             target = ranked[0]
-                    target.client.call(
-                        "restore_job", job=m.job, workload=rec.workload,
-                        spec=rec.spec, saved=r["saved"],
-                        subject=self.subject)
+                    self._op(target, "restore_job", job=m.job,
+                             workload=rec.workload, spec=rec.spec,
+                             saved=r["saved"], subject=self.subject)
                     holder.client.call("drop_replica", job=m.job,
                                        subject=self.subject)
                 else:
@@ -568,9 +645,9 @@ class Controller:
                         raise RuntimeError(
                             f"no live host for {rec.name}/{m.job}")
                     target = ranked[0]
-                    target.client.call("create_job", job=m.job,
-                                       workload=rec.workload, spec=rec.spec,
-                                       subject=self.subject)
+                    self._op(target, "create_job", job=m.job,
+                             workload=rec.workload, spec=rec.spec,
+                             subject=self.subject)
                 m.agent = target.name
                 moved.append(m.job)
                 self._drop_and_rearm(rec, m)

@@ -50,7 +50,12 @@ class RemusSession:
         self.peer_addr = (peer[0], int(peer[1]))
         self.period_s = period_s
         self.subject = subject
-        self.client = RpcClient(self.peer_addr, auth_token=auth_token)
+        # fault_key: logical (source agent + protected job), so each
+        # replication channel owns its own deterministic fault stream —
+        # a shared default key would interleave consultations across
+        # sessions and make seeded chaos traces depend on pump timing.
+        self.client = RpcClient(self.peer_addr, auth_token=auth_token,
+                                fault_key=f"{agent.name}.remus.{job_name}")
         self.epochs_committed = 0
         self.failures = 0
         self.skipped = 0

@@ -1,0 +1,47 @@
+"""Deterministic, seeded fault injection for the control plane.
+
+``FaultPlan`` (plan.py) declares *what* goes wrong where and how often;
+``FaultInjector`` (injector.py) is the process-global registry every
+instrumented seam consults; ``chaos.py`` drives controller + agents over
+the sim workload catalog under a plan and checks end-state invariants
+(the ``pbst chaos`` engine). See docs/FAULTS.md.
+"""
+
+from pbs_tpu.faults.injector import (
+    Fault,
+    FaultInjector,
+    InjectedFault,
+    active,
+    consult,
+    install,
+    uninstall,
+)
+from pbs_tpu.faults.plan import POINTS, FaultPlan, FaultSpec
+
+
+def __getattr__(name: str):
+    # chaos.py pulls in sim/ and dist/, which import the very modules
+    # that host injection seams (telemetry, runtime) — an eager import
+    # here is a cycle. The seams import ``pbs_tpu.faults.injector``
+    # directly; the chaos engine loads only when someone asks for it.
+    if name in ("run_chaos", "tenant_spec_dict"):
+        from pbs_tpu.faults import chaos
+
+        return getattr(chaos, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "Fault",
+    "FaultInjector",
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedFault",
+    "POINTS",
+    "active",
+    "consult",
+    "install",
+    "run_chaos",
+    "tenant_spec_dict",
+    "uninstall",
+]

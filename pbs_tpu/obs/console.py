@@ -12,6 +12,11 @@ plane (``pbst console``), which mirrors xenconsoled's relay role.
 Sequence-numbered reads make the stream resumable and loss-visible:
 a reader that fell behind sees the gap (``first_seq`` > its cursor),
 exactly like a console ring overwriting old lines.
+
+Besides the per-job rings there is one *system* console — the analog of
+the hypervisor's own ``xl dmesg`` ring: infrastructure that must report
+a condition but has no job to attribute it to (a leaked RPC server
+thread, a quarantined agent) writes here via :func:`log`.
 """
 
 from __future__ import annotations
@@ -56,3 +61,25 @@ class Console:
             "first_seq": first,
             "dropped": max(0, first - since) if since < first else 0,
         }
+
+
+# -- system console (xl dmesg analog) ---------------------------------------
+
+#: The one process-wide infrastructure ring. Bounded like every job
+#: ring, so a wedged component that logs in a loop cannot grow memory.
+_system = Console(capacity=1024)
+
+
+def system_console() -> Console:
+    return _system
+
+
+def log(line: str) -> int:
+    """Write one line to the system console ring. Returns its sequence
+    number. This is where infrastructure reports conditions that have
+    no owning job — operators read it with :func:`read_system`."""
+    return _system.write(line)
+
+
+def read_system(since: int = 0, max_lines: int = 256) -> dict:
+    return _system.read(since, max_lines)

@@ -105,20 +105,29 @@ class ProfiledLock:
         self._depth = 0
         self._t_acq: int | None = None
 
-    def acquire(self) -> None:
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        # Signature-compatible with threading.Lock so a ProfiledLock
+        # can drop in anywhere a raw lock was (RemusSession's
+        # time-bounded epoch quiesce depends on ``timeout=``); a failed
+        # try/timed acquire touches no owner-only state.
         if not lock_profile.value:
-            self._lock.acquire()
+            if not self._lock.acquire(blocking, timeout):
+                return False
             self._depth += 1
-            return
+            return True
         wait: int | None = None
         if not self._lock.acquire(blocking=False):
+            if not blocking:
+                return False
             t0 = time.monotonic_ns()
-            self._lock.acquire()
+            if not self._lock.acquire(timeout=timeout):
+                return False
             wait = time.monotonic_ns() - t0
         self._depth += 1
         self.stats.note_acquire(wait)
         if self._depth == 1:
             self._t_acq = time.monotonic_ns()
+        return True
 
     def release(self) -> None:
         self._depth -= 1

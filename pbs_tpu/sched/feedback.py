@@ -76,6 +76,12 @@ class JobMetricState:
     grows: int = 0
     shrinks: int = 0
     resets: int = 0
+    # Stale-telemetry tracking: consecutive ticks where the job retired
+    # steps but the device-time channel read zero (a dead counter
+    # readout, not idleness). Past the stale window the policy stops
+    # steering and parks the slice on the default band value.
+    stale_ticks: int = 0
+    fallbacks: int = 0
 
 
 class FeedbackPolicy:
@@ -92,12 +98,26 @@ class FeedbackPolicy:
         max_us: int = TSLICE_MAX_US,
         stall_threshold: float = STALL_RATE_THRESHOLD,
         window: int = WINDOW,
+        stale_after: int = WINDOW,
+        fallback_us: int | None = None,
     ):
         self.partition = partition
         self.min_us = min_us
         self.max_us = max_us
         self.stall_threshold = stall_threshold
         self.window_len = window
+        #: Degraded mode (docs/FAULTS.md): after ``stale_after``
+        #: consecutive dead-counter ticks the policy stops steering and
+        #: parks the job's slice at ``fallback_us`` — the boot-param
+        #: default band value, NOT whatever the last (possibly garbage)
+        #: adaptation left behind. Steering on dead counters would walk
+        #: the slice to a band edge and pin it there.
+        self.stale_after = max(1, int(stale_after))
+        if fallback_us is None:
+            from pbs_tpu.runtime.job import SchedParams
+
+            fallback_us = SchedParams().tslice_us
+        self.fallback_us = self._clamp(int(fallback_us))
         self.states: dict[str, JobMetricState] = {}
         now = partition.clock.now_ns()
         self.timer = partition.timers.arm(
@@ -133,6 +153,20 @@ class FeedbackPolicy:
             coll_ns += delta[Counter.COLLECTIVE_WAIT_NS]
         if int(steps) == 0 and int(dev_ns) == 0:
             return  # job idle this tick — nothing to learn
+        if int(steps) > 0 and int(dev_ns) == 0:
+            # Steps retired but zero device time: the readout is dead
+            # (progress is runtime-observed; device time is a counter
+            # read — see telemetry.source._STALLABLE), so every rate
+            # metric this tick would be garbage. Never steer on it.
+            st.stale_ticks += 1
+            if st.stale_ticks == self.stale_after:
+                # Trip once per stall episode: park on the default band
+                # value and forget the (now meaningless) window.
+                st.window.clear()
+                st.fallbacks += 1
+                job.params.tslice_us = self.fallback_us
+            return
+        st.stale_ticks = 0  # live counters again: resume steering
         # Rate metrics (csched_dom_metric_update, s_c.c:427-435).
         if int(dev_ns) > 0:
             job.stall_rate = float(int(stall_ns)) * 1000.0 / float(int(dev_ns))
@@ -235,6 +269,8 @@ class FeedbackPolicy:
                     "grows": st.grows,
                     "shrinks": st.shrinks,
                     "resets": st.resets,
+                    "stale_ticks": st.stale_ticks,
+                    "fallbacks": st.fallbacks,
                 }
             )
         return out

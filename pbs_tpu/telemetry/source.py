@@ -27,6 +27,7 @@ from typing import Any, Callable, Protocol
 
 import numpy as np
 
+from pbs_tpu.faults import injector as _faults
 from pbs_tpu.telemetry.counters import NUM_COUNTERS, Counter
 from pbs_tpu.utils.clock import Clock, MonotonicClock, VirtualClock
 
@@ -35,6 +36,39 @@ from pbs_tpu.utils.clock import Clock, MonotonicClock, VirtualClock
 # in per-family PMU capabilities, asm-x86/perfctr.h:40-65.)
 DEFAULT_PEAK_FLOPS = 197e12  # bf16 FLOP/s
 DEFAULT_PEAK_HBM_BW = 819e9  # bytes/s
+
+
+#: Channels a ``telemetry.counters`` 'stall' fault freezes: the
+#: PMC-grade measurements a dead readout stops delivering. Progress
+#: counters (STEPS_RETIRED, TOKENS, YIELDS) are runtime-observed — the
+#: job really ran — so a stalled readout must NOT erase progress; that
+#: split is exactly what lets the feedback policy *detect* staleness
+#: (steps advanced, device time didn't) and stop steering on it.
+_STALLABLE = (Counter.DEVICE_TIME_NS, Counter.HBM_BYTES,
+              Counter.HBM_STALL_NS, Counter.COLLECTIVE_WAIT_NS,
+              Counter.DEVICE_FLOPS)
+
+#: Channels a 'spike' fault multiplies: the noisy-counter adversity the
+#: feedback policy's stability window must absorb (PAPER.md's "counter
+#: noise" premise) — rate inputs only, never progress.
+_SPIKABLE = (Counter.HBM_STALL_NS, Counter.COLLECTIVE_WAIT_NS)
+
+
+def apply_counter_faults(job_name: str, deltas: np.ndarray) -> np.ndarray:
+    """``telemetry.counters`` injection seam (stream key = job name),
+    shared by every backend: consult once per execute call, mutate the
+    delta vector in place. No injector installed = one global load."""
+    f = _faults.consult("telemetry.counters", job_name)
+    if f is None:
+        return deltas
+    if f.fault == "stall":
+        for c in _STALLABLE:
+            deltas[c] = 0
+    elif f.fault == "spike":
+        factor = float(f.args.get("factor", 10.0))
+        for c in _SPIKABLE:
+            deltas[c] = np.uint64(int(deltas[c]) * factor)
+    return deltas
 
 
 class TelemetrySource(Protocol):
@@ -179,7 +213,7 @@ class SimBackend:
             deltas[Counter.STEPS_RETIRED] += 1
             deltas[Counter.TOKENS] += ph.tokens
             self._steps_done[name] = step + 1
-        return deltas
+        return apply_counter_faults(name, deltas)
 
     def execute_micro(self, ctx: Any, n_micro: int) -> np.ndarray:
         """Micro-step execution: each unit burns 1/K of the phase's step
@@ -203,7 +237,7 @@ class SimBackend:
                 self._steps_done[name] = step + 1
         if ctx.micro_progress:
             deltas[Counter.YIELDS] += 1
-        return deltas
+        return apply_counter_faults(name, deltas)
 
 
 # ---------------------------------------------------------------------------
@@ -398,7 +432,7 @@ class TpuBackend:
             deltas[Counter.COMPILES] += n_c
             deltas[Counter.COMPILE_TIME_NS] += c_ns
             deltas[Counter.STEPS_RETIRED] += 1
-        return deltas
+        return apply_counter_faults(job.name, deltas)
 
     def execute_micro(self, ctx: Any, n_micro: int) -> np.ndarray:
         """Chunked execution of a long-step job: each call to
@@ -433,4 +467,4 @@ class TpuBackend:
                 deltas[Counter.STEPS_RETIRED] += 1
         if ctx.micro_progress:
             deltas[Counter.YIELDS] += 1
-        return deltas
+        return apply_counter_faults(job.name, deltas)

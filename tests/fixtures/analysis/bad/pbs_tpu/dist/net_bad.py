@@ -1,0 +1,18 @@
+"""Seeded net-discipline violations (pbst check fixture — never
+imported)."""
+
+import socket
+
+
+def probe_peer(address):
+    # net-raw-socket: a private wire — no retries, no deadline, no
+    # idempotency token on anything sent here.
+    s = socket.create_connection(address, timeout=2.0)
+    s.sendall(b"ping")
+    return s.recv(16)
+
+
+def push_state(client, payload):
+    # net-raw-transport: the private helper skips the retry loop and
+    # the idempotency token.
+    return client._roundtrip({"op": "push", "args": payload})

@@ -1,0 +1,12 @@
+"""Clean twin of net_bad.py: every byte rides RpcClient.call (pbst
+check fixture — never imported)."""
+
+
+def probe_peer(client):
+    # The sanctioned wire: call() owns retries, deadline, idempotency.
+    return client.call("ping")
+
+
+def push_state(client, payload):
+    # Deadline bounds the whole retry loop, not one attempt.
+    return client.call("push", _deadline=5.0, **payload)

@@ -47,6 +47,7 @@ def test_all_rules_fire_on_bad_tree():
         "unit-mix",
         "sched-ops-missing", "sched-ops-signature", "sched-ops-clamp",
         "counter-raw-cache", "counter-raw-threshold",
+        "net-raw-socket", "net-raw-transport",
     }
 
 

@@ -1,0 +1,90 @@
+"""``pbst chaos`` smoke: seeded, deterministic, invariants hold.
+
+Tier-1 carries one small fixed-seed scenario with a golden fault-trace
+digest (the CI determinism gate: random.Random streams and sha256 are
+platform-stable, so a digest change means injection behavior changed —
+review it like a golden file). The full workload-catalog soak and the
+CLI selfcheck live behind the ``slow`` marker.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from pbs_tpu.cli.pbst import main
+from pbs_tpu.faults import FaultPlan, run_chaos
+from pbs_tpu.faults import injector as faults
+from pbs_tpu.sim.workload import workload_names
+
+#: Golden digest for (stable, seed=0, 2 agents, 2 tenants, 2 rounds)
+#: under FaultPlan.chaos(0). Regenerate via
+#: ``pbst chaos --workload stable --seed 0 --agents 2 --tenants 2
+#: --rounds 2`` after an intentional injection change.
+GOLDEN_SMOKE_DIGEST = (
+    "d809f6d4bd0db30cea84f3b85eca3145f99c657f8f587e20915c34581528bbb1")
+
+SMOKE_KW = dict(workload="stable", seed=0, n_agents=2, n_tenants=2,
+                rounds=2)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    faults.uninstall()
+
+
+def test_chaos_smoke_invariants_and_golden_digest():
+    r = run_chaos(**SMOKE_KW)
+    assert r["problems"] == []
+    assert r["ok"] is True
+    assert sum(r["faults_fired"].values()) > 0  # chaos actually happened
+    assert r["round_errors"] == 0  # retries absorbed every injected fault
+    assert r["ops"]["audited"] is True  # exactly-once evidence admissible
+    assert r["trace_digest"] == GOLDEN_SMOKE_DIGEST
+
+
+def test_chaos_cli_json_smoke(capsys):
+    rc = main(["chaos", "--workload", "stable", "--seed", "0",
+               "--agents", "2", "--tenants", "2", "--rounds", "2",
+               "--json"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["ok"] is True
+    assert report["trace_digest"] == GOLDEN_SMOKE_DIGEST
+
+
+def test_chaos_cli_rejects_bad_plan_file(tmp_path, capsys):
+    bad = tmp_path / "plan.json"
+    bad.write_text(json.dumps(
+        {"seed": 0, "specs": [{"point": "nope", "fault": "reset"}]}))
+    assert main(["chaos", "--plan", str(bad)]) == 2
+
+
+def test_chaos_trace_file_digest_matches_report(tmp_path):
+    import hashlib
+
+    path = tmp_path / "trace.jsonl"
+    r = run_chaos(**SMOKE_KW, trace_path=str(path))
+    h = hashlib.sha256()
+    for line in sorted(path.read_text().splitlines()):
+        h.update(line.encode())
+        h.update(b"\n")
+    assert h.hexdigest() == r["trace_digest"]
+
+
+@pytest.mark.slow
+def test_chaos_soak_full_catalog_all_invariants():
+    # Acceptance sweep: every sim workload, faults enabled, twice each
+    # (digest equality = the determinism criterion).
+    for name in workload_names():
+        a = run_chaos(workload=name, seed=0, rounds=4)
+        assert a["ok"] is True, (name, a["problems"])
+        b = run_chaos(workload=name, seed=0, rounds=4)
+        assert b["trace_digest"] == a["trace_digest"], name
+
+
+@pytest.mark.slow
+def test_chaos_cli_selfcheck_default_plan():
+    assert main(["chaos", "--seed", "0", "--selfcheck"]) == 0

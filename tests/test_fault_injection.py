@@ -1,0 +1,189 @@
+"""pbs_tpu.faults core: plans validate, streams are deterministic,
+the trace digest is the reproducibility witness.
+
+The determinism model under test (injector.py docstring): every
+(point, key) pair owns an independent seeded stream, so a stream's
+decision sequence is a pure function of the plan and its own
+consultation history — and the digest sorts trace lines, so thread
+interleaving across streams cannot change it.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from pbs_tpu.faults import FaultPlan, FaultSpec
+from pbs_tpu.faults import injector as faults
+from pbs_tpu.faults.injector import FaultInjector
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    # The injector registry is process-global: a test that fails before
+    # its own uninstall must not poison the rest of the suite.
+    yield
+    faults.uninstall()
+
+
+# -- plan validation --------------------------------------------------------
+
+
+def test_unknown_point_rejected():
+    with pytest.raises(ValueError, match="unknown injection point"):
+        FaultPlan(specs=(FaultSpec("rpc.clinet", "reset"),)).validate()
+
+
+def test_unknown_fault_kind_rejected():
+    with pytest.raises(ValueError, match="has no fault"):
+        FaultPlan(specs=(FaultSpec("rpc.client", "torn"),)).validate()
+
+
+def test_probability_outside_unit_interval_rejected():
+    with pytest.raises(ValueError, match="outside"):
+        FaultPlan(specs=(FaultSpec("rpc.client", "reset", p=1.5),)).validate()
+
+
+def test_plan_round_trips_through_dict():
+    plan = FaultPlan.chaos(seed=7)
+    again = FaultPlan.from_dict(json.loads(json.dumps(plan.as_dict())))
+    assert again == plan
+
+
+# -- stream determinism -----------------------------------------------------
+
+
+def _drive(inj: FaultInjector, keys, n=50):
+    out = []
+    for i in range(n):
+        for k in keys:
+            f = inj.consult("rpc.client", k)
+            out.append(None if f is None else (f.key, f.fault, f.seq))
+    return out
+
+
+def test_same_seed_same_decisions_and_digest():
+    plan = FaultPlan(seed=3, specs=(
+        FaultSpec("rpc.client", "drop_reply", p=0.3),
+        FaultSpec("rpc.client", "reset", p=0.2),
+    ))
+    a, b = FaultInjector(plan), FaultInjector(plan)
+    assert _drive(a, ["x:run", "y:run"]) == _drive(b, ["x:run", "y:run"])
+    assert a.trace_digest() == b.trace_digest()
+    assert any(r is not None for r in _drive(FaultInjector(plan), ["x:run"]))
+
+
+def test_different_seed_different_digest():
+    mk = lambda s: FaultPlan(seed=s, specs=(
+        FaultSpec("rpc.client", "drop_reply", p=0.5),))
+    a, b = FaultInjector(mk(0)), FaultInjector(mk(1))
+    _drive(a, ["x:run"]), _drive(b, ["x:run"])
+    assert a.trace_digest() != b.trace_digest()
+
+
+def test_digest_independent_of_stream_interleaving():
+    # Two runs consult the same per-stream sequences in a different
+    # global order (thread-race analog): identical digests, different
+    # append order.
+    plan = FaultPlan(seed=5, specs=(
+        FaultSpec("rpc.client", "drop_reply", p=0.6),))
+    a, b = FaultInjector(plan), FaultInjector(plan)
+    for k in ("s1", "s2"):
+        for _ in range(40):
+            a.consult("rpc.client", k)
+    for _ in range(40):
+        for k in ("s2", "s1"):
+            b.consult("rpc.client", k)
+    assert a.trace_lines() != b.trace_lines()  # order really differed
+    assert a.trace_digest() == b.trace_digest()
+
+
+def test_stream_isolation_consultations_elsewhere_do_not_perturb():
+    plan = FaultPlan(seed=9, specs=(
+        FaultSpec("rpc.client", "reset", p=0.4),))
+    a, b = FaultInjector(plan), FaultInjector(plan)
+    seq_a = _drive(a, ["victim"])
+    _drive(b, ["noise1", "noise2"], n=17)  # extra traffic on OTHER keys
+    assert _drive(b, ["victim"]) == seq_a
+
+
+# -- spec matching ----------------------------------------------------------
+
+
+def test_key_glob_scopes_rule():
+    plan = FaultPlan(seed=0, specs=(
+        FaultSpec("agent.op", "crash", p=1.0, key="*:run"),))
+    inj = FaultInjector(plan)
+    assert inj.consult("agent.op", "a0:run").fault == "crash"
+    assert inj.consult("agent.op", "a0:create_job") is None
+
+
+def test_after_skips_warmup_and_times_caps_fires():
+    plan = FaultPlan(seed=0, specs=(
+        FaultSpec("rpc.client", "reset", p=1.0, after=2, times=3),))
+    inj = FaultInjector(plan)
+    hits = [inj.consult("rpc.client", "k") is not None for _ in range(10)]
+    assert hits == [False, False, True, True, True,
+                    False, False, False, False, False]
+
+
+def test_first_matching_rule_wins():
+    plan = FaultPlan(seed=0, specs=(
+        FaultSpec("rpc.client", "garble", p=1.0, key="special"),
+        FaultSpec("rpc.client", "reset", p=1.0),
+    ))
+    inj = FaultInjector(plan)
+    assert inj.consult("rpc.client", "special").fault == "garble"
+    assert inj.consult("rpc.client", "other").fault == "reset"
+
+
+# -- the global registry ----------------------------------------------------
+
+
+def test_consult_without_install_is_inert():
+    assert faults.active() is None
+    assert faults.consult("rpc.client", "anything") is None
+
+
+def test_double_install_rejected_uninstall_idempotent():
+    faults.install(FaultPlan(seed=0))
+    with pytest.raises(RuntimeError, match="already installed"):
+        faults.install(FaultPlan(seed=1))
+    inj = faults.uninstall()
+    assert inj is not None
+    assert faults.uninstall() is None  # idempotent
+
+
+def test_torn_checkpoint_write_keeps_published_generation(tmp_path):
+    # The ckpt.write seam dies mid-serialization, BEFORE the manifest
+    # and the atomic symlink swap: the previously published generation
+    # must stay loadable and no partial state may be visible.
+    from pbs_tpu.ckpt.checkpoint import load_checkpoint, save_checkpoint
+    from pbs_tpu.faults.injector import InjectedFault
+
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, {"w": [1.0, 2.0], "b": [3.0]})
+    faults.install(FaultPlan(seed=0, specs=(
+        FaultSpec("ckpt.write", "torn", p=1.0, key="ck"),)))
+    with pytest.raises(InjectedFault, match="torn"):
+        save_checkpoint(path, {"w": [9.0, 9.0], "b": [9.0]})
+    faults.uninstall()
+    state, _ = load_checkpoint(path)
+    assert [float(x) for x in state["w"]] == [1.0, 2.0]  # old gen intact
+    leftovers = [d for d in tmp_path.iterdir()
+                 if d.name.startswith(".ckpt_tmp_")]
+    assert leftovers == []  # the torn tmp dir was swept up
+
+
+def test_trace_file_matches_records(tmp_path):
+    plan = FaultPlan(seed=0, specs=(
+        FaultSpec("rpc.client", "reset", p=1.0, times=4),))
+    inj = faults.install(plan, trace_path=str(tmp_path / "trace.jsonl"))
+    for _ in range(6):
+        faults.consult("rpc.client", "k")
+    faults.uninstall()
+    path = inj.write_trace()
+    lines = [json.loads(x) for x in open(path)]
+    assert lines == inj.records
+    assert [r["seq"] for r in lines] == [0, 1, 2, 3]

@@ -1,0 +1,124 @@
+"""Feedback scheduler degraded mode: stale telemetry => default band.
+
+PAPER.md's premise is steering on live counters; the failure mode is
+steering on DEAD ones. The split that makes staleness detectable:
+progress counters (STEPS_RETIRED) are runtime-observed, PMC-grade rate
+channels (DEVICE_TIME_NS, ...) come from the readout — a stalled
+readout shows steps advancing with zero device time. After
+``stale_after`` such ticks the policy must park the slice on the
+default band value instead of walking it to a band edge on garbage.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pbs_tpu.faults import FaultPlan, FaultSpec
+from pbs_tpu.faults import injector as faults
+from pbs_tpu.runtime import Job, Partition, SchedParams
+from pbs_tpu.sched.feedback import FeedbackPolicy
+from pbs_tpu.telemetry import Counter, SimBackend, SimProfile
+from pbs_tpu.telemetry.source import apply_counter_faults
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    faults.uninstall()
+
+
+def _stall_plan(job: str = "w") -> None:
+    faults.install(FaultPlan(seed=0, specs=(
+        FaultSpec("telemetry.counters", "stall", p=1.0, key=job),)))
+
+
+def setup(stall_frac=0.5, tslice_us=200, **fb_kw):
+    be = SimBackend()
+    part = Partition("t", source=be, scheduler="credit")
+    fb = FeedbackPolicy(part, **fb_kw)
+    be.register("w", SimProfile.steady(
+        step_time_ns=100_000, stall_frac=stall_frac,
+        collective_wait_ns=1_000))
+    job = Job("w", params=SchedParams(tslice_us=tslice_us),
+              max_steps=100_000)
+    job.contexts[0].avg_step_ns = 100_000
+    part.add_job(job)
+    return part, fb, job
+
+
+# -- the seam itself --------------------------------------------------------
+
+
+def test_stall_freezes_rate_channels_never_progress():
+    _stall_plan()
+    d = np.zeros(len(Counter), dtype=np.uint64)
+    d[Counter.STEPS_RETIRED] = 3
+    d[Counter.DEVICE_TIME_NS] = 1_000_000
+    d[Counter.HBM_STALL_NS] = 500_000
+    out = apply_counter_faults("w", d)
+    assert out[Counter.DEVICE_TIME_NS] == 0
+    assert out[Counter.HBM_STALL_NS] == 0
+    assert out[Counter.STEPS_RETIRED] == 3  # the job really ran
+
+
+def test_spike_multiplies_rate_inputs_only():
+    faults.install(FaultPlan(seed=0, specs=(
+        FaultSpec("telemetry.counters", "spike", p=1.0, key="w",
+                  args={"factor": 50.0}),)))
+    d = np.zeros(len(Counter), dtype=np.uint64)
+    d[Counter.STEPS_RETIRED] = 2
+    d[Counter.HBM_STALL_NS] = 1_000
+    out = apply_counter_faults("w", d)
+    assert out[Counter.HBM_STALL_NS] == 50_000
+    assert out[Counter.STEPS_RETIRED] == 2
+
+
+# -- the degraded mode ------------------------------------------------------
+
+
+def test_stale_telemetry_falls_back_to_default_band():
+    part, fb, job = setup(stale_after=3, fallback_us=500)
+    part.run(until_ns=100_000_000)
+    adapted = job.params.tslice_us
+    assert adapted > 200  # live counters: the slice was steering up
+    _stall_plan()
+    part.run(until_ns=200_000_000)
+    st = fb.state_of(job)
+    assert st.fallbacks == 1  # tripped once per stall episode, not per tick
+    assert st.stale_ticks >= 3
+    assert job.params.tslice_us == 500  # parked on the default band value
+    assert job.params.tslice_us != adapted
+
+
+def test_steering_resumes_when_counters_come_back():
+    part, fb, job = setup(stale_after=3, fallback_us=500)
+    _stall_plan()
+    part.run(until_ns=100_000_000)
+    assert job.params.tslice_us == 500
+    assert fb.state_of(job).fallbacks == 1
+    faults.uninstall()
+    part.run(until_ns=250_000_000)
+    st = fb.state_of(job)
+    assert st.stale_ticks == 0  # live again
+    assert job.params.tslice_us > 500  # memory-bound phase grows off park
+    assert st.grows > 0
+
+
+def test_fallback_defaults_to_boot_param_band_value():
+    part, fb, _ = setup()
+    assert fb.fallback_us == SchedParams().tslice_us
+
+
+def test_idle_job_is_not_stale():
+    # zero steps AND zero device time = idle, not a dead readout: the
+    # fallback must not trip on a sleeping tenant.
+    part, fb, job = setup(stale_after=1)
+    part.run(until_ns=20_000_000)
+    part.sleep_job(job)
+    before = job.params.tslice_us
+    part.run(until_ns=120_000_000)
+    st = fb.state_of(job)
+    assert st.fallbacks == 0
+    assert st.stale_ticks == 0
+    assert job.params.tslice_us == before

@@ -1,0 +1,95 @@
+"""Remus replication under injected transport faults.
+
+The commit handshake under test: ``epochs_committed`` advances ONLY on
+a real ack from the backup (tools/remus commit model). Retries plus the
+idempotency token mean a lost reply still commits exactly one epoch —
+the backup executed one ``push_replica``, and the retried frame got the
+cached ack, not a second execution.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from pbs_tpu.dist import Agent, RemusSession
+from pbs_tpu.faults import FaultPlan, FaultSpec
+from pbs_tpu.faults import injector as faults
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    faults.uninstall()
+
+
+@pytest.fixture()
+def pair():
+    src = Agent("rsrc")  # source never needs its own server serving
+    dst = Agent("rdst").start()
+    src.op_create_job("prot", spec={"step_time_ns": 200_000,
+                                    "sched": {"weight": 320}})
+    sess = RemusSession(src, "prot", dst.address, period_s=3600.0)
+    yield src, dst, sess
+    sess.client.close()
+    dst.stop()
+    src.server.stop()
+
+
+def _remus_plan(fault: str, times: int | None = 1) -> None:
+    # Keyed to the session's own stream: `<source>.remus.<job>:<op>`.
+    faults.install(FaultPlan(seed=0, specs=(
+        FaultSpec("rpc.client", fault, p=1.0, times=times,
+                  key="rsrc.remus.prot:push_replica"),)))
+
+
+@pytest.mark.parametrize("fault", ["drop_reply", "drop_request",
+                                   "duplicate", "reset"])
+def test_fault_matrix_epoch_advances_exactly_once_per_real_ack(pair, fault):
+    src, dst, sess = pair
+    _remus_plan(fault)
+    assert sess.tick_once() is True  # retries + dedup absorbed the fault
+    # the fault really fired: it cost a retry or a dedup cache hit
+    assert sess.client.retries + dst.server.idem_hits > 0
+    assert sess.epochs_committed == 1
+    assert sess.failures == 0
+    # one REAL execution on the backup, whatever the wire did
+    assert dst.server.op_executions["push_replica"] == 1
+    assert dst.replicas["prot"]["epoch"] == 0
+    # next epoch on a clean wire: everything advances in lockstep
+    assert sess.tick_once() is True
+    assert sess.epochs_committed == 2
+    assert dst.server.op_executions["push_replica"] == 2
+    assert dst.replicas["prot"]["epoch"] == 1
+
+
+def test_exhausted_retries_do_not_count_an_epoch(pair):
+    src, dst, sess = pair
+    _remus_plan("drop_reply", times=None)  # every attempt loses its ack
+    assert sess.tick_once() is False
+    assert sess.epochs_committed == 0  # no ack, no commit
+    assert sess.failures == 1
+    # ...but the backup DID execute the push once (dedup ate the
+    # retries): the replica exists, merely uncommitted source-side.
+    assert dst.server.op_executions["push_replica"] == 1
+    assert dst.replicas["prot"]["epoch"] == 0
+    faults.uninstall()
+    # Wire heals: the session re-ships epoch 0 (a fresh token — the
+    # idempotency token is stable only across ONE call's retries) and
+    # finally counts it. Equal epoch is accepted, not "stale": only
+    # OLDER epochs roll back.
+    assert sess.tick_once() is True
+    assert sess.epochs_committed == 1
+    assert dst.server.op_executions["push_replica"] == 2
+
+
+def test_delayed_duplicate_cannot_roll_replica_back(pair):
+    src, dst, sess = pair
+    assert sess.tick_once() and sess.tick_once() and sess.tick_once()
+    assert dst.replicas["prot"]["epoch"] == 2
+    # A stale epoch arriving late (replayed frame from a resurrected
+    # source) is refused and reported stale.
+    ack = sess.client.call("push_replica", job="prot", epoch=0,
+                           saved=src.snapshot_record("prot"),
+                           source="rsrc", subject="controller")
+    assert ack == {"job": "prot", "epoch": 2, "stale": True}
+    assert dst.replicas["prot"]["epoch"] == 2
