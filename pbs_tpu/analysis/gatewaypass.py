@@ -1,0 +1,153 @@
+"""Gateway-discipline pass.
+
+Serving traffic enters through exactly one door: :class:`pbs_tpu
+.gateway.Gateway`, which owns admission (tenant quotas, backpressure,
+explicit shed), fair queueing across tenants, and routing with the
+drain/requeue guarantee (docs/GATEWAY.md). What breaks is code
+submitting straight into an engine or dispatching straight onto a
+backend — that traffic is invisible to every one of those guarantees:
+no quota charges it, no fairness schedules it, and a backend loss
+silently drops it. Two rules, scoped to the package tree minus the
+machinery (``pbs_tpu/gateway/`` implements the door; ``models/
+serving.py`` implements the engine the door fronts) and tests:
+
+- ``gw-direct-submit``: ``.submit(...)`` on an object constructed from
+  ``ContinuousBatcher``/``SpeculativeBatcher`` in the same module
+  (including ``self.x = ContinuousBatcher(...)`` attributes) — an
+  admission bypass.
+- ``gw-direct-dispatch``: a call to a backend's ``dispatch_request``
+  — dispatch without routing, so nothing requeues it on backend loss.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from pbs_tpu.analysis.core import (
+    CheckContext,
+    Finding,
+    Pass,
+    SourceFile,
+    qualified_name,
+)
+
+#: Engine constructors whose instances must be fed via the gateway.
+ENGINE_CTORS = {"ContinuousBatcher", "SpeculativeBatcher"}
+
+#: Modules that ARE the machinery (relative to the package root).
+MACHINERY = ("gateway", "models/serving.py")
+
+
+def _anchored(rel_path: str) -> list[str]:
+    parts = rel_path.replace("\\", "/").split("/")
+    if "pbs_tpu" in parts:
+        parts = parts[parts.index("pbs_tpu") + 1:]
+    return parts
+
+
+def _exempt(rel_path: str) -> bool:
+    parts = _anchored(rel_path)
+    if not parts:
+        return True
+    if parts[0] == "gateway" or "/".join(parts) == "models/serving.py":
+        return True
+    # Tests drive engines directly on purpose (parity/latency pins).
+    norm = rel_path.replace("\\", "/")
+    return "tests/" in norm or norm.rsplit("/", 1)[-1].startswith("test_")
+
+
+def _ctor_name(node: ast.AST) -> str | None:
+    """Last dotted segment of a Call's callee, if resolvable."""
+    if not isinstance(node, ast.Call):
+        return None
+    qual = qualified_name(node.func)
+    if qual is None:
+        return None
+    return qual.rsplit(".", 1)[-1]
+
+
+class _EngineNames(ast.NodeVisitor):
+    """First sweep: names/attributes bound to engine constructions."""
+
+    def __init__(self) -> None:
+        self.names: set[str] = set()
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if _ctor_name(node.value) in ENGINE_CTORS:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.names.add(tgt.id)
+                elif isinstance(tgt, ast.Attribute):
+                    self.names.add(tgt.attr)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None and _ctor_name(node.value) in ENGINE_CTORS:
+            if isinstance(node.target, ast.Name):
+                self.names.add(node.target.id)
+            elif isinstance(node.target, ast.Attribute):
+                self.names.add(node.target.attr)
+        self.generic_visit(node)
+
+
+class _GatewayScan(ast.NodeVisitor):
+    def __init__(self, src: SourceFile, engine_names: set[str]):
+        self.src = src
+        self.engine_names = engine_names
+        self.findings: list[Finding] = []
+
+    def _base_name(self, node: ast.Attribute) -> str | None:
+        base = node.value
+        if isinstance(base, ast.Name):
+            return base.id
+        if isinstance(base, ast.Attribute):
+            return base.attr
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr == "submit":
+                base = self._base_name(func)
+                qual = qualified_name(func) or ""
+                owner = qual.rsplit(".", 2)
+                if (base in self.engine_names
+                        or (len(owner) >= 2 and owner[-2] in ENGINE_CTORS)):
+                    self.findings.append(Finding(
+                        "gw-direct-submit", self.src.rel_path,
+                        node.lineno, node.col_offset,
+                        "direct engine submit bypasses the gateway — no "
+                        "admission (quota/backpressure), no fair queue, "
+                        "no requeue on backend loss",
+                        hint="route requests through Gateway.submit "
+                             "(pbs_tpu.gateway); wrap the engine in a "
+                             "BatcherBackend"))
+            elif func.attr == "dispatch_request":
+                self.findings.append(Finding(
+                    "gw-direct-dispatch", self.src.rel_path,
+                    node.lineno, node.col_offset,
+                    "direct backend dispatch skips routing — nothing "
+                    "drains or requeues this request if the backend "
+                    "dies, and no queue-delay sample is taken",
+                    hint="let the gateway pump dispatch (Gateway.tick); "
+                         "backends are routed least-loaded and "
+                         "breaker-vetted there"))
+        self.generic_visit(node)
+
+
+class GatewayDisciplinePass(Pass):
+    id = "gateway-discipline"
+    rules = ("gw-direct-submit", "gw-direct-dispatch")
+    description = ("serving requests enter through the gateway front "
+                   "door (admission, fair queue, routed dispatch); "
+                   "direct engine submits and backend dispatches "
+                   "outside pbs_tpu/gateway/ are flagged")
+
+    def run(self, src: SourceFile, ctx: CheckContext) -> list[Finding]:
+        if src.tree is None or _exempt(src.rel_path):
+            return []
+        names = _EngineNames()
+        names.visit(src.tree)
+        scan = _GatewayScan(src, names.names)
+        scan.visit(src.tree)
+        return scan.findings

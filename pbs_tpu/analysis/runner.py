@@ -19,6 +19,7 @@ from typing import Any, Iterable
 
 from pbs_tpu.analysis.core import CheckContext, Finding, Pass, SourceFile
 from pbs_tpu.analysis.counterapi import CounterApiPass
+from pbs_tpu.analysis.gatewaypass import GatewayDisciplinePass
 from pbs_tpu.analysis.locks import LockDisciplinePass
 from pbs_tpu.analysis.netdiscipline import NetDisciplinePass
 from pbs_tpu.analysis.schedops import SchedOpsPass
@@ -31,6 +32,7 @@ ALL_PASSES: tuple[type[Pass], ...] = (
     SchedOpsPass,
     CounterApiPass,
     NetDisciplinePass,
+    GatewayDisciplinePass,
 )
 
 

@@ -12,6 +12,7 @@ Reference surface being re-expressed (``tools/libxl/xl_cmdimpl.c``,
     pbst ckpt-info  inspect a checkpoint directory (xl save artifacts)
     pbst sched-credit  adjust weight/cap in a store db (xl sched-credit)
     pbst check      static invariant checker suite (docs/ANALYSIS.md)
+    pbst gateway    serving front door demo + ledger stats (docs/GATEWAY.md)
     pbst demo       run the two-tenant sim demo end to end
 
 Monitors attach to artifacts (ledger file, store db, trace dump), not to
@@ -633,9 +634,52 @@ def cmd_chaos(args) -> int:
     """Seeded chaos run (pbs_tpu.faults): controller + agents over the
     sim workload catalog under an armed FaultPlan, end-state invariants
     checked, fault-trace digest printed (the determinism witness).
+    ``--plan gateway`` attacks the serving front door instead
+    (pbs_tpu.gateway: admission sheds/stalls, misroutes, a backend
+    kill) with the "no admitted request lost" invariant.
     ``--selfcheck`` runs the scenario twice and requires identical
     digests. Exit 0 = every invariant held."""
     from pbs_tpu.faults import FaultPlan, run_chaos
+
+    if args.plan == "gateway":
+        from pbs_tpu.gateway import run_gateway_chaos
+
+        kw = dict(workload=args.workload, seed=args.seed,
+                  n_backends=args.agents, n_tenants=args.tenants,
+                  ticks=args.rounds * 80, trace_path=args.trace)
+        report = run_gateway_chaos(**kw)
+        ok = report["ok"]
+        if args.selfcheck:
+            again = run_gateway_chaos(**kw)
+            match = again["trace_digest"] == report["trace_digest"]
+            report["selfcheck"] = {
+                "digest_match": match, "second_ok": again["ok"],
+                "second_digest": again["trace_digest"],
+            }
+            ok = ok and match and again["ok"]
+        if args.json:
+            print(json.dumps(report, indent=1, sort_keys=True))
+        else:
+            st = report["stats"]
+            print(f"gateway chaos workload={report['workload']} "
+                  f"seed={report['seed']} backends={report['backends']} "
+                  f"ticks={report['ticks']} "
+                  f"killed={report['killed_backend']}")
+            print(f"admitted={st['admitted']} completed={st['completed']} "
+                  f"requeued={st['requeued']} "
+                  f"shed_rate={st['shed_rate']} "
+                  f"faults_fired={sum(report['faults_fired'].values())}")
+            for k, v in report["faults_fired"].items():
+                print(f"  {k:<32} {v}")
+            for prob in report["problems"]:
+                print(f"  INVARIANT VIOLATED: {prob}")
+            if args.selfcheck:
+                sc = report["selfcheck"]
+                print(f"selfcheck: digest_match={sc['digest_match']} "
+                      f"second_ok={sc['second_ok']}")
+            print(f"trace_digest={report['trace_digest']}")
+            print("ok" if ok else "FAILED")
+        return 0 if ok else 1
 
     if args.plan == "chaos":
         plan = FaultPlan.chaos(args.seed)
@@ -694,6 +738,80 @@ def chaos_entry() -> None:
     sys.exit(main(["chaos", *sys.argv[1:]]))
 
 
+def cmd_gateway(args) -> int:
+    """Serving front-door surface (docs/GATEWAY.md).
+
+    ``pbst gateway demo``  — the fault-free gateway scenario over the
+    sim workload catalog (seeded arrivals, simulated backends): prints
+    admission/fairness/queue-delay stats per SLO class.
+    ``pbst gateway stats --ledger F`` — render a gateway telemetry
+    ledger (the per-class slots) the way ``pbst dump`` renders a
+    partition's.
+    """
+    if args.action == "stats":
+        from pbs_tpu.gateway.gateway import GW_LEDGER_SLOTS
+        from pbs_tpu.telemetry import Counter, Ledger
+
+        if args.ledger is None:
+            print("pbst: gateway stats needs --ledger", file=sys.stderr)
+            return 2
+        led = Ledger.file_backed(args.ledger, readonly=True)
+        print(f"{'class':<14} {'completed':>10} {'dispatched':>10} "
+              f"{'shed':>6} {'requeued':>8} {'cost':>8} "
+              f"{'avg_qdelay_ms':>14} {'avg_service_ms':>15}")
+        for cls, slot in GW_LEDGER_SLOTS.items():
+            snap = led.snapshot(slot)
+            dispatched = int(snap[Counter.SCHED_COUNT])
+            completed = int(snap[Counter.STEPS_RETIRED])
+            # The ledger counters are cumulative sums; render the
+            # per-request means an operator reads as latency figures.
+            qdelay = (int(snap[Counter.RUNQ_WAIT_NS]) / 1e6
+                      / max(1, dispatched))
+            service = (int(snap[Counter.DEVICE_TIME_NS]) / 1e6
+                       / max(1, completed))
+            print(f"{cls:<14} {completed:>10} "
+                  f"{dispatched:>10} "
+                  f"{int(snap[Counter.COMPILES]):>6} "
+                  f"{int(snap[Counter.YIELDS]):>8} "
+                  f"{int(snap[Counter.TOKENS]):>8} "
+                  f"{qdelay:>14.3f} "
+                  f"{service:>15.3f}")
+        return 0
+    # demo: the chaos harness with no faults and no backend kill.
+    from pbs_tpu.faults import FaultPlan
+    from pbs_tpu.gateway import run_gateway_chaos
+
+    report = run_gateway_chaos(
+        workload=args.workload, seed=args.seed,
+        n_backends=args.backends, n_tenants=args.tenants,
+        ticks=args.ticks, plan=FaultPlan(seed=args.seed),
+        ledger_path=args.ledger, kill_backend=False)
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+        return 0 if report["ok"] else 1
+    st = report["stats"]
+    print(f"gateway demo workload={report['workload']} "
+          f"seed={report['seed']} backends={report['backends']} "
+          f"tenants={report['tenants']} ticks={report['ticks']}")
+    print(f"admitted={st['admitted']} completed={st['completed']} "
+          f"shed_rate={st['shed_rate']} sheds={st['shed']}")
+    for cls, c in st["classes"].items():
+        print(f"  {cls:<12} queued={c['queued']:>4} "
+              f"qdelay_p50_ms={c['qdelay_p50_ns'] / 1e6:>8.3f} "
+              f"qdelay_p99_ms={c['qdelay_p99_ns'] / 1e6:>8.3f} "
+              f"latency_p99_ms={c['latency_p99_ns'] / 1e6:>8.3f}")
+    for prob in report["problems"]:
+        print(f"  PROBLEM: {prob}")
+    print("ok" if report["ok"] else "FAILED")
+    return 0 if report["ok"] else 1
+
+
+def gateway_entry() -> None:
+    """Console entry ``pbst-gateway`` (CI convenience: exactly
+    ``pbst gateway ...`` without the subcommand word)."""
+    sys.exit(main(["gateway", *sys.argv[1:]]))
+
+
 def cmd_quantize(args) -> int:
     """Offline int8 weight-only quantization of a param checkpoint:
     reads a checkpoint holding a transformer/MoE param tree, writes a
@@ -729,8 +847,10 @@ def cmd_quantize(args) -> int:
 
 def cmd_serve_demo(args) -> int:
     """Continuous-batching serving demo on a tiny model (CPU-safe):
-    submits a request mix with repeated prompts, drains the engine,
-    prints the SLO/stats surface (incl. prefix-cache hits)."""
+    submits a request mix THROUGH the gateway front door (admission +
+    fair queue + routing; docs/GATEWAY.md), drains the engine, prints
+    both surfaces — gateway stats and the engine's SLO stats (incl.
+    prefix-cache hits)."""
     import os
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -743,6 +863,7 @@ def cmd_serve_demo(args) -> int:
         pass
     import jax.numpy as jnp
 
+    from pbs_tpu.gateway import BatcherBackend, Gateway, TenantQuota
     from pbs_tpu.models import TransformerConfig, init_params
     from pbs_tpu.models.serving import ContinuousBatcher
 
@@ -753,16 +874,27 @@ def cmd_serve_demo(args) -> int:
     eng = ContinuousBatcher(cfg, params, n_slots=args.slots,
                             prompt_bucket=16, max_len=64,
                             prefix_cache_size=args.prefix_cache)
+    gw = Gateway(
+        [BatcherBackend("engine", eng)],
+        quotas={"demo": TenantQuota(rate=1000.0, burst=256.0,
+                                    slo="interactive",
+                                    max_queued=max(64, args.requests))})
     rng = np.random.default_rng(0)
     prompts = [list(rng.integers(1, 128, size=5)) for _ in range(3)]
+    shed = 0
     for i in range(args.requests):
-        eng.submit(prompts[i % len(prompts)], max_new_tokens=8)
+        r = gw.submit("demo", {"prompt": prompts[i % len(prompts)],
+                               "max_new": 8})
+        if not r.admitted:
+            shed += 1
     done = []
-    while eng.has_work():
-        done += eng.step()
+    while gw.busy():
+        done += gw.tick()
     print(json.dumps({
         "completions": len(done),
-        "sample_tokens": done[0].tokens if done else [],
+        "shed": shed,
+        "sample_completion": done[0][1] if done else {},
+        "gateway": gw.stats(),
         **eng.stats(),
     }, indent=1))
     return 0
@@ -1003,6 +1135,21 @@ def main(argv=None) -> int:
                     help="run twice; digests must match")
     sp.add_argument("--json", action="store_true")
     sp.set_defaults(fn=cmd_chaos)
+
+    sp = sub.add_parser(
+        "gateway", help="serving front door (docs/GATEWAY.md)")
+    sp.add_argument("action", choices=["demo", "stats"])
+    sp.add_argument("--workload", default="mixed",
+                    help="workload mix (see docs/SIM.md)")
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--backends", type=int, default=3)
+    sp.add_argument("--tenants", type=int, default=4)
+    sp.add_argument("--ticks", type=int, default=400,
+                    help="gateway pump rounds (1 ms of virtual time each)")
+    sp.add_argument("--ledger", default=None,
+                    help="gateway telemetry ledger file (stats action)")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_gateway)
 
     sp = sub.add_parser("demo", help="run the two-tenant sim demo")
     sp.add_argument("--scheduler", default="credit")

@@ -735,6 +735,22 @@ class Controller:
 
     # -- observability ---------------------------------------------------
 
+    def backend_health(self) -> dict[str, dict[str, Any]]:
+        """Routing view for the serving gateway (pbs_tpu.gateway): the
+        controller's last-OBSERVED liveness, breaker state, and load
+        per agent — no RPC here, so the gateway's dispatch loop can
+        consult it every tick. The gateway vetoes backends whose names
+        match agents that are dead or breaker-open, reusing exactly the
+        health state ``place()``/``available_agents()`` rank on."""
+        return {
+            name: {
+                "alive": h.alive,
+                "breaker": h.breaker,
+                "load": int(h.info.get("n_jobs", 0)),
+            }
+            for name, h in self.agents.items()
+        }
+
     def cluster_dump(self) -> dict[str, Any]:
         out: dict[str, Any] = {"agents": {}, "jobs": {}}
         for name, h in self.agents.items():

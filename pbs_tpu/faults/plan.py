@@ -23,6 +23,8 @@ point                 fault kinds                                 seam
 ``agent.op``          crash, slow                                 dist/agent.py
 ``telemetry.counters``  stall, spike                              telemetry/source.py
 ``ckpt.write``        torn, delay                                 ckpt/checkpoint.py
+``gateway.admit``     shed, delay                                 gateway/gateway.py
+``gateway.route``     misroute                                    gateway/gateway.py
 ====================  ==========================================  ==============
 """
 
@@ -41,6 +43,8 @@ POINTS: dict[str, tuple[str, ...]] = {
     "agent.op": ("crash", "slow"),
     "telemetry.counters": ("stall", "spike"),
     "ckpt.write": ("torn", "delay"),
+    "gateway.admit": ("shed", "delay"),
+    "gateway.route": ("misroute",),
 }
 
 
@@ -166,4 +170,20 @@ class FaultPlan:
             FaultSpec("telemetry.counters", "stall", p=0.05),
             FaultSpec("telemetry.counters", "spike", p=0.02,
                       args={"factor": 50.0}),
+        )).validate()
+
+    @classmethod
+    def gateway(cls, seed: int = 0) -> "FaultPlan":
+        """The ``pbst chaos --plan gateway`` plan: front-door seams
+        only — admission sheds capacity that exists, admission stalls
+        charge phantom queue delay, and routing picks the worst live
+        backend instead of the best. Streams are keyed by tenant name
+        (logical, replayable). The invariant under this plan: admitted
+        ⇒ completed-or-requeued, never lost (docs/GATEWAY.md)."""
+        return cls(seed=seed, specs=(
+            FaultSpec("gateway.admit", "shed", p=0.03,
+                      args={"retry_after_ns": 10_000_000}),
+            FaultSpec("gateway.admit", "delay", p=0.05,
+                      args={"delay_ns": 2_000_000}),
+            FaultSpec("gateway.route", "misroute", p=0.10),
         )).validate()

@@ -1,0 +1,64 @@
+"""Multi-tenant serving front door (docs/GATEWAY.md).
+
+SLO-aware admission (token buckets + explicit shed with retry-after),
+weighted deficit-round-robin fair queueing across tenants with
+interactive/batch SLO classes, least-loaded routing with drain/requeue
+on backend loss, and queue-delay feedback into the scheduler — the
+paper's performance-feedback loop applied at the request-queue layer.
+
+Jax-free by construction: backends arrive already built (a
+``ContinuousBatcher`` via :class:`BatcherBackend`, or the simulated
+:class:`SimServeBackend`); the gateway itself imports no accelerator
+stack, so admission/fairness/routing test and run anywhere.
+"""
+
+from pbs_tpu.gateway.admission import (
+    BATCH,
+    INTERACTIVE,
+    SLO_CLASSES,
+    AdmissionController,
+    Shed,
+    TenantQuota,
+    TokenBucket,
+)
+from pbs_tpu.gateway.backends import Backend, BatcherBackend, SimServeBackend
+from pbs_tpu.gateway.fairqueue import DeficitRoundRobin, Request
+from pbs_tpu.gateway.feedback import sched_feedback_sink
+from pbs_tpu.gateway.gateway import (
+    GW_LEDGER_SLOTS,
+    Gateway,
+    SubmitResult,
+)
+
+
+def __getattr__(name: str):
+    # The chaos harness pulls in the sim workload catalog; keep that
+    # import lazy so `pbs_tpu.gateway` stays cheap for serving callers
+    # (the same pattern as pbs_tpu.faults.run_chaos).
+    if name in ("run_gateway_chaos", "quota_for"):
+        from pbs_tpu.gateway import chaos
+
+        return getattr(chaos, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "AdmissionController",
+    "BATCH",
+    "Backend",
+    "BatcherBackend",
+    "DeficitRoundRobin",
+    "GW_LEDGER_SLOTS",
+    "Gateway",
+    "INTERACTIVE",
+    "Request",
+    "SLO_CLASSES",
+    "Shed",
+    "SimServeBackend",
+    "SubmitResult",
+    "TenantQuota",
+    "TokenBucket",
+    "quota_for",
+    "run_gateway_chaos",
+    "sched_feedback_sink",
+]

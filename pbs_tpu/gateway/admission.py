@@ -1,0 +1,160 @@
+"""Admission control: per-tenant token buckets + global backpressure.
+
+The front door's first job is saying *no* early: an overloaded serving
+tier that queues unboundedly converts overload into unbounded tail
+latency for everyone (the serving-tier form of the runqueue the
+reference's BOOST class exists to jump). Admission therefore happens
+at submit time, against three independent gates, and a rejection is an
+explicit :class:`Shed` with a computed ``retry_after_ns`` — clients
+get a backoff hint instead of a silently growing queue:
+
+- **per-tenant token bucket** — ``rate`` cost-units/second with a
+  ``burst`` bucket, so a tenant's sustained throughput is capped while
+  short bursts ride the bucket (the classic shaper);
+- **per-tenant queue depth** — even an in-quota tenant may not park
+  more than ``max_queued`` requests at the gateway (quota describes
+  throughput, not the right to hoard queue slots);
+- **global queue depth** — the gateway-wide bound that keeps the fair
+  queue's memory and latency finite under any tenant mix.
+
+The ``gateway.admit`` fault point lives here (docs/FAULTS.md): ``shed``
+forces a rejection (capacity lies), ``delay`` charges phantom queue
+delay to an admitted request (a stalled admission path) — both keyed by
+tenant name, so chaos streams are logical and replayable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from pbs_tpu.utils.clock import MS, SEC
+
+#: SLO classes the fair queue schedules between (docs/GATEWAY.md).
+INTERACTIVE = "interactive"
+BATCH = "batch"
+SLO_CLASSES = (INTERACTIVE, BATCH)
+
+
+@dataclasses.dataclass
+class TenantQuota:
+    """One tenant's admission contract."""
+
+    rate: float = 100.0  # sustained cost-units per second
+    burst: float = 50.0  # bucket capacity (peak debt)
+    weight: int = 256  # fair-queue share (SchedParams.weight scale)
+    slo: str = BATCH  # SLO class: "interactive" | "batch"
+    max_queued: int = 64  # per-tenant gateway queue-slot bound
+
+    def __post_init__(self) -> None:
+        if self.slo not in SLO_CLASSES:
+            raise ValueError(
+                f"unknown SLO class {self.slo!r}; known: {SLO_CLASSES}")
+        if self.rate <= 0 or self.burst <= 0:
+            raise ValueError("rate and burst must be > 0")
+
+
+class TokenBucket:
+    """Deterministic token bucket in integer-ns time, float tokens."""
+
+    def __init__(self, rate: float, burst: float, now_ns: int = 0):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.level = float(burst)
+        self._last_ns = int(now_ns)
+
+    def _refill(self, now_ns: int) -> None:
+        dt_ns = max(0, int(now_ns) - self._last_ns)
+        self._last_ns = max(self._last_ns, int(now_ns))
+        self.level = min(self.burst, self.level + self.rate * dt_ns / SEC)
+
+    def take(self, cost: float, now_ns: int) -> bool:
+        self._refill(now_ns)
+        if self.level >= cost:
+            self.level -= cost
+            return True
+        return False
+
+    def retry_after_ns(self, cost: float, now_ns: int) -> int:
+        """When the bucket could cover ``cost`` (refill horizon); the
+        shed hint clients back off on. 0 means "already affordable"."""
+        self._refill(now_ns)
+        deficit = min(cost, self.burst) - self.level
+        if deficit <= 0:
+            return 0
+        return int(deficit / self.rate * SEC) + 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Shed:
+    """An explicit rejection: why, and when to come back."""
+
+    reason: str  # "quota" | "tenant-queue-full" | "queue-full" |
+    # "cost-over-burst" | "unknown-tenant" | "injected-shed"
+    retry_after_ns: int
+
+
+class AdmissionController:
+    """The three admission gates, consulted in deterministic order.
+
+    Gate order matters for accounting: the global bound is checked
+    before the tenant bucket so a full gateway never *charges* the
+    tenant's bucket for a request it cannot take anyway.
+    """
+
+    def __init__(self, max_queued_total: int = 256,
+                 default_quota: TenantQuota | None = None):
+        self.max_queued_total = int(max_queued_total)
+        #: Quota applied to tenants never registered explicitly; None =
+        #: unknown tenants are shed outright (closed-world gateways).
+        self.default_quota = default_quota
+        self.quotas: dict[str, TenantQuota] = {}
+        self._buckets: dict[str, TokenBucket] = {}
+        self.sheds: dict[str, int] = {}  # reason -> count
+
+    def register(self, tenant: str, quota: TenantQuota,
+                 now_ns: int = 0) -> None:
+        self.quotas[tenant] = quota
+        self._buckets[tenant] = TokenBucket(quota.rate, quota.burst, now_ns)
+
+    def quota_of(self, tenant: str) -> TenantQuota | None:
+        q = self.quotas.get(tenant)
+        if q is None and self.default_quota is not None:
+            return self.default_quota
+        return q
+
+    def record_shed(self, reason: str, retry_after_ns: int) -> Shed:
+        """Account a shed decided elsewhere (e.g. an injected
+        ``gateway.admit``/``shed`` fault) in the same books."""
+        return self._shed(reason, retry_after_ns)
+
+    def _shed(self, reason: str, retry_after_ns: int) -> Shed:
+        self.sheds[reason] = self.sheds.get(reason, 0) + 1
+        return Shed(reason, max(1, int(retry_after_ns)))
+
+    def admit(self, tenant: str, cost: float, now_ns: int,
+              tenant_queued: int, total_queued: int) -> Shed | None:
+        """None = admitted. ``tenant_queued``/``total_queued`` are the
+        fair queue's current depths (the gateway passes them in; the
+        controller owns no queue state of its own)."""
+        quota = self.quota_of(tenant)
+        if quota is None:
+            # No contract at all: permanent condition, long retry-after.
+            return self._shed("unknown-tenant", SEC)
+        if total_queued >= self.max_queued_total:
+            # Global backpressure: retry when a slot plausibly drains.
+            return self._shed("queue-full", 50 * MS)
+        if tenant_queued >= quota.max_queued:
+            return self._shed("tenant-queue-full", 50 * MS)
+        if cost > quota.burst:
+            # The bucket can NEVER cover this request (level <= burst):
+            # shedding with a finite bucket-refill hint would send a
+            # contract-following client into a retry livelock. Permanent
+            # condition, long retry-after — like unknown-tenant.
+            return self._shed("cost-over-burst", SEC)
+        bucket = self._buckets.get(tenant)
+        if bucket is None:  # default-quota tenant: lazily materialize
+            bucket = self._buckets[tenant] = TokenBucket(
+                quota.rate, quota.burst, now_ns)
+        if not bucket.take(cost, now_ns):
+            return self._shed("quota", bucket.retry_after_ns(cost, now_ns))
+        return None

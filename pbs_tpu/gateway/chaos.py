@@ -1,0 +1,164 @@
+"""Gateway chaos harness: the front door under a seeded FaultPlan.
+
+The ``pbst chaos --plan gateway`` engine — the gateway's twin of
+``faults.chaos.run_chaos`` (which attacks the cluster control plane).
+Here the attack surface is the front door itself: injected admission
+sheds, stalled admissions, and misroutes, plus a deterministic backend
+kill mid-run. Everything runs on a :class:`VirtualClock` with seeded
+arrivals, so the run — and therefore the fault-trace digest — is a
+pure function of ``(workload, seed, plan, shape)``.
+
+The invariant this harness exists to gate (docs/GATEWAY.md):
+
+- **no admitted request lost** — at every point, ``admitted ==
+  completed + queued + inflight``; after the drain phase with a live
+  backend remaining, ``admitted == completed`` exactly. Sheds are only
+  ever explicit (retry-after attached) and only at admission.
+- **determinism** — same seed ⇒ same digest AND same shed/requeue
+  counts (``pbst chaos --plan gateway --selfcheck``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from pbs_tpu.faults import injector as faults_mod
+from pbs_tpu.faults.plan import FaultPlan
+from pbs_tpu.gateway.admission import INTERACTIVE, TenantQuota
+from pbs_tpu.gateway.backends import SimServeBackend
+from pbs_tpu.gateway.gateway import Gateway
+from pbs_tpu.sim.workload import build_workload
+from pbs_tpu.utils.clock import MS, VirtualClock
+
+
+def quota_for(tenant_name: str, slo: str, weight: int) -> TenantQuota:
+    """Admission contract derived from a workload-catalog tenant:
+    interactive tenants get high rate / small burst (latency traffic),
+    batch tenants lower rate / big burst (throughput traffic)."""
+    if slo == INTERACTIVE:
+        return TenantQuota(rate=600.0, burst=60.0, weight=weight,
+                           slo=slo, max_queued=64)
+    return TenantQuota(rate=300.0, burst=120.0, weight=weight,
+                       slo=slo, max_queued=128)
+
+
+def run_gateway_chaos(workload: str = "mixed", seed: int = 0,
+                      n_backends: int = 3, n_tenants: int = 4,
+                      ticks: int = 400, tick_ns: int = 1 * MS,
+                      plan: FaultPlan | None = None,
+                      trace_path: str | None = None,
+                      ledger_path: str | None = None,
+                      kill_backend: bool = True) -> dict:
+    """One seeded gateway chaos scenario; returns the report dict
+    (``ok`` = every invariant held). Installs the plan process-wide for
+    the duration — callers must not have their own plan armed."""
+    plan = plan if plan is not None else FaultPlan.gateway(seed)
+    inj = faults_mod.install(plan, trace_path=trace_path)
+    problems: list[str] = []
+    try:
+        clock = VirtualClock()
+        # Service time of one cost unit = one tick: batch requests
+        # (cost 4-12) occupy a slot for many ticks, so queues form,
+        # fairness matters, and the mid-run kill reliably catches
+        # in-flight work (the drain/requeue path under test).
+        backends = [
+            SimServeBackend(f"b{i}", n_slots=2,
+                            service_ns_per_cost=tick_ns,
+                            seed=seed + i)
+            for i in range(max(1, int(n_backends)))
+        ]
+        tenants = build_workload(workload, seed=seed, n_tenants=n_tenants)
+        gw = Gateway(backends, clock=clock, max_queued=64 * len(tenants),
+                     trace_capacity=8192, ledger_path=ledger_path)
+        arrivals = {}
+        for i, t in enumerate(tenants):
+            gw.register_tenant(
+                t.name, quota_for(t.name, t.slo, t.params.weight))
+            arrivals[t.name] = np.random.default_rng([int(seed), 7, i])
+
+        kill_at = ticks // 3 if kill_backend and len(backends) > 1 else -1
+        shed_results = 0
+        completions: list[tuple[str, dict]] = []
+        seen_rids: set[str] = set()
+
+        def _check_books(where: str) -> None:
+            acct = gw.completed + gw.queue.depth() + len(gw.inflight)
+            if gw.admitted != acct:
+                problems.append(
+                    f"{where}: admitted {gw.admitted} != completed "
+                    f"{gw.completed} + queued {gw.queue.depth()} + "
+                    f"inflight {len(gw.inflight)}")
+
+        for tick in range(int(ticks)):
+            if tick == kill_at:
+                backends[0].fail()
+            for t in tenants:
+                rng = arrivals[t.name]
+                u = float(rng.random())
+                if t.slo == INTERACTIVE:
+                    fire, cost = u < 0.35, 1 + int(rng.integers(0, 3))
+                else:
+                    fire, cost = u < 0.15, 4 + int(rng.integers(0, 9))
+                if not fire:
+                    continue
+                r = gw.submit(t.name, {"tick": tick}, cost=cost)
+                if not r.admitted:
+                    shed_results += 1
+                    if r.retry_after_ns <= 0:
+                        problems.append(
+                            f"shed of {t.name} at tick {tick} carries "
+                            f"no retry-after ({r.reason})")
+            completions.extend(gw.tick())
+            if tick % 50 == 0:
+                _check_books(f"tick {tick}")
+            clock.advance(tick_ns)
+
+        # Drain: no new arrivals; pump until idle (bounded).
+        for _ in range(int(ticks) * 4):
+            if not gw.busy():
+                break
+            completions.extend(gw.tick())
+            clock.advance(tick_ns)
+
+        _check_books("end")
+        if gw.busy():
+            problems.append(
+                f"drain did not converge: queued {gw.queue.depth()}, "
+                f"inflight {len(gw.inflight)}")
+        elif gw.admitted != gw.completed:
+            problems.append(
+                f"admitted requests lost: admitted {gw.admitted}, "
+                f"completed {gw.completed}")
+        for rid, _ in completions:
+            if rid in seen_rids:
+                problems.append(f"request {rid} completed twice")
+            seen_rids.add(rid)
+        st = gw.stats()
+        shed_books = sum(st["shed"].values())
+        if shed_results != shed_books:
+            problems.append(
+                f"shed accounting drift: {shed_results} shed results, "
+                f"{shed_books} in the admission books")
+    finally:
+        faults_mod.uninstall()
+
+    fault_counts: dict[str, int] = {}
+    for rec in inj.records:
+        k = f"{rec['point']}:{rec['fault']}"
+        fault_counts[k] = fault_counts.get(k, 0) + 1
+    if trace_path is not None:
+        inj.write_trace()
+    report: dict[str, Any] = {
+        "workload": workload, "seed": seed, "backends": n_backends,
+        "tenants": n_tenants, "ticks": ticks,
+        "plan": plan.as_dict(),
+        "killed_backend": backends[0].name if kill_at >= 0 else None,
+        "stats": st,
+        "faults_fired": dict(sorted(fault_counts.items())),
+        "trace_digest": inj.trace_digest(),
+        "problems": problems,
+        "ok": not problems,
+    }
+    return report

@@ -1,0 +1,35 @@
+"""Gateway → scheduler feedback bridge (the loop, one layer up).
+
+The paper's loop: the guest reports spin latency through the vcrd_op
+channel; the scheduler adapts the quantum. The serving tier's analog
+signal is interactive queue delay at the front door, and this bridge
+is the channel: the gateway's periodic feedback export calls the sink
+with the interval's accumulated (wait_ns, events), and the sink feeds
+them into :meth:`~pbs_tpu.sched.feedback.FeedbackPolicy
+.note_queue_delay` against the serving job — which rides the SAME
+submilli contention window as spin latency (``Job.report_contention``)
+and, when the pressure is sustained, applies the BOOST/tslice-shrink
+response immediately.
+
+Jax-free and import-light: the sink closes over objects the caller
+already has (a policy and a job); nothing here touches the engine.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from pbs_tpu.gateway.admission import INTERACTIVE
+
+
+def sched_feedback_sink(policy, job,
+                        cls: str = INTERACTIVE) -> Callable[[str, int, int], None]:
+    """A ``Gateway(feedback_sink=...)`` callable reporting class
+    ``cls``'s queue delay into ``policy`` against ``job`` (the serving
+    job whose quantum protects that traffic)."""
+
+    def sink(slo_class: str, wait_ns: int, events: int) -> None:
+        if slo_class == cls and events > 0:
+            policy.note_queue_delay(job, wait_ns, events)
+
+    return sink

@@ -1,0 +1,458 @@
+"""The multi-tenant serving front door (``pbs_tpu.gateway``).
+
+PBS-T's loop is guest-reported contention latency steering the
+scheduler's quantum. One layer up, the serving-tier analog of spin
+latency is *request queue delay*: time an admitted request waits at
+the gateway before a backend takes it. This module closes the same
+loop at that layer — requests flow
+
+    submit → admission (token bucket, backpressure, explicit shed)
+           → fair queue (weighted DRR across tenants, SLO classes)
+           → routing   (least-loaded live backend; breaker-aware via
+                        an attached Controller's health view)
+           → completion (latency accounting, telemetry ledger, GW_*
+                        trace events)
+
+and sustained interactive queue delay feeds ``sched/feedback.py`` as a
+BOOST/tslice-shrink signal (the vcrd_op analog) through a pluggable
+``feedback_sink``. The invariant the chaos harness gates on: once
+admitted, a request is COMPLETED or REQUEUED — backend loss drains its
+uncompleted requests back to the front of the fair queue; nothing is
+ever silently dropped (sheds are explicit, with retry-after, and only
+happen at admission).
+
+Single-threaded by construction: callers own the pump (``tick``); all
+state mutation happens on the caller's thread, so the whole gateway is
+lock-free the honest way — there is nothing to lock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+from collections import deque
+from typing import Any, Callable
+
+from pbs_tpu.faults import injector as _faults
+from pbs_tpu.gateway.admission import (
+    INTERACTIVE,
+    SLO_CLASSES,
+    AdmissionController,
+    Shed,
+    TenantQuota,
+)
+from pbs_tpu.gateway.backends import Backend
+from pbs_tpu.gateway.fairqueue import DeficitRoundRobin, Request
+from pbs_tpu.obs.trace import Ev, TraceBuffer
+from pbs_tpu.telemetry.counters import Counter
+from pbs_tpu.utils.clock import MS, MonotonicClock
+from pbs_tpu.utils.stats import nearest_rank
+
+#: Ledger counter reuse for the per-class gateway slots (the ledger
+#: layout is the fixed 18-counter page; the gateway maps its stats onto
+#: the semantically closest counters — documented in docs/GATEWAY.md):
+#:   RUNQ_WAIT_NS   cumulative queue delay of dispatched requests
+#:   DEVICE_TIME_NS cumulative backend service time
+#:   STEPS_RETIRED  requests completed
+#:   SCHED_COUNT    dispatches (>= completions; includes re-dispatches)
+#:   YIELDS         requeues after backend loss
+#:   COMPILES       sheds (explicit rejections)
+#:   TOKENS         cost units completed
+GW_LEDGER_SLOTS = {cls: i for i, cls in enumerate(SLO_CLASSES)}
+
+#: Shed reasons -> stable small ints for trace args.
+SHED_REASON_CODES = {
+    "quota": 1, "tenant-queue-full": 2, "queue-full": 3,
+    "unknown-tenant": 4, "injected-shed": 5, "cost-over-burst": 6,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class SubmitResult:
+    admitted: bool
+    rid: str | None = None
+    reason: str = ""
+    retry_after_ns: int = 0
+
+
+class Gateway:
+    """The front door. See module docstring for the pipeline."""
+
+    def __init__(
+        self,
+        backends: list[Backend],
+        quotas: dict[str, TenantQuota] | None = None,
+        clock=None,
+        max_inflight: int | None = None,
+        max_queued: int = 256,
+        default_quota: TenantQuota | None = None,
+        controller=None,
+        trace_capacity: int = 0,
+        ledger_path: str | None = None,
+        feedback_sink: Callable[[str, int, int], None] | None = None,
+        feedback_period_ns: int = 10 * MS,
+        drr_quantum: int = 16,
+    ):
+        if not backends:
+            raise ValueError("gateway needs at least one backend")
+        names = [b.name for b in backends]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate backend names: {names}")
+        self.backends = list(backends)
+        self.clock = clock or MonotonicClock()
+        now = self.clock.now_ns()
+        self.admission = AdmissionController(
+            max_queued_total=max_queued, default_quota=default_quota)
+        self.queue = DeficitRoundRobin(quantum=drr_quantum)
+        for tenant, q in (quotas or {}).items():
+            self.register_tenant(tenant, q, now_ns=now)
+        #: Global concurrency bound across backends; default: the sum
+        #: of backend capacities (each backend also bounds itself).
+        self.max_inflight = (int(max_inflight) if max_inflight is not None
+                             else sum(b.capacity for b in self.backends))
+        #: Controller whose breaker/liveness view vetoes routing
+        #: targets whose names match cluster agents (dist/controller).
+        self.controller = controller
+        self.trace = (TraceBuffer(trace_capacity)
+                      if trace_capacity else None)
+        self._ledger = None
+        self._ledger_path = ledger_path
+        if ledger_path is not None:
+            from pbs_tpu.telemetry.ledger import Ledger
+
+            self._ledger = Ledger.file_backed(
+                ledger_path, num_slots=len(SLO_CLASSES))
+            # file_backed attaches to an existing file as-is; a fresh
+            # gateway must not accumulate onto a previous run's counts.
+            for slot in GW_LEDGER_SLOTS.values():
+                self._ledger.reset(slot)
+            self._write_ledger_meta()
+        self.feedback_sink = feedback_sink
+        self.feedback_period_ns = int(feedback_period_ns)
+        self._last_feedback_ns = now
+        # Feedback accumulators since the last feedback tick.
+        self._fb_delay_ns = {cls: 0 for cls in SLO_CLASSES}
+        self._fb_events = {cls: 0 for cls in SLO_CLASSES}
+        # Bookkeeping.
+        self._rids = itertools.count()
+        self._tenant_slot: dict[str, int] = {}  # stable ints for trace
+        self.inflight: dict[str, Request] = {}
+        self.admitted = 0
+        self.completed = 0
+        self.requeued = 0
+        self.dispatched = 0
+        self._delays = {cls: deque(maxlen=1024) for cls in SLO_CLASSES}
+        self._latencies = {cls: deque(maxlen=1024) for cls in SLO_CLASSES}
+        self.completions: deque = deque(maxlen=4096)  # (rid, info)
+
+    # -- tenants ---------------------------------------------------------
+
+    def register_tenant(self, tenant: str, quota: TenantQuota,
+                        now_ns: int | None = None) -> None:
+        self.admission.register(
+            tenant, quota,
+            now_ns=self.clock.now_ns() if now_ns is None else now_ns)
+        self.queue.set_weight(tenant, quota.weight)
+
+    def _slot_of(self, tenant: str) -> int:
+        slot = self._tenant_slot.get(tenant)
+        if slot is None:
+            slot = self._tenant_slot[tenant] = len(self._tenant_slot)
+        return slot
+
+    # -- intake ----------------------------------------------------------
+
+    def submit(self, tenant: str, payload: Any, cost: int = 1,
+               slo: str | None = None) -> SubmitResult:
+        """Admit or shed. ``slo`` defaults to the tenant quota's class."""
+        now = self.clock.now_ns()
+        cost = max(1, int(cost))
+        quota = self.admission.quota_of(tenant)
+        cls = slo or (quota.slo if quota is not None else "batch")
+        if cls not in SLO_CLASSES:
+            # Before the fault consult and before any accounting: a bad
+            # override must not burn a fault-stream draw, charge a shed,
+            # or crash deep in the fair queue with a bare KeyError.
+            raise ValueError(
+                f"unknown SLO class {cls!r}; known: {SLO_CLASSES}")
+        penalty_ns = 0
+        f = _faults.consult("gateway.admit", tenant)
+        if f is not None:
+            if f.fault == "shed":
+                shed = self.admission.record_shed(
+                    "injected-shed",
+                    int(f.args.get("retry_after_ns", 10 * MS)))
+                self._emit_shed(now, tenant, cls, shed)
+                return SubmitResult(False, None, shed.reason,
+                                    shed.retry_after_ns)
+            if f.fault == "delay":
+                penalty_ns = int(f.args.get("delay_ns", 1 * MS))
+        shed = self.admission.admit(
+            tenant, cost, now,
+            # The tenant's slots across BOTH classes: max_queued bounds
+            # what a tenant parks at the gateway, and a per-request slo
+            # override must not open a second, separately-bounded queue.
+            tenant_queued=sum(self.queue.depth(c, tenant)
+                              for c in SLO_CLASSES),
+            total_queued=self.queue.depth())
+        if shed is not None:
+            self._emit_shed(now, tenant, cls, shed)
+            return SubmitResult(False, None, shed.reason,
+                                shed.retry_after_ns)
+        rid = f"gw-{next(self._rids)}"
+        req = Request(rid=rid, tenant=tenant, slo=cls, cost=cost,
+                      payload=payload, submit_ns=now,
+                      penalty_ns=penalty_ns)
+        self.queue.push(req)
+        self.admitted += 1
+        self._emit(now, Ev.GW_ADMIT, self._slot_of(tenant),
+                   self._cls_code(cls), cost, self.queue.depth())
+        return SubmitResult(True, rid)
+
+    # -- the pump --------------------------------------------------------
+
+    def tick(self) -> list[tuple[str, dict]]:
+        """One gateway round: reap completions, repair backend loss,
+        dispatch from the fair queue, export feedback. Returns this
+        tick's completions as (rid, info) pairs."""
+        now = self.clock.now_ns()
+        done = self._reap(now)
+        self._repair(now)
+        self._dispatch(now)
+        self._feedback(now)
+        return done
+
+    def busy(self) -> bool:
+        return bool(self.queue.depth() or self.inflight)
+
+    # poll completions from every live backend
+    def _reap(self, now: int) -> list[tuple[str, dict]]:
+        out: list[tuple[str, dict]] = []
+        for b in self.backends:
+            if not b.alive():
+                continue
+            for req, info in b.poll(now):
+                self.inflight.pop(req.rid, None)
+                self.completed += 1
+                cls = req.slo
+                lat = now - req.submit_ns + req.penalty_ns
+                self._latencies[cls].append(lat)
+                info = {**info, "tenant": req.tenant, "slo": cls,
+                        "latency_ns": lat,
+                        "queue_delay_ns": req.queue_delay_ns}
+                out.append((req.rid, info))
+                self.completions.append((req.rid, info))
+                self._ledger_add(cls, Counter.STEPS_RETIRED, 1)
+                self._ledger_add(cls, Counter.TOKENS, req.cost)
+                self._ledger_add(cls, Counter.DEVICE_TIME_NS,
+                                 int(info.get("service_ns", 0)))
+                self._emit(now, Ev.GW_COMPLETE, self._slot_of(req.tenant),
+                           self._cls_code(cls),
+                           self._backend_slot(req.backend),
+                           int(info.get("service_ns", 0)))
+        return out
+
+    # backend loss: drain + requeue, never drop
+    def _repair(self, now: int) -> None:
+        for b in self.backends:
+            if b.alive():
+                continue
+            casualties = list(b.drain())
+            # Inflight requests mapped to the dead backend that drain()
+            # could not return (already consumed) are requeued from the
+            # gateway's own inflight table — the authoritative record.
+            drained = {r.rid for r in casualties}
+            for rid, req in list(self.inflight.items()):
+                if req.backend == b.name and rid not in drained:
+                    casualties.append(req)
+            # Reversed so sequential requeue_front/appendleft leaves
+            # the FIFO oldest-first: the longest-waiting casualty must
+            # re-dispatch first, not last.
+            for req in reversed(casualties):
+                self.inflight.pop(req.rid, None)
+                req.backend = None
+                req.requeues += 1
+                self.requeued += 1
+                self.queue.requeue_front(req)
+                self._ledger_add(req.slo, Counter.YIELDS, 1)
+                self._emit(now, Ev.GW_REQUEUE, self._slot_of(req.tenant),
+                           self._cls_code(req.slo),
+                           self._backend_slot(b.name))
+
+    def _eligible(self, health: dict | None = None) -> list[Backend]:
+        """Live backends, controller-health vetted (breaker-open or
+        dead agents of the same name never take dispatches), ranked
+        least-loaded first, name-tiebroken for determinism. ``health``
+        lets the dispatch loop snapshot the controller view once per
+        tick instead of rebuilding it per request."""
+        if health is None:
+            health = (self.controller.backend_health()
+                      if self.controller is not None else {})
+        out = []
+        for b in self.backends:
+            if not b.alive():
+                continue
+            h = health.get(b.name)
+            if h is not None and (not h["alive"] or h["breaker"] == "open"):
+                continue
+            out.append(b)
+        return sorted(out, key=lambda b: (b.depth(), b.name))
+
+    def _dispatch(self, now: int) -> None:
+        health = (self.controller.backend_health()
+                  if self.controller is not None else {})
+        while len(self.inflight) < self.max_inflight:
+            eligible = self._eligible(health)
+            ranked = [b for b in eligible if b.depth() < b.capacity]
+            if not ranked:
+                return
+            req = self.queue.pop()
+            if req is None:
+                return
+            target = ranked[0]
+            f = _faults.consult("gateway.route", req.tenant)
+            if f is not None and f.fault == "misroute":
+                # Wrong placement, still a LIVE placement: the worst
+                # eligible backend, capacity bound waived — latency
+                # degrades, the request is never lost.
+                target = eligible[-1]
+            req.backend = target.name
+            req.dispatch_ns = now
+            req.queue_delay_ns = now - req.submit_ns + req.penalty_ns
+            self._delays[req.slo].append(req.queue_delay_ns)
+            # Settle the feedback watermark: only the wait not already
+            # exported by the stuck-queue sentinel (or a previous
+            # dispatch, for requeued casualties) enters the channel, so
+            # each ns of delay reaches the scheduler exactly once.
+            self._fb_delay_ns[req.slo] += max(
+                0, req.queue_delay_ns - req.reported_wait_ns)
+            req.reported_wait_ns = max(req.reported_wait_ns,
+                                       req.queue_delay_ns)
+            self._fb_events[req.slo] += 1
+            self.inflight[req.rid] = req
+            self.dispatched += 1
+            target.dispatch_request(req, now)
+            self._ledger_add(req.slo, Counter.SCHED_COUNT, 1)
+            self._ledger_add(req.slo, Counter.RUNQ_WAIT_NS,
+                             req.queue_delay_ns)
+            self._emit(now, Ev.GW_DISPATCH, self._slot_of(req.tenant),
+                       self._cls_code(req.slo),
+                       self._backend_slot(target.name),
+                       req.queue_delay_ns)
+
+    # -- feedback export (the serving-tier vcrd_op analog) ---------------
+
+    def _feedback(self, now: int) -> None:
+        if now - self._last_feedback_ns < self.feedback_period_ns:
+            return
+        self._last_feedback_ns = now
+        shed_total = sum(self.admission.sheds.values())
+        denom = self.admitted + shed_total
+        shed_ppm = int(1_000_000 * shed_total / denom) if denom else 0
+        for cls in SLO_CLASSES:
+            delays = self._delays[cls]
+            self._emit(now, Ev.GW_QDELAY, self._cls_code(cls),
+                       int(nearest_rank(delays, 0.50)),
+                       int(nearest_rank(delays, 0.99)), shed_ppm)
+        if self.feedback_sink is not None:
+            wait_ns = self._fb_delay_ns[INTERACTIVE]
+            events = self._fb_events[INTERACTIVE]
+            # Sustained pressure also counts queued-but-undispatched
+            # age: a stuck queue must not read as "no delay samples".
+            # Incremental against the request's watermark — the age
+            # already exported last period (and later settled at
+            # dispatch) is never counted twice.
+            req = self.queue.oldest(INTERACTIVE)
+            if req is not None:
+                age = now - req.submit_ns + req.penalty_ns
+                inc = age - req.reported_wait_ns
+                if inc > 0:
+                    req.reported_wait_ns = age
+                    wait_ns += inc
+                    events += 1
+            if events:
+                self.feedback_sink(INTERACTIVE, int(wait_ns), int(events))
+        self._fb_delay_ns = {cls: 0 for cls in SLO_CLASSES}
+        self._fb_events = {cls: 0 for cls in SLO_CLASSES}
+
+    # -- telemetry plumbing ----------------------------------------------
+
+    @staticmethod
+    def _cls_code(cls: str) -> int:
+        return SLO_CLASSES.index(cls)
+
+    def _backend_slot(self, name: str | None) -> int:
+        for i, b in enumerate(self.backends):
+            if b.name == name:
+                return i
+        return len(self.backends)  # unknown/None sentinel
+
+    def _emit(self, now: int, ev: int, *args: int) -> None:
+        if self.trace is not None:
+            self.trace.emit(now, ev, *args)
+
+    def _emit_shed(self, now: int, tenant: str, cls: str,
+                   shed: Shed) -> None:
+        self._ledger_add(cls, Counter.COMPILES, 1)
+        self._emit(now, Ev.GW_SHED, self._slot_of(tenant),
+                   self._cls_code(cls),
+                   SHED_REASON_CODES.get(shed.reason, 0),
+                   shed.retry_after_ns)
+
+    def _ledger_add(self, cls: str, counter: int, delta: int) -> None:
+        if self._ledger is not None and delta:
+            self._ledger.add(GW_LEDGER_SLOTS[cls], int(counter), int(delta))
+
+    def _write_ledger_meta(self) -> None:
+        """Sidecar so ``pbst dump/top --ledger`` render the gateway
+        slots like any partition's (one row per SLO class)."""
+        meta = {
+            "partition": "gateway",
+            "scheduler": "drr",
+            "slots": {
+                str(slot): {"ctx": f"gw/{cls}", "job": f"gw/{cls}",
+                            "weight": "", "cap": "", "tslice_us": ""}
+                for cls, slot in GW_LEDGER_SLOTS.items()
+            },
+        }
+        tmp = self._ledger_path + ".meta.json.tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f, indent=1)
+        os.replace(tmp, self._ledger_path + ".meta.json")
+
+    # -- observability ---------------------------------------------------
+
+    def stats(self) -> dict:
+        per_class = {}
+        for cls in SLO_CLASSES:
+            d, lt = self._delays[cls], self._latencies[cls]
+            per_class[cls] = {
+                "queued": self.queue.depth(cls),
+                "qdelay_p50_ns": int(nearest_rank(d, 0.50)),
+                "qdelay_p99_ns": int(nearest_rank(d, 0.99)),
+                "latency_p50_ns": int(nearest_rank(lt, 0.50)),
+                "latency_p99_ns": int(nearest_rank(lt, 0.99)),
+            }
+        shed_total = sum(self.admission.sheds.values())
+        denom = self.admitted + shed_total
+        bypass = sum(getattr(b, "bypass_submits", 0)
+                     for b in self.backends)
+        return {
+            "admitted": self.admitted,
+            "completed": self.completed,
+            "dispatched": self.dispatched,
+            "requeued": self.requeued,
+            "inflight": len(self.inflight),
+            "queued": self.queue.depth(),
+            "shed": dict(sorted(self.admission.sheds.items())),
+            "shed_rate": round(shed_total / denom, 6) if denom else 0.0,
+            "bypass_submits": bypass,
+            "classes": per_class,
+            "backends": {
+                b.name: {"alive": b.alive(), "depth": b.depth(),
+                         "capacity": b.capacity}
+                for b in self.backends
+            },
+        }

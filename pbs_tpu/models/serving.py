@@ -241,8 +241,14 @@ class ContinuousBatcher:
                  max_len: int | None = None, temperature: float = 0.0,
                  eos_id: int | None = None, seed: int = 0,
                  mesh=None, prefix_cache_size: int = 0,
-                 clock=None, mlp_fn=None):
+                 clock=None, mlp_fn=None, submit_hook=None):
         self.cfg = cfg
+        # Front-door seam (pbs_tpu.gateway): called as
+        # ``submit_hook(rid, prompt_len, max_new)`` on EVERY accepted
+        # submit — through the gateway or around it — so a gateway-
+        # managed engine can count admission bypasses (the runtime twin
+        # of the ``gateway-discipline`` static pass, docs/GATEWAY.md).
+        self.submit_hook = submit_hook
         # Latency-stat clock: seconds, monotonic. Injectable so TTFT /
         # completion latencies can be accounted in virtual time —
         # deterministic SLO tests and replayable traces (the xentop
@@ -419,6 +425,8 @@ class ContinuousBatcher:
         self.queue.append((rid, prompt, int(max_new_tokens)))
         self._submitted_step[rid] = self.steps
         self._submitted_t[rid] = self._now()
+        if self.submit_hook is not None:
+            self.submit_hook(rid, len(prompt), int(max_new_tokens))
         return rid
 
     # -- the engine tick --------------------------------------------------
@@ -561,10 +569,12 @@ class ContinuousBatcher:
 
     @staticmethod
     def _pct(values, q: float) -> float:
-        if not values:
-            return 0.0
-        v = sorted(values)
-        return v[min(len(v) - 1, int(q * len(v)))]
+        # Nearest-rank (utils.stats): the old int(q*n) indexed one rank
+        # high — p50 of two samples returned the max, inflating every
+        # reported percentile by up to one rank.
+        from pbs_tpu.utils.stats import nearest_rank
+
+        return nearest_rank(values, q)
 
     def stats(self) -> dict:
         """Engine + SLO surface: time-to-first-token and completion

@@ -57,6 +57,15 @@ class Ev(enum.IntEnum):
     CKPT_END = 0x0402  # args: job_slot, bytes, dur_ns
     # contention channel (0x05xx) — the vcrd_op analog
     CONTENTION = 0x0501  # args: job_slot, wait_ns, events
+    # serving gateway (0x06xx) — the front-door class (docs/GATEWAY.md);
+    # tenant_slot is the gateway's stable per-tenant index, cls is the
+    # SLO-class index (0=interactive, 1=batch)
+    GW_ADMIT = 0x0601  # args: tenant_slot, cls, cost, queue_depth
+    GW_SHED = 0x0602  # args: tenant_slot, cls, reason_code, retry_after_ns
+    GW_DISPATCH = 0x0603  # args: tenant_slot, cls, backend_slot, qdelay_ns
+    GW_COMPLETE = 0x0604  # args: tenant_slot, cls, backend_slot, service_ns
+    GW_REQUEUE = 0x0605  # args: tenant_slot, cls, backend_slot
+    GW_QDELAY = 0x0606  # args: cls, p50_ns, p99_ns, shed_ppm
 
 
 class TraceBuffer:

@@ -63,6 +63,15 @@ SHRINK_SUB_US = 200
 LOW_PHASE = "low"  # SPIN_LOW_PHASE: grow
 HIGH_PHASE = "high"  # SPIN_HIGH_PHASE: shrink
 
+# Gateway queue-delay feedback (docs/GATEWAY.md): an interactive
+# request waiting longer than this per event at the front door means
+# the serving tier is falling behind its SLO class.
+GW_QDELAY_THRESHOLD_NS = 2 * MS
+# Consecutive over-threshold reports before the policy reacts —
+# sustained pressure, not one burst (the window-stability idea applied
+# to the serving-tier signal).
+GW_HOT_AFTER = 3
+
 
 @dataclasses.dataclass
 class JobMetricState:
@@ -82,6 +91,12 @@ class JobMetricState:
     # steering and parks the slice on the default band value.
     stale_ticks: int = 0
     fallbacks: int = 0
+    # Gateway queue-delay channel (the serving-tier vcrd_op analog):
+    # consecutive over-threshold reports, and how often the sustained
+    # condition fired the BOOST/shrink response.
+    gw_reports: int = 0
+    gw_hot: int = 0
+    gw_boosts: int = 0
 
 
 class FeedbackPolicy:
@@ -179,6 +194,38 @@ class FeedbackPolicy:
         if rec is not None:
             rec.on_feedback(self.partition.clock.now_ns(), job, st)
 
+    # -- gateway queue-delay channel (docs/GATEWAY.md) -------------------
+
+    def note_queue_delay(self, job: "Job", wait_ns: int,
+                         events: int = 1,
+                         threshold_ns: int = GW_QDELAY_THRESHOLD_NS,
+                         hot_after: int = GW_HOT_AFTER) -> None:
+        """Serving-tier contention report from the gateway front door:
+        ``wait_ns`` of interactive queue delay over ``events`` requests
+        since the last report.
+
+        Two effects, mirroring how spin latency reaches the policy:
+        the raw wait rides the job's contention channel (the SAME
+        submilli window ``report_contention`` feeds, so queue delay
+        participates in phase detection like any other contention),
+        and ``hot_after`` CONSECUTIVE over-threshold reports trigger
+        the immediate response — shrink the slice (bound co-tenant
+        latency now, not a window later) and arm wake-boost — the
+        BOOST/tslice-shrink signal the gateway's SLO classes lean on.
+        """
+        job.report_contention(int(wait_ns), int(events))
+        st = self.state_of(job)
+        st.gw_reports += 1
+        if events > 0 and wait_ns / events >= threshold_ns:
+            st.gw_hot += 1
+            if st.gw_hot >= max(1, int(hot_after)):
+                st.gw_hot = 0
+                st.gw_boosts += 1
+                job.params.boost_on_wake = True
+                self._shrink(job, st)
+        else:
+            st.gw_hot = 0
+
     # -- csched_submilli_metric_update (s_c.c:302-389) -------------------
 
     def _submilli_update(self, job: "Job", st: JobMetricState,
@@ -271,6 +318,7 @@ class FeedbackPolicy:
                     "resets": st.resets,
                     "stale_ticks": st.stale_ticks,
                     "fallbacks": st.fallbacks,
+                    "gw_boosts": st.gw_boosts,
                 }
             )
         return out

@@ -44,6 +44,10 @@ class TenantSpec:
     max_steps: int | None = None
     # [(t_ns, awake)] state flips relative to sim start; None = always on.
     arrival: list[tuple[int, bool]] | None = None
+    # Serving-gateway SLO class ("interactive" | "batch"): which front
+    # door queue this tenant's requests ride (pbs_tpu.gateway). Batch
+    # by default; latency-sensitive generators override.
+    slo: str = "batch"
 
 
 def _rng(seed: int, salt: int) -> np.random.Generator:
@@ -64,6 +68,7 @@ def compute_bound(i: int, rng: np.random.Generator) -> TenantSpec:
             jitter=0.05,
         ),
         params=SchedParams(weight=256, tslice_us=300),
+        slo="interactive",  # short-step latency tenant at the gateway
     )
 
 
@@ -149,6 +154,7 @@ def bursty_serving(i: int, rng: np.random.Generator,
         ),
         params=SchedParams(weight=128, tslice_us=100, boost_on_wake=True),
         arrival=arrival,
+        slo="interactive",  # the gateway's TTFT-protected class
     )
 
 

@@ -1,0 +1,27 @@
+"""Seeded gateway-discipline violations (pbst check fixture — never
+imported)."""
+
+from pbs_tpu.models.serving import ContinuousBatcher
+
+
+def handle_request(cfg, params, prompt):
+    eng = ContinuousBatcher(cfg, params)
+    # gw-direct-submit: no admission, no fair queue, no requeue — a
+    # tenant bypassing the front door entirely.
+    eng.submit(prompt, max_new_tokens=8)
+    return eng
+
+
+class Server:
+    def __init__(self, cfg, params):
+        self.engine = ContinuousBatcher(cfg, params)
+
+    def handle(self, prompt):
+        # gw-direct-submit via the attribute form.
+        return self.engine.submit(prompt, max_new_tokens=4)
+
+
+def push(backend, req, now_ns):
+    # gw-direct-dispatch: routing skipped — nothing requeues this
+    # request when the backend dies.
+    return backend.dispatch_request(req, now_ns)

@@ -1,0 +1,21 @@
+"""Clean twin of gw_bad.py: requests enter through the front door
+(pbst check fixture — never imported)."""
+
+
+def handle_request(gw, prompt):
+    # The sanctioned door: admission + fair queue + routed dispatch.
+    return gw.submit("tenant", {"prompt": prompt, "max_new": 8}, cost=1)
+
+
+class Server:
+    def __init__(self, gw):
+        self.gw = gw
+
+    def handle(self, prompt):
+        r = self.gw.submit("tenant", {"prompt": prompt, "max_new": 4})
+        return r.rid if r.admitted else None
+
+
+def pump(gw):
+    # Dispatch belongs to the gateway pump, not callers.
+    return gw.tick()

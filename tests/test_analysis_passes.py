@@ -48,6 +48,7 @@ def test_all_rules_fire_on_bad_tree():
         "sched-ops-missing", "sched-ops-signature", "sched-ops-clamp",
         "counter-raw-cache", "counter-raw-threshold",
         "net-raw-socket", "net-raw-transport",
+        "gw-direct-submit", "gw-direct-dispatch",
     }
 
 
@@ -108,7 +109,7 @@ def test_cli_list_passes(capsys):
     assert main(["check", "--list-passes"]) == 0
     out = capsys.readouterr().out
     for pid in ("lock-discipline", "time-units", "sched-ops",
-                "counter-api"):
+                "counter-api", "gateway-discipline"):
         assert pid in out
 
 

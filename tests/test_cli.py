@@ -139,14 +139,19 @@ def test_cli_live_agent_lifecycle(capsys):
 
 
 def test_serve_demo_cli(capsys):
-    """pbst serve-demo: the batcher drains a request mix; repeated
-    prompts hit the prefix cache."""
+    """pbst serve-demo: requests ride the gateway front door into the
+    batcher; repeated prompts hit the prefix cache."""
     import json as _json
 
     assert main(["serve-demo", "--requests", "6"]) == 0
     out = _json.loads(capsys.readouterr().out)
     assert out["completions"] == 6
     assert out["prefix_hits"] >= 3  # 3 distinct prompts, 6 requests
+    # The front door accounted every request; none bypassed admission.
+    assert out["gateway"]["admitted"] == 6
+    assert out["gateway"]["completed"] == 6
+    assert out["gateway"]["bypass_submits"] == 0
+    assert out["shed"] == 0
 
 
 def test_oprofile_passive_ledger(tmp_path, capsys):

@@ -170,6 +170,8 @@ def test_tp_mesh_validation(model):
 def test_slo_stats_populate(model):
     cfg, params = model
     eng = ContinuousBatcher(cfg, params, n_slots=2, prompt_bucket=16)
+    hooked = []  # gateway seam: every submit reports (rid, plen, max_new)
+    eng.submit_hook = lambda rid, plen, mn: hooked.append((rid, plen, mn))
     for i in range(3):
         eng.submit([1 + i], max_new_tokens=3)
     done = _drain(eng)
@@ -178,6 +180,21 @@ def test_slo_stats_populate(model):
     assert 0 < st["ttft_p50_s"] <= st["latency_p99_s"]
     for c in done.values():
         assert 0 < c.ttft_s <= c.latency_s
+    assert hooked == [(rid, 1, 3) for rid in sorted(done)]
+    # Both SLO windows are bounded to the same 1024-sample cap.
+    assert eng._ttfts.maxlen == eng._latencies.maxlen == 1024
+
+
+def test_pct_is_nearest_rank():
+    """Satellite pin: the old int(q*n) indexed one rank high — p50 of
+    two samples returned the max. Nearest-rank returns an observed
+    sample at the ceil(q*n)-th rank."""
+    pct = ContinuousBatcher._pct
+    assert pct([], 0.99) == 0.0
+    assert pct([7.0], 0.50) == 7.0
+    assert pct([2.0, 1.0], 0.50) == 1.0  # was 2.0 before the fix
+    assert pct(list(range(1, 101)), 0.50) == 50
+    assert pct(list(range(1, 101)), 0.99) == 99
 
 
 def test_job_shaped_serve_step(model):
