@@ -1,0 +1,134 @@
+"""Perf-discipline pass.
+
+PR 5 vectorized the trace/telemetry hot path (``docs/PERF.md``):
+records move through ``TraceBuffer.emit_many``/``consume`` in bulk
+slice copies, and bursty producers stage events through
+``obs.trace.EmitBatch``. What regresses is code quietly reintroducing
+the per-record idioms the rewrite removed — a Python loop striding a
+``TRACE_REC_WORDS``-word buffer one record at a time, or a hot loop
+paying a scalar ring emit per event. Two rules:
+
+- ``perf-rec-loop``: a ``for`` loop whose body does
+  ``TRACE_REC_WORDS``-strided record arithmetic — the
+  one-record-per-iteration copy the vectorized ring APIs replaced.
+  Scoped to the whole tree minus the machinery that *implements* the
+  record layout (``obs/trace.py``) and the harness that measures it
+  (``perf/``).
+- ``perf-emit-in-loop``: a scalar ``.emit(...)``/``.trace_emit(...)``
+  call inside a ``for``/``while`` body in the heavy-producer packages
+  (``sim/``, ``gateway/``, ``telemetry/``). Staged emits are
+  sanctioned and recognized by naming convention: a receiver whose
+  trailing identifier contains ``batch`` (``self._trace_batch.emit``,
+  ``ring_batch.emit``) is an ``EmitBatch``, which exists precisely to
+  be called per event.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from pbs_tpu.analysis.core import CheckContext, Finding, Pass, SourceFile
+
+#: Modules that implement the record layout / measure it — the strided
+#: arithmetic lives there by design.
+REC_MACHINERY = ("obs/trace.py", "perf/")
+
+#: Packages whose event producers are hot enough to batch.
+HOT_PACKAGES = ("sim/", "gateway/", "telemetry/")
+
+#: Scalar per-event emitters the batching APIs replace in hot loops.
+EMITTERS = ("emit", "trace_emit")
+
+
+def _anchored(rel_path: str) -> str:
+    parts = rel_path.replace("\\", "/").split("/")
+    if "pbs_tpu" in parts:
+        parts = parts[parts.index("pbs_tpu") + 1:]
+    return "/".join(parts)
+
+
+def _is_test(rel_path: str) -> bool:
+    norm = rel_path.replace("\\", "/")
+    return "tests/" in norm or norm.rsplit("/", 1)[-1].startswith("test_")
+
+
+def _receiver_ident(func: ast.Attribute) -> str:
+    """Trailing identifier of the emit receiver: ``self._trace_batch``
+    -> "_trace_batch", ``ring`` -> "ring"."""
+    base = func.value
+    if isinstance(base, ast.Attribute):
+        return base.attr
+    if isinstance(base, ast.Name):
+        return base.id
+    return ""
+
+
+def _mentions_rec_words(node: ast.AST) -> bool:
+    return any(isinstance(sub, ast.Name) and sub.id == "TRACE_REC_WORDS"
+               for sub in ast.walk(node))
+
+
+class _PerfScan(ast.NodeVisitor):
+    def __init__(self, src: SourceFile, rec_scope: bool, emit_scope: bool):
+        self.src = src
+        self.rec_scope = rec_scope
+        self.emit_scope = emit_scope
+        self.findings: list[Finding] = []
+        self._loop_depth = 0
+
+    def _visit_loop(self, node: ast.For | ast.While) -> None:
+        if self.rec_scope and isinstance(node, ast.For) and any(
+                _mentions_rec_words(stmt) for stmt in node.body):
+            self.findings.append(Finding(
+                "perf-rec-loop", self.src.rel_path, node.lineno,
+                node.col_offset,
+                "per-record loop over a TRACE_REC_WORDS-strided buffer — "
+                "one slice copy per record is the scalar path the "
+                "vectorized ring APIs replaced",
+                hint="move records in bulk: TraceBuffer.emit_many / "
+                     "consume / peek copy the wrapped span in at most "
+                     "two contiguous slices (obs/trace.py)"))
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_For = _visit_loop
+    visit_While = _visit_loop
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if (self.emit_scope and self._loop_depth > 0
+                and isinstance(func, ast.Attribute)
+                and func.attr in EMITTERS
+                and "batch" not in _receiver_ident(func).lower()):
+            self.findings.append(Finding(
+                "perf-emit-in-loop", self.src.rel_path, node.lineno,
+                node.col_offset,
+                f"scalar .{func.attr}() inside a loop in a hot producer "
+                "package — every event pays the full ring-emit cost",
+                hint="stage through an EmitBatch (one vectorized "
+                     "emit_many per watermark) or build the records and "
+                     "call emit_many once (obs/trace.py)"))
+        self.generic_visit(node)
+
+
+class PerfDisciplinePass(Pass):
+    id = "perf-discipline"
+    rules = ("perf-rec-loop", "perf-emit-in-loop")
+    description = ("trace/telemetry hot paths stay vectorized: no "
+                   "per-record TRACE_REC_WORDS loops, no scalar ring "
+                   "emits inside loops in sim/gateway/telemetry "
+                   "(EmitBatch/emit_many are the sanctioned forms)")
+
+    def run(self, src: SourceFile, ctx: CheckContext) -> list[Finding]:
+        if src.tree is None or _is_test(src.rel_path):
+            return []
+        anchored = _anchored(src.rel_path)
+        rec_scope = not any(
+            anchored == m or anchored.startswith(m) for m in REC_MACHINERY)
+        emit_scope = any(anchored.startswith(p) for p in HOT_PACKAGES)
+        if not (rec_scope or emit_scope):
+            return []
+        scan = _PerfScan(src, rec_scope, emit_scope)
+        scan.visit(src.tree)
+        return scan.findings

@@ -12,6 +12,8 @@ Reference surface being re-expressed (``tools/libxl/xl_cmdimpl.c``,
     pbst ckpt-info  inspect a checkpoint directory (xl save artifacts)
     pbst sched-credit  adjust weight/cap in a store db (xl sched-credit)
     pbst check      static invariant checker suite (docs/ANALYSIS.md)
+    pbst perf       hot-path microbench harness + regression gate
+                    (docs/PERF.md; the xenperf counter dump is ``perfc``)
     pbst gateway    serving front door demo + ledger stats (docs/GATEWAY.md)
     pbst demo       run the two-tenant sim demo end to end
 
@@ -80,8 +82,9 @@ def cmd_dump(args) -> int:
     meta = _load_meta(args.ledger)
     print(f"partition={meta['partition']} scheduler={meta['scheduler']}")
     print(HDR)
-    for slot_s, info in sorted(meta["slots"].items(), key=lambda kv: int(kv[0])):
-        snap = led.snapshot(int(slot_s))
+    rows = sorted(meta["slots"].items(), key=lambda kv: int(kv[0]))
+    snaps = led.snapshot_many([int(s) for s, _ in rows])
+    for (slot_s, info), snap in zip(rows, snaps):
         print(_fmt_row(int(slot_s), info, snap))
     return 0
 
@@ -92,11 +95,12 @@ def cmd_top(args) -> int:
     try:
         for _ in range(args.iterations if args.iterations > 0 else 10**9):
             meta = _load_meta(args.ledger)
+            slot_rows = sorted(meta["slots"].items(),
+                               key=lambda kv: int(kv[0]))
+            snaps = led.snapshot_many([int(s) for s, _ in slot_rows])
             rows = []
-            for slot_s, info in sorted(meta["slots"].items(),
-                                       key=lambda kv: int(kv[0])):
+            for (slot_s, info), snap in zip(slot_rows, snaps):
                 slot = int(slot_s)
-                snap = led.snapshot(slot)
                 rows.append(_fmt_row(slot, info, snap, prev.get(slot),
                                      args.interval))
                 prev[slot] = snap
@@ -261,7 +265,7 @@ def cmd_oprofile(args) -> int:
     return 0
 
 
-def cmd_perf(args) -> int:
+def cmd_perfc(args) -> int:
     """xenperf analog: format a published obs dump's software counters."""
     from pbs_tpu.obs.dumpfile import read_obs_dump
 
@@ -269,6 +273,58 @@ def cmd_perf(args) -> int:
     for name, val in snap.get("perfc", {}).items():
         print(f"{name:<40} {val:>12}")
     return 0
+
+
+def cmd_perf(args) -> int:
+    """Hot-path microbenchmark harness (pbs_tpu.perf; docs/PERF.md):
+    run the named benches (default: all), print stable JSON or a table,
+    optionally gate against the checked-in baseline (--check fails only
+    on >= --threshold ns/op regressions) or refresh it
+    (--update-baseline)."""
+    from pbs_tpu.perf import (
+        format_report,
+        load_baseline,
+        run_benches,
+        save_baseline,
+    )
+    from pbs_tpu.perf.report import main_check
+
+    if args.update_baseline and args.quick:
+        print("pbst: refusing to write a --quick-only baseline "
+              "(--update-baseline measures both modes itself)",
+              file=sys.stderr)
+        return 2
+    try:
+        results = run_benches(args.benches, quick=args.quick)
+    except KeyError as e:
+        print(f"pbst: {e.args[0]}", file=sys.stderr)
+        return 2
+    if args.update_baseline:
+        # Both modes: --check compares like-with-like (quick op counts
+        # carry systematic per-call-overhead offsets).
+        quick_results = run_benches(args.benches, quick=True)
+        path = save_baseline(results, args.baseline,
+                             quick_results=quick_results)
+        print(f"wrote baseline {path}")
+        return 0
+    if args.json:
+        print(json.dumps(results, indent=1, sort_keys=True))
+    else:
+        baseline = None
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError):
+            pass  # table renders without the vs_base column
+        print(format_report(results, baseline))
+    if args.check:
+        return main_check(results, args.baseline, args.threshold)
+    return 0
+
+
+def perf_entry() -> None:
+    """Console entry ``pbst-perf`` (CI convenience: exactly
+    ``pbst perf ...`` without the subcommand word)."""
+    sys.exit(main(["perf", *sys.argv[1:]]))
 
 
 def cmd_lockprof(args) -> int:
@@ -988,8 +1044,30 @@ def main(argv=None) -> int:
                     help="sampling period in ms")
     sp.set_defaults(fn=cmd_oprofile)
 
-    sp = sub.add_parser("perf", help="software counter dump (xenperf)")
+    sp = sub.add_parser("perfc", help="software counter dump (xenperf)")
     sp.add_argument("file", help="obs dump JSON (obs.dumpfile)")
+    sp.set_defaults(fn=cmd_perfc)
+
+    sp = sub.add_parser(
+        "perf", help="hot-path microbench harness (docs/PERF.md)")
+    sp.add_argument("--bench", dest="benches", action="append",
+                    metavar="NAME",
+                    help="run only this bench (repeatable; default: all)")
+    sp.add_argument("--quick", action="store_true",
+                    help="small op counts (the <=5s tier-1 smoke)")
+    sp.add_argument("--check", action="store_true",
+                    help="exit 1 on >= --threshold ns/op regressions "
+                         "vs the baseline")
+    sp.add_argument("--threshold", type=float, default=2.0,
+                    help="regression factor for --check (default 2.0)")
+    sp.add_argument("--baseline", default=None,
+                    help="baseline JSON (default: the checked-in "
+                         "pbs_tpu/perf/baseline.json)")
+    sp.add_argument("--update-baseline", action="store_true",
+                    dest="update_baseline",
+                    help="re-measure and overwrite the baseline")
+    sp.add_argument("--json", action="store_true",
+                    help="stable JSON report instead of the table")
     sp.set_defaults(fn=cmd_perf)
 
     sp = sub.add_parser("lockprof", help="lock contention (xenlockprof)")

@@ -45,7 +45,7 @@ from pbs_tpu.gateway.admission import (
 )
 from pbs_tpu.gateway.backends import Backend
 from pbs_tpu.gateway.fairqueue import DeficitRoundRobin, Request
-from pbs_tpu.obs.trace import Ev, TraceBuffer
+from pbs_tpu.obs.trace import EmitBatch, Ev, TraceBuffer
 from pbs_tpu.telemetry.counters import Counter
 from pbs_tpu.utils.clock import MS, MonotonicClock
 from pbs_tpu.utils.stats import nearest_rank
@@ -117,6 +117,12 @@ class Gateway:
         self.controller = controller
         self.trace = (TraceBuffer(trace_capacity)
                       if trace_capacity else None)
+        # Staged GW_* events: the pump is single-threaded (module
+        # docstring), so a tick's worth of admits/dispatches/completes
+        # is one vectorized ring write, flushed at tick end and before
+        # any external read (stats).
+        self._trace_batch = (EmitBatch(self.trace, capacity=128)
+                             if self.trace is not None else None)
         self._ledger = None
         self._ledger_path = ledger_path
         if ledger_path is not None:
@@ -222,7 +228,14 @@ class Gateway:
         self._repair(now)
         self._dispatch(now)
         self._feedback(now)
+        self.flush_trace()
         return done
+
+    def flush_trace(self) -> None:
+        """Land staged GW_* records in the ring (consumers reading
+        ``gw.trace`` between ticks call this first)."""
+        if self._trace_batch is not None:
+            self._trace_batch.flush()
 
     def busy(self) -> bool:
         return bool(self.queue.depth() or self.inflight)
@@ -390,8 +403,8 @@ class Gateway:
         return len(self.backends)  # unknown/None sentinel
 
     def _emit(self, now: int, ev: int, *args: int) -> None:
-        if self.trace is not None:
-            self.trace.emit(now, ev, *args)
+        if self._trace_batch is not None:
+            self._trace_batch.emit(now, ev, *args)
 
     def _emit_shed(self, now: int, tenant: str, cls: str,
                    shed: Shed) -> None:
@@ -425,6 +438,7 @@ class Gateway:
     # -- observability ---------------------------------------------------
 
     def stats(self) -> dict:
+        self.flush_trace()
         per_class = {}
         for cls in SLO_CLASSES:
             d, lt = self._delays[cls], self._latencies[cls]

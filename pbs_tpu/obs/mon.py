@@ -72,18 +72,23 @@ class SchedHistory:
 
     def ingest(self, recs: np.ndarray) -> int:
         """Fold (n, 8) u64 trace records; returns records consumed."""
-        for r in recs:
-            ts, ev = int(r[0]), int(r[1])
+        if not len(recs):
+            return 0
+        # One bulk tolist() instead of 3-4 numpy scalar reads per
+        # record: digestion is the monitor's hot loop (pbst mon polls
+        # tens of thousands of records per refresh).
+        for row in np.asarray(recs).tolist():
+            ts, ev = row[0], row[1]
             self._roll_to(ts)
             self.records_seen += 1
             if ev == Ev.SCHED_PICK:
-                self._cur[int(r[2])].allocated_ns += int(r[3])
+                self._cur[row[2]].allocated_ns += row[3]
             elif ev == Ev.SCHED_DESCHED:
-                w = self._cur[int(r[2])]
-                w.gotten_ns += int(r[3])
+                w = self._cur[row[2]]
+                w.gotten_ns += row[3]
                 w.execs += 1
             elif ev == Ev.SCHED_WAKE:
-                self._cur[int(r[2])].wakes += 1
+                self._cur[row[2]].wakes += 1
         return len(recs)
 
     def slots(self) -> list[int]:

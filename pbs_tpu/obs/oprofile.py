@@ -183,9 +183,9 @@ class ProfileSession:
                     ctx.counters[Counter.COLLECTIVE_WAIT_NS])
         for name, led, _path, meta in self._passive:
             last = self._passive_last[name]
-            for slot_s in meta.get("slots", {}):
-                slot = int(slot_s)
-                snap = led.snapshot(slot)
+            slots = [int(s) for s in meta.get("slots", {})]
+            snaps = led.snapshot_many(slots)
+            for slot, snap in zip(slots, snaps):
                 last[slot] = (
                     int(snap[Counter.STEPS_RETIRED]),
                     int(snap[Counter.DEVICE_TIME_NS]),
@@ -278,12 +278,14 @@ class ProfileSession:
                     coll_wait_dns=cw - prev_cw,
                 ))
         # Passive domains: lock-free ledger snapshots of foreign
-        # partitions.
+        # partitions — one vectorized snapshot_many per domain per tick
+        # (the sample-window fast path) instead of a per-slot loop.
         for name, led, _path, meta in self._passive:
             last = self._passive_last[name]
-            for slot_s, info in meta.get("slots", {}).items():
+            slot_meta = meta.get("slots", {})
+            snaps = led.snapshot_many([int(s) for s in slot_meta])
+            for (slot_s, info), snap in zip(slot_meta.items(), snaps):
                 slot = int(slot_s)
-                snap = led.snapshot(slot)
                 cur = (
                     int(snap[Counter.STEPS_RETIRED]),
                     int(snap[Counter.DEVICE_TIME_NS]),

@@ -11,6 +11,22 @@ Here: one ring per executor over a flat u64 buffer (native SPSC ring in
 ``native/pbst_runtime.cc`` when available, Python fallback otherwise),
 records of (timestamp, event, 6 args), a lost-record counter instead of
 blocking, and host-side formatting/digestion in ``pbs_tpu.cli``.
+
+**Hot-path contract** (``pbst perf`` pins it, docs/PERF.md): ``emit``
+allocates nothing per event (a preallocated scratch record and cached
+header views), ``emit_many``/``consume``/``peek`` move records in at
+most two contiguous slice copies each (wrap-aware), and producers with
+bursty event streams stage through :class:`EmitBatch` so N events cost
+one batched ring write instead of N scalar ones.
+
+**Batched-writer concurrency contract** (mirrors the ledger's): the
+vectorized producer paths (``emit_many``, and any ``EmitBatch`` over
+this ring) are plain slice stores + a header store with no fences —
+in-process SPSC is always safe (stores are program-ordered under the
+GIL), and a cross-process consumer attached to a file-backed ring is
+safe on TSO hosts (x86: the head store cannot pass the record stores).
+A cross-process producer needing release semantics on weaker memory
+models must use the native scalar ``emit``.
 """
 
 from __future__ import annotations
@@ -23,6 +39,8 @@ from pbs_tpu.utils.params import integer_param
 
 TRACE_HEADER_WORDS = 4
 TRACE_REC_WORDS = 8
+
+_U64_MASK = 2**64 - 1
 
 # ``tbuf_size=`` boot param analog (xen/common/trace.c): default ring
 # capacity in records for rings whose creator doesn't size them.
@@ -66,6 +84,10 @@ class Ev(enum.IntEnum):
     GW_COMPLETE = 0x0604  # args: tenant_slot, cls, backend_slot, service_ns
     GW_REQUEUE = 0x0605  # args: tenant_slot, cls, backend_slot
     GW_QDELAY = 0x0606  # args: cls, p50_ns, p99_ns, shed_ppm
+    # telemetry sampling (0x07xx) — the i-mode overflow path
+    # (telemetry/sampler.py): one record per threshold crossing, staged
+    # through an EmitBatch so a quantum's firings cost one ring write
+    TELEM_OVERFLOW = 0x0701  # args: ledger_slot, sample_id, counter, value
 
 
 class TraceBuffer:
@@ -79,6 +101,16 @@ class TraceBuffer:
         if buf is None:
             buf = bytearray(nwords * 8)
         self._arr = np.frombuffer(memoryview(buf), dtype="<u8", count=nwords)
+        # Cached header/word views: plain-int loads and stores with no
+        # numpy scalar boxing on the per-event path. Native-endian 'Q'
+        # over the '<u8' layout — this framework targets little-endian
+        # hosts (the native library shares the same assumption).
+        words = memoryview(buf)[: nwords * 8].cast("B").cast("Q")
+        self._hdr = words[:TRACE_HEADER_WORDS]
+        self._words = words
+        # Reusable staging record for the pure-Python emit path: arg
+        # normalization must not allocate per event.
+        self._scratch = memoryview(bytearray(TRACE_REC_WORDS * 8)).cast("Q")
         self._nat = None
         self._ptr = None
         if native is not False:
@@ -138,24 +170,90 @@ class TraceBuffer:
     # -- producer --------------------------------------------------------
 
     def emit(self, ts_ns: int, event: int, *args: int) -> bool:
-        a = list(args)[:6] + [0] * (6 - min(6, len(args)))
         if self._nat is not None:
+            a = [int(x) & _U64_MASK for x in args[:6]]
+            a += [0] * (6 - len(a))
             return bool(
-                self._nat.pbst_trace_emit(
-                    self._ptr, ts_ns, int(event), *[int(x) & (2**64 - 1) for x in a]
-                )
-            )
-        head, tail, cap = int(self._arr[0]), int(self._arr[1]), self.capacity
-        if head - tail >= cap:
-            self._arr[3] += np.uint64(1)
+                self._nat.pbst_trace_emit(self._ptr, ts_ns, int(event), *a))
+        hdr = self._hdr
+        head = hdr[0]
+        cap = self.capacity
+        if head - hdr[1] >= cap:
+            hdr[3] += 1
             return False
+        rec = self._scratch
+        rec[0] = int(ts_ns)
+        rec[1] = int(event)
+        i = 2
+        for x in args[:6]:
+            x = int(x)
+            if not 0 <= x <= _U64_MASK:  # mask only when out of range
+                x &= _U64_MASK
+            rec[i] = x
+            i += 1
+        while i < TRACE_REC_WORDS:
+            rec[i] = 0
+            i += 1
         off = TRACE_HEADER_WORDS + (head % cap) * TRACE_REC_WORDS
-        rec = [ts_ns, int(event)] + [int(x) & (2**64 - 1) for x in a]
-        self._arr[off:off + TRACE_REC_WORDS] = np.array(rec, dtype="<u8")
-        self._arr[0] = np.uint64(head + 1)
+        self._words[off:off + TRACE_REC_WORDS] = rec
+        hdr[0] = head + 1
         return True
 
+    def emit_many(self, recs: np.ndarray) -> int:
+        """Batched emit of an ``(n, 8)`` u64 record array in at most two
+        contiguous slice copies (wrap-aware). Returns the number of
+        records written; records that don't fit are dropped tail-first
+        with the lost counter charged — exactly the per-record drop
+        semantics of ``n`` scalar :meth:`emit` calls. See the module
+        docstring for the batched-writer concurrency contract."""
+        recs = np.ascontiguousarray(recs, dtype="<u8")
+        if recs.ndim != 2 or recs.shape[1] != TRACE_REC_WORDS:
+            raise ValueError(
+                f"emit_many wants (n, {TRACE_REC_WORDS}) u64 records, "
+                f"got shape {recs.shape}")
+        n = recs.shape[0]
+        if n == 0:
+            return 0
+        hdr = self._hdr
+        head, tail, cap = hdr[0], hdr[1], self.capacity
+        space = cap - (head - tail)
+        k = n if n <= space else space
+        if k < n:
+            hdr[3] += n - k
+        if k == 0:
+            return 0
+        flat = recs.reshape(-1)
+        arr = self._arr
+        start = head % cap
+        k1 = min(k, cap - start)
+        off = TRACE_HEADER_WORDS + start * TRACE_REC_WORDS
+        w = TRACE_REC_WORDS
+        arr[off:off + k1 * w] = flat[:k1 * w]
+        if k > k1:
+            arr[TRACE_HEADER_WORDS:TRACE_HEADER_WORDS + (k - k1) * w] = (
+                flat[k1 * w:k * w])
+        hdr[0] = head + k
+        return k
+
     # -- consumer --------------------------------------------------------
+
+    def _copy_out(self, first: int, n: int) -> np.ndarray:
+        """Wrap-aware bulk copy of records [first, first+n) into a fresh
+        (n, 8) array — one or two contiguous slices, no per-record loop."""
+        out = np.empty((n, TRACE_REC_WORDS), dtype="<u8")
+        if n:
+            flat = out.reshape(-1)
+            arr = self._arr
+            cap = self.capacity
+            start = first % cap
+            k1 = min(n, cap - start)
+            off = TRACE_HEADER_WORDS + start * TRACE_REC_WORDS
+            w = TRACE_REC_WORDS
+            flat[:k1 * w] = arr[off:off + k1 * w]
+            if n > k1:
+                flat[k1 * w:] = arr[
+                    TRACE_HEADER_WORDS:TRACE_HEADER_WORDS + (n - k1) * w]
+        return out
 
     def consume(self, max_records: int = 1024) -> np.ndarray:
         """(n, 8) u64 array of drained records."""
@@ -166,13 +264,12 @@ class TraceBuffer:
             n = self._nat.pbst_trace_consume(
                 self._ptr, native_mod.as_u64p(out), max_records)
             return out[: n * TRACE_REC_WORDS].reshape(n, TRACE_REC_WORDS)
-        head, tail, cap = int(self._arr[0]), int(self._arr[1]), self.capacity
-        n = min(head - tail, max_records)
-        recs = np.empty((n, TRACE_REC_WORDS), dtype="<u8")
-        for i in range(n):
-            off = TRACE_HEADER_WORDS + ((tail + i) % cap) * TRACE_REC_WORDS
-            recs[i] = self._arr[off:off + TRACE_REC_WORDS]
-        self._arr[1] = np.uint64(tail + n)
+        hdr = self._hdr
+        tail = hdr[1]
+        n = min(hdr[0] - tail, max_records)
+        recs = self._copy_out(tail, n)
+        if n:
+            hdr[1] = tail + n
         return recs
 
     def peek(self, max_records: int = 1024) -> np.ndarray:
@@ -182,21 +279,91 @@ class TraceBuffer:
         (same layout for the native ring), so it also works on a ring the
         native library owns; safe in-process where the producer is
         quiescent or slow relative to the copy."""
-        head, tail, cap = int(self._arr[0]), int(self._arr[1]), self.capacity
+        hdr = self._hdr
+        head, tail = hdr[0], hdr[1]
         avail = head - tail
         n = min(avail, max_records)
-        first = tail + (avail - n)  # newest n records
-        recs = np.empty((n, TRACE_REC_WORDS), dtype="<u8")
-        for i in range(n):
-            off = TRACE_HEADER_WORDS + ((first + i) % cap) * TRACE_REC_WORDS
-            recs[i] = self._arr[off:off + TRACE_REC_WORDS]
-        return recs
+        return self._copy_out(tail + (avail - n), n)  # newest n records
 
     @property
     def lost(self) -> int:
         if self._nat is not None:
             return int(self._nat.pbst_trace_lost(self._ptr))
-        return int(self._arr[3])
+        return self._hdr[3]
+
+
+class EmitBatch:
+    """Per-producer staging buffer over one ring: N events become one
+    wrap-aware ``emit_many`` instead of N scalar emits.
+
+    Flush happens on a **size watermark** (the staging buffer fills) or
+    a **time watermark** (the staged span of event timestamps exceeds
+    ``flush_ns`` — timestamps, not wall time, so virtual-clock runs stay
+    deterministic), or explicitly via :meth:`flush` (the partition's
+    drain/peek paths flush before reading so batched records are never
+    invisible to an in-process consumer).
+
+    NOT thread-safe: one batch per producer thread, and only where that
+    producer owns the ring (the SPSC contract). Producers needing
+    cross-thread ordering keep scalar ``TraceBuffer.emit`` — a staged
+    record does not reach the ring until flush, so two threads batching
+    into one ring would interleave at flush granularity, not emit order.
+    """
+
+    __slots__ = ("ring", "capacity", "flush_ns", "_buf", "_w", "_n",
+                 "_t0", "emitted", "flushes")
+
+    def __init__(self, ring: TraceBuffer, capacity: int = 256,
+                 flush_ns: int = 1_000_000):
+        if capacity <= 0:
+            raise ValueError("EmitBatch capacity must be > 0")
+        self.ring = ring
+        self.capacity = int(capacity)
+        self.flush_ns = int(flush_ns)
+        self._buf = np.zeros((self.capacity, TRACE_REC_WORDS), dtype="<u8")
+        self._w = memoryview(self._buf.reshape(-1))  # 1-D 'Q' item view
+        self._n = 0
+        self._t0 = -1  # ts of the oldest staged record; -1 = empty
+        self.emitted = 0
+        self.flushes = 0
+
+    def emit(self, ts_ns: int, event: int, *args: int) -> None:
+        w = self._w
+        base = self._n * TRACE_REC_WORDS
+        ts_ns = int(ts_ns)
+        w[base] = ts_ns
+        w[base + 1] = int(event)
+        i = base + 2
+        for x in args[:6]:
+            x = int(x)
+            if not 0 <= x <= _U64_MASK:
+                x &= _U64_MASK
+            w[i] = x
+            i += 1
+        end = base + TRACE_REC_WORDS
+        while i < end:
+            w[i] = 0
+            i += 1
+        self._n += 1
+        if self._t0 < 0:
+            self._t0 = ts_ns
+        if self._n >= self.capacity or ts_ns - self._t0 >= self.flush_ns:
+            self.flush()
+
+    def pending(self) -> int:
+        return self._n
+
+    def flush(self) -> int:
+        """Push staged records to the ring; returns records written
+        (staged minus any the full ring dropped)."""
+        n, self._n = self._n, 0
+        self._t0 = -1
+        if not n:
+            return 0
+        self.flushes += 1
+        written = self.ring.emit_many(self._buf[:n])
+        self.emitted += written
+        return written
 
 
 def merge_records(chunks: list[np.ndarray]) -> np.ndarray:
@@ -213,14 +380,14 @@ def merge_records(chunks: list[np.ndarray]) -> np.ndarray:
 def format_records(recs: np.ndarray) -> list[str]:
     """xentrace_format analog: human-readable lines."""
     out = []
-    for r in recs:
-        ts, ev = int(r[0]), int(r[1])
+    # tolist() converts the whole batch to Python ints in one C pass —
+    # per-element numpy scalar boxing dominates the scalar version.
+    for ts, ev, *args in np.asarray(recs).tolist():
         try:
             name = Ev(ev).name
         except ValueError:
             name = f"0x{ev:04x}"
-        args = " ".join(str(int(x)) for x in r[2:])
-        out.append(f"[{ts / 1e9:.6f}] {name} {args}")
+        out.append(f"[{ts / 1e9:.6f}] {name} {' '.join(map(str, args))}")
     return out
 
 
@@ -236,9 +403,7 @@ def chrome_trace(recs: np.ndarray, labels: dict[int, str] | None = None,
     labels = labels or {}
     events: list[dict] = []
     open_pick: dict[int, int] = {}  # slot -> pick ts
-    for r in recs:
-        ts, ev = int(r[0]), int(r[1])
-        a = [int(x) for x in r[2:]]
+    for ts, ev, *a in np.asarray(recs).tolist():
         slot = a[0] if a else 0
         try:
             name = Ev(ev).name

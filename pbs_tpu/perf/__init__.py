@@ -1,0 +1,31 @@
+"""``pbs_tpu.perf`` — microbenchmark harness for the framework's own
+hot paths (``pbst perf``; docs/PERF.md "Substrate microbenchmarks").
+
+PBS's premise is that the feedback instrumentation is cheap enough to
+run every millisecond on the hot path; this package makes that a
+*measured, regression-gated* property instead of a hope. Named benches
+cover the per-event/per-sample costs every layer pays (trace emit,
+batched emit, vectorized drain, ledger sampling, fair-queue cycling,
+the sim dispatch loop, an RPC loopback), emit stable JSON, and
+``pbst perf --check`` fails CI only on large (default ≥2×) ns/op
+regressions against the checked-in ``baseline.json`` — the
+order-of-magnitude canary philosophy of ``pbst selftest`` extended to
+a refreshable, per-path baseline.
+"""
+
+from pbs_tpu.perf.bench import BENCHES, BenchResult, bench_names, run_bench
+from pbs_tpu.perf.report import (
+    DEFAULT_THRESHOLD,
+    baseline_path,
+    compare_to_baseline,
+    format_report,
+    load_baseline,
+    run_benches,
+    save_baseline,
+)
+
+__all__ = [
+    "BENCHES", "BenchResult", "DEFAULT_THRESHOLD", "baseline_path",
+    "bench_names", "compare_to_baseline", "format_report",
+    "load_baseline", "run_bench", "run_benches", "save_baseline",
+]

@@ -21,7 +21,7 @@ import numpy as np
 
 import os
 
-from pbs_tpu.obs.trace import Ev, TraceBuffer, merge_records
+from pbs_tpu.obs.trace import Ev, EmitBatch, TraceBuffer, merge_records
 from pbs_tpu.runtime import xsm
 from pbs_tpu.runtime.events import EventBus, Virq
 from pbs_tpu.runtime.executor import Executor
@@ -69,6 +69,10 @@ class Partition:
             self.ledger = Ledger(ledger_slots)
         # Per-executor lockless trace rings (per-CPU rings, trace.c).
         self.traces: list[TraceBuffer] = []
+        # Optional per-ring staging batches (enable_trace_batching):
+        # single-threaded drivers (the sim engine) trade immediate ring
+        # visibility for one vectorized write per batch.
+        self._trace_batches: list[EmitBatch] | None = None
         # Async signaling fabric (event_channel.c analog); delivered by
         # the run loop between quanta.
         self.events = EventBus()
@@ -121,6 +125,13 @@ class Partition:
             else:
                 self.traces.append(TraceBuffer())
             self.scheduler.executor_added(ex)
+        # Overflow crossings land in ring 0 as TELEM_OVERFLOW in every
+        # mode (trace content must not depend on whether trace batching
+        # is enabled): the sampler stages a quantum's firings and
+        # flushes at the end of each check() call.
+        if self.traces:
+            self.sampler.bind_trace(
+                EmitBatch(self.traces[0], capacity=64), self.clock)
 
     # -- admission (domain_create analog, xen/common/domain.c) -----------
 
@@ -397,7 +408,9 @@ class Partition:
 
                     _t.sleep(min(0.001, max(0.0, (deadline - self.clock.now_ns()) / 1e9)))
         # Refresh the monitor sidecar so adapted tslice/weights are
-        # visible to pbst top after the run.
+        # visible to pbst top after the run; staged trace batches land
+        # in the rings so attached monitors see the full stream.
+        self.flush_traces()
         self._publish_meta()
         return quanta
 
@@ -433,17 +446,41 @@ class Partition:
             json.dump(meta, f, indent=1)
         os.replace(tmp, self._ledger_path + ".meta.json")
 
+    def enable_trace_batching(self, capacity: int = 256,
+                              flush_ns: int = 1_000_000) -> None:
+        """Stage trace events per ring through :class:`EmitBatch` (one
+        vectorized ``emit_many`` per watermark instead of a scalar emit
+        per event). Only for single-threaded drivers that own every
+        producer — the sim engine — because staged records reach the
+        ring at flush granularity; live multi-threaded partitions keep
+        scalar emits so cross-thread ring order matches emit order."""
+        self._trace_batches = [
+            EmitBatch(t, capacity=capacity, flush_ns=flush_ns)
+            for t in self.traces
+        ]
+
+    def flush_traces(self) -> None:
+        if self._trace_batches is not None:
+            for b in self._trace_batches:
+                b.flush()
+
     def trace_emit(self, exi: int, event: int, *args: int) -> None:
         if 0 <= exi < len(self.traces):
-            self.traces[exi].emit(self.clock.now_ns(), event, *args)
+            if self._trace_batches is not None:
+                self._trace_batches[exi].emit(
+                    self.clock.now_ns(), event, *args)
+            else:
+                self.traces[exi].emit(self.clock.now_ns(), event, *args)
 
     def peek_traces(self, max_records: int = 4096):
         """Non-destructive tail of all rings, merged and time-sorted —
         for postmortems/snapshots that must not race a live consumer."""
+        self.flush_traces()
         return merge_records([t.peek(max_records) for t in self.traces])
 
     def drain_traces(self, max_records: int = 4096):
         """xentrace analog: drain all rings, merged and time-sorted."""
+        self.flush_traces()
         return merge_records([t.consume(max_records) for t in self.traces])
 
     def dump(self) -> dict[str, Any]:

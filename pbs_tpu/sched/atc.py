@@ -30,6 +30,8 @@ import dataclasses
 import math
 from typing import TYPE_CHECKING
 
+import numpy as np
+
 from pbs_tpu.sched.feedback import FeedbackPolicy, JobMetricState
 from pbs_tpu.utils.clock import MS
 
@@ -47,7 +49,12 @@ ATC_MAX_US = 30_000
 @dataclasses.dataclass
 class AtcJobState:
     ewma_ns: float = 0.0
-    history: list = dataclasses.field(default_factory=list)
+    # Preallocated HISTORY-deep bucket ring (shift-in-place, arrival
+    # order; hfill = filled prefix) — same no-allocation contract as
+    # the base policy's sample window.
+    history: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros(HISTORY, dtype=np.int64))
+    hfill: int = 0
     applied_bucket: int | None = None
 
 
@@ -78,11 +85,15 @@ class AtcFeedbackPolicy(FeedbackPolicy):
         a.ewma_ns = (a.ewma_ns * (ALPHA - 1) + sample) / ALPHA
         bucket = int(math.log2(a.ewma_ns)) if a.ewma_ns >= 1 else 0
 
-        a.history.append(bucket)
-        if len(a.history) > HISTORY:
-            a.history.pop(0)
+        h = a.history
+        if a.hfill < HISTORY:
+            h[a.hfill] = bucket
+            a.hfill += 1
+        else:
+            h[:-1] = h[1:]
+            h[-1] = bucket
         # Hysteresis: only adopt a bucket after HISTORY agreeing samples.
-        if len(a.history) == HISTORY and len(set(a.history)) == 1:
+        if a.hfill == HISTORY and bool((h == h[0]).all()):
             a.applied_bucket = bucket
 
         self._apply_global_min()

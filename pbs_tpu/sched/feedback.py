@@ -76,9 +76,16 @@ GW_HOT_AFTER = 3
 @dataclasses.dataclass
 class JobMetricState:
     """Per-job filter state (``struct metric_state``/``event_sample``,
-    ``sched_credit.c:173-191``)."""
+    ``sched_credit.c:173-191``).
 
-    window: list[float] = dataclasses.field(default_factory=list)
+    The sample window is a preallocated float64 ring in arrival order
+    (shift-in-place on the full window) — the metric tick runs every
+    millisecond for every job, so the filter must not allocate or walk
+    Python lists per tick. ``wfill`` is the filled prefix; resets just
+    zero it."""
+
+    window: np.ndarray | None = None  # allocated lazily to window_len
+    wfill: int = 0
     phase: str = LOW_PHASE
     last_contention: tuple[int, int] = (0, 0)
     ticks: int = 0
@@ -155,20 +162,23 @@ class FeedbackPolicy:
     def _job_update(self, job: "Job") -> None:
         st = self.state_of(job)
         st.ticks += 1
-        steps = np.uint64(0)
-        dev_ns = np.uint64(0)
-        stall_ns = np.uint64(0)
-        coll_ns = np.uint64(0)
+        # One ndarray subtraction + in-place baseline refresh per
+        # context (no per-tick .copy() allocation, no per-counter numpy
+        # scalar arithmetic), then a single int() per consumed counter.
+        tot = None
         for ctx in job.contexts:
             delta = ctx.counters - ctx.prev_counters
-            ctx.prev_counters = ctx.counters.copy()
-            steps += delta[Counter.STEPS_RETIRED]
-            dev_ns += delta[Counter.DEVICE_TIME_NS]
-            stall_ns += delta[Counter.HBM_STALL_NS]
-            coll_ns += delta[Counter.COLLECTIVE_WAIT_NS]
-        if int(steps) == 0 and int(dev_ns) == 0:
+            ctx.prev_counters[:] = ctx.counters
+            tot = delta if tot is None else tot + delta
+        if tot is None:
+            return
+        steps = int(tot[Counter.STEPS_RETIRED])
+        dev_ns = int(tot[Counter.DEVICE_TIME_NS])
+        stall_ns = int(tot[Counter.HBM_STALL_NS])
+        coll_ns = int(tot[Counter.COLLECTIVE_WAIT_NS])
+        if steps == 0 and dev_ns == 0:
             return  # job idle this tick — nothing to learn
-        if int(steps) > 0 and int(dev_ns) == 0:
+        if steps > 0 and dev_ns == 0:
             # Steps retired but zero device time: the readout is dead
             # (progress is runtime-observed; device time is a counter
             # read — see telemetry.source._STALLABLE), so every rate
@@ -177,17 +187,17 @@ class FeedbackPolicy:
             if st.stale_ticks == self.stale_after:
                 # Trip once per stall episode: park on the default band
                 # value and forget the (now meaningless) window.
-                st.window.clear()
+                st.wfill = 0
                 st.fallbacks += 1
                 job.params.tslice_us = self.fallback_us
             return
         st.stale_ticks = 0  # live counters again: resume steering
         # Rate metrics (csched_dom_metric_update, s_c.c:427-435).
-        if int(dev_ns) > 0:
-            job.stall_rate = float(int(stall_ns)) * 1000.0 / float(int(dev_ns))
-        if int(steps) > 0:
-            job.nspi = float(int(dev_ns)) / float(int(steps))
-        self._submilli_update(job, st, float(int(coll_ns)), int(steps))
+        if dev_ns > 0:
+            job.stall_rate = float(stall_ns) * 1000.0 / float(dev_ns)
+        if steps > 0:
+            job.nspi = float(dev_ns) / float(steps)
+        self._submilli_update(job, st, float(coll_ns), steps)
         # Tick record for the sim trace (pbs_tpu.sim.trace): captures the
         # adaptation decision stream so live runs replay offline.
         rec = getattr(self.partition, "recorder", None)
@@ -243,17 +253,25 @@ class FeedbackPolicy:
         total_events = max(1, events + (steps if coll_wait_ns > 0 else 0))
         sample = total_wait / total_events
 
-        st.window.append(sample)
-        if len(st.window) < self.window_len:
-            return
-        if len(st.window) > self.window_len:
-            st.window.pop(0)
+        w = st.window
+        if w is None or len(w) != self.window_len:
+            w = st.window = np.zeros(self.window_len, dtype=np.float64)
+            st.wfill = 0
+        if st.wfill < self.window_len:
+            w[st.wfill] = sample
+            st.wfill += 1
+            if st.wfill < self.window_len:
+                return
+        else:
+            # Full window: shift-in-place keeps arrival order (the
+            # append+pop(0) semantics) with no allocation.
+            w[:-1] = w[1:]
+            w[-1] = sample
 
-        mean = sum(st.window) / len(st.window)
+        mean = float(w.sum()) / self.window_len
         if mean > 0:
-            stable = all(
-                STABLE_LO * mean <= s <= STABLE_HI * mean for s in st.window
-            )
+            stable = bool(np.all((w >= STABLE_LO * mean)
+                                 & (w <= STABLE_HI * mean)))
         else:
             stable = True  # no contention at all is maximally stable
 
@@ -271,8 +289,8 @@ class FeedbackPolicy:
         else:
             # Unstable window: reset; shrink if contention is rising
             # (s_c.c:374-384).
-            rising = st.window[-1] > mean
-            st.window.clear()
+            rising = float(w[-1]) > mean
+            st.wfill = 0
             st.resets += 1
             if rising:
                 self._shrink(job, st)

@@ -168,6 +168,12 @@ class SimEngine:
         self.partition = Partition(
             f"sim-{workload}", source=self.backend, scheduler=sched_name,
             n_executors=n_executors)
+        # The engine owns every producer on one thread under virtual
+        # time, so dispatch events stage through EmitBatch: one
+        # vectorized ring write per watermark instead of two scalar
+        # emits per quantum (watermarks key on record timestamps, so
+        # batching is as deterministic as the run itself).
+        self.partition.enable_trace_batching()
         self.probe = SchedulerProbe(self.partition.scheduler, self.clock)
         self.partition.scheduler = self.probe
         self.feedback = (policy_cls(self.partition)

@@ -233,6 +233,37 @@ class Ledger:
                 return sums
         raise RuntimeError(f"ledger slot {slot}: snapshot retries exhausted")
 
+    def snapshot_many(self, slots, max_retries: int = 64) -> np.ndarray:
+        """Vectorized :meth:`snapshot` over a slot set: one fancy-index
+        copy of the sums slab per retry round instead of a Python loop
+        of per-slot, per-counter reads — the sample-window fast path for
+        monitors (``pbst dump``/``top``, oprofile passive domains).
+
+        Returns ``(len(slots), NUM_COUNTERS)`` u64 sums. The seqlock
+        contract is checked per retry round across ALL requested slots
+        (version column even and unchanged around the slab copy), so a
+        torn slot retries the round the same way the scalar read does.
+        """
+        idx = np.asarray(list(slots), dtype=np.intp)
+        if idx.size == 0:
+            return np.empty((0, NUM_COUNTERS), dtype="<u8")
+        if self._nat is not None:
+            out = np.empty((idx.size, NUM_COUNTERS), dtype="<u8")
+            for i, slot in enumerate(idx):
+                out[i] = self.snapshot(int(slot), max_retries)
+            return out
+        for _ in range(max_retries):
+            v0 = self._arr[idx, _V].copy()
+            if (v0 & 1).any():
+                continue
+            sums = self._arr[idx, _SUMS:_SUMS + NUM_COUNTERS]
+            v1 = self._arr[idx, _V]
+            if (v0 == v1).all():
+                return sums
+        raise RuntimeError(
+            f"ledger slots {list(map(int, idx))}: snapshot_many retries "
+            "exhausted")
+
     def is_running(self, slot: int) -> bool:
         return int(self._arr[slot, _T]) != 0
 

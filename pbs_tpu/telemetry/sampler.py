@@ -67,13 +67,40 @@ class _Sample:
 
 
 class OverflowSampler:
-    """Per-partition registry of armed counter thresholds."""
+    """Per-partition registry of armed counter thresholds.
+
+    Hot-path shape: :meth:`check` runs at every quantum boundary, so
+    samples are indexed per context — a partition with hundreds of
+    armed samples costs each deschedule only the samples armed on the
+    descheduled context, not a full-registry scan.
+    """
 
     def __init__(self, events: EventBus):
         self._events = events
         self._samples: dict[int, _Sample] = {}
+        # id(ctx) -> {sample_id: _Sample}. Keyed by identity (contexts
+        # are not hashable by value); safe because every _Sample holds a
+        # strong ref to its ctx, so a key can never be recycled while
+        # its group is non-empty, and empty groups are deleted.
+        self._by_ctx: dict[int, dict[int, _Sample]] = {}
         self._ids = itertools.count(1)
         self._queue: list[OverflowEvent] = []
+        # Optional batched trace channel (Ev.TELEM_OVERFLOW): wired by
+        # Partition.enable_trace_batching so a quantum's firings cost
+        # one staged ring write, not one emit per crossing.
+        self._trace_batch = None
+        self._clock = None
+
+    def bind_trace(self, batch, clock) -> None:
+        """Attach an ``EmitBatch`` (or None to detach) + clock: every
+        crossing then also lands in the trace ring as TELEM_OVERFLOW."""
+        # Lazy import: obs/__init__ reaches back into telemetry (the
+        # oprofile leg), so a module-level import here would cycle.
+        from pbs_tpu.obs.trace import Ev
+
+        self._ev_overflow = int(Ev.TELEM_OVERFLOW)
+        self._trace_batch = batch
+        self._clock = clock
 
     # -- arming (VPERFCTR_CONTROL with si_signo set) ---------------------
 
@@ -87,11 +114,22 @@ class OverflowSampler:
         if threshold is None:
             threshold = int(ctx.counters[counter]) + period
         sid = next(self._ids)
-        self._samples[sid] = _Sample(sid, ctx, counter, period, threshold)
+        s = _Sample(sid, ctx, counter, period, threshold)
+        self._samples[sid] = s
+        self._by_ctx.setdefault(id(ctx), {})[sid] = s
         return sid
 
+    def _unindex(self, s: _Sample) -> None:
+        group = self._by_ctx.get(id(s.ctx))
+        if group is not None:
+            group.pop(s.sample_id, None)
+            if not group:
+                del self._by_ctx[id(s.ctx)]
+
     def disarm(self, sample_id: int) -> None:
-        self._samples.pop(sample_id, None)
+        s = self._samples.pop(sample_id, None)
+        if s is not None:
+            self._unindex(s)
 
     def disarm_job(self, job) -> int:
         """Drop every sample on the job's contexts (called at job
@@ -100,7 +138,7 @@ class OverflowSampler:
         doomed = [sid for sid, s in self._samples.items()
                   if s.ctx.job is job]
         for sid in doomed:
-            del self._samples[sid]
+            self._unindex(self._samples.pop(sid))
         return len(doomed)
 
     def rearm(self, sample_id: int, period: int | None = None) -> None:
@@ -130,9 +168,12 @@ class OverflowSampler:
         """Test every armed sample on ``ctx`` after a quantum folded new
         deltas in. Each crossing queues one event, disarms the sample,
         and raises ``Virq.TELEMETRY``. Returns events queued."""
+        group = self._by_ctx.get(id(ctx))
+        if not group:
+            return 0
         n = 0
-        for s in self._samples.values():
-            if not s.armed or s.ctx is not ctx:
+        for s in group.values():
+            if not s.armed:
                 continue
             value = int(ctx.counters[s.counter])
             if value >= s.threshold:
@@ -147,8 +188,18 @@ class OverflowSampler:
                     value=value,
                     seq=s.fired,
                 ))
+                if self._trace_batch is not None:
+                    self._trace_batch.emit(
+                        self._clock.now_ns(), self._ev_overflow,
+                        ctx.ledger_slot, s.sample_id, int(s.counter),
+                        value)
                 n += 1
         if n:
+            if self._trace_batch is not None:
+                # Flush per check(): one batched ring write per quantum
+                # with crossings, and identical trace content whether or
+                # not the partition batches its scheduler events.
+                self._trace_batch.flush()
             self._events.send_virq(Virq.TELEMETRY)
         return n
 

@@ -1,0 +1,26 @@
+"""Seeded perf-discipline violations (perf-rec-loop, perf-emit-in-loop)."""
+
+TRACE_HEADER_WORDS = 4
+TRACE_REC_WORDS = 8
+
+
+def drain_scalar(arr, head, tail, cap):
+    """The pre-vectorization consume idiom: one slice copy per record."""
+    recs = []
+    for i in range(head - tail):
+        off = TRACE_HEADER_WORDS + ((tail + i) % cap) * TRACE_REC_WORDS
+        recs.append(arr[off:off + TRACE_REC_WORDS])
+    return recs
+
+
+def pump(ring, events, clock):
+    """Scalar ring emit per event in a hot producer loop."""
+    for ev in events:
+        ring.emit(clock.now_ns(), ev, 1)
+
+
+def dispatch_all(part, picks):
+    i = 0
+    while i < len(picks):
+        part.trace_emit(0, picks[i])
+        i += 1

@@ -49,6 +49,7 @@ def test_all_rules_fire_on_bad_tree():
         "counter-raw-cache", "counter-raw-threshold",
         "net-raw-socket", "net-raw-transport",
         "gw-direct-submit", "gw-direct-dispatch",
+        "perf-rec-loop", "perf-emit-in-loop",
     }
 
 
@@ -109,7 +110,7 @@ def test_cli_list_passes(capsys):
     assert main(["check", "--list-passes"]) == 0
     out = capsys.readouterr().out
     for pid in ("lock-discipline", "time-units", "sched-ops",
-                "counter-api", "gateway-discipline"):
+                "counter-api", "gateway-discipline", "perf-discipline"):
         assert pid in out
 
 

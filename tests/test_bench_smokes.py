@@ -14,6 +14,8 @@ from __future__ import annotations
 import json
 import os
 import subprocess
+
+import pytest
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -35,6 +37,7 @@ def _run(script: str, env_extra: dict, timeout: float = 900.0):
     return proc, rows
 
 
+@pytest.mark.slow  # ~20 s eight-row engine matrix sweep
 def test_bench_serving_tiny_covers_the_matrix():
     proc, rows = _run("bench_serving.py", {"PBST_BENCH_TINY": "1"})
     assert proc.returncode == 0, proc.stderr[-800:]
@@ -66,6 +69,7 @@ def test_bench_longctx_tiny_emits_points():
     assert ok, rows
 
 
+@pytest.mark.slow  # ~25 s roofline-section sweep
 def test_bench_decompose_tiny_emits_sections():
     proc, rows = _run("bench_decompose.py", {"PBST_DECOMP_TINY": "1"})
     assert proc.returncode == 0, proc.stderr[-800:]
@@ -103,6 +107,7 @@ def _queue_agenda(tmp_path):
     return agenda
 
 
+@pytest.mark.slow  # ~90 s full-agenda rehearsal; tier-1 runs at the 870 s kill (docs/PERF.md)
 def test_queue_stage_rehearsal_tiny(tmp_path):
     """Execute every sweep/candidate stage command from the REAL queue
     agenda in tiny mode on CPU (r5: stage 4's pallas-only grid was

@@ -14,6 +14,8 @@ import stat
 import subprocess
 import time
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -126,6 +128,7 @@ def test_success_after_deadline_skips_queue(tmp_path):
     assert "starting chip_queue.sh" not in out
 
 
+@pytest.mark.slow  # ~7 s real-sleep deadline soak
 def test_success_past_not_after_still_runs_queue_before_deadline(
         tmp_path):
     """r5 incident (10:32): NOT_AFTER bounds ATTEMPTS — a one-attempt

@@ -20,6 +20,8 @@ import os
 import re
 import shutil
 import subprocess
+
+import pytest
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -231,6 +233,7 @@ def test_apply_rewrites_anchor_and_still_parses(tmp_path):
                       "mu_dtype": "bf16", "remat": None}
 
 
+@pytest.mark.slow  # ~17 s end-to-end worker rehearsal
 def test_bench_worker_honors_committed_defaults(tmp_path):
     """End-to-end: a flipped DEFAULTS line changes what the no-env
     driver invocation measures (tiny mode, CPU).  Runs the real worker
@@ -262,6 +265,7 @@ def test_bench_worker_honors_committed_defaults(tmp_path):
     assert row["mu_dtype"] == "bf16"
 
 
+@pytest.mark.slow  # ~12 s end-to-end worker rehearsal
 def test_committed_loss_chunks_never_bricks_tiny_smoke(tmp_path):
     """A committed loss_chunks valid at the driver seq (1024) but with
     no divisor at the tiny seq (128) must not kill the CPU smoke path
@@ -287,6 +291,7 @@ def test_committed_loss_chunks_never_bricks_tiny_smoke(tmp_path):
     assert "loss_chunks" not in row
 
 
+@pytest.mark.slow  # ~13 s end-to-end worker rehearsal
 def test_env_zero_reopens_unchunked_path_over_committed_default(tmp_path):
     """PBST_BENCH_LOSS_CHUNKS=0 is the explicit unchunked spelling:
     after a flip commits loss_chunks, the pre-flip protocol must stay

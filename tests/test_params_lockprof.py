@@ -194,7 +194,7 @@ def test_obs_dump_roundtrip_and_cli(tmp_path, capsys):
     snap = write_obs_dump(path)
     assert read_obs_dump(path) == json.loads(json.dumps(snap))
 
-    assert main(["perf", path]) == 0
+    assert main(["perfc", path]) == 0
     out = capsys.readouterr().out
     assert "t_cli_counter" in out and "3" in out
 

@@ -131,6 +131,7 @@ def test_pipelined_attn_mesh_guards():
         _pipe_blocks(uly_cfg, tp_sp, 2)
 
 
+@pytest.mark.slow  # ~30 s 3-step parity soak; the non-flash pipelined parity pins stay tier-1
 def test_pipelined_flash_train_matches_single_device():
     """dp2 x pp2 with the framework's OWN flash kernel inside the
     GPipe stages (interpreter mode on CPU, Mosaic on chip): three

@@ -41,6 +41,7 @@ def drain(eng, max_ticks=300):
     return got
 
 
+@pytest.mark.slow  # ~11 s token-exact mesh property sweep
 def test_spec_serving_on_tp_mesh_token_exact(models):
     """r5: speculative serving composes with the tp mesh — target AND
     draft trees Megatron-sharded, both slot caches kv-head-sharded.
@@ -237,6 +238,7 @@ def test_moe_continuous_serving_token_exact():
     assert got[0] == ref, (got[0], ref)
 
 
+@pytest.mark.slow  # ~10 s token-exact MoE property sweep
 def test_moe_speculative_serving_token_exact():
     """And the composition: MoE target + dense draft in the
     speculative engine, exact vs the plain MoE engine."""
