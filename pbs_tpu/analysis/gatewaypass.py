@@ -17,6 +17,14 @@ serving.py`` implements the engine the door fronts) and tests:
   admission bypass.
 - ``gw-direct-dispatch``: a call to a backend's ``dispatch_request``
   — dispatch without routing, so nothing requeues it on backend loss.
+- ``gw-lease-bypass``: a write to a token bucket's ``.level`` outside
+  the gateway machinery. Under federation (docs/GATEWAY.md
+  "Federation") admission state is REPLICATED: bucket levels are
+  slices of one global bank, and every level change must go through
+  the lease path (``LeaseBroker.grant``/``deposit``,
+  ``LeasedBucket.credit``/``take``) or the federation's global-rate
+  contract silently desyncs — a hand-topped bucket is minting tokens
+  nobody audited.
 """
 
 from __future__ import annotations
@@ -33,6 +41,9 @@ from pbs_tpu.analysis.core import (
 
 #: Engine constructors whose instances must be fed via the gateway.
 ENGINE_CTORS = {"ContinuousBatcher", "SpeculativeBatcher"}
+
+#: Bucket constructors whose ``.level`` is replicated admission state.
+BUCKET_CTORS = {"TokenBucket", "LeasedBucket", "GlobalBucket"}
 
 #: Modules that ARE the machinery (relative to the package root).
 MACHINERY = ("gateway", "models/serving.py")
@@ -67,42 +78,79 @@ def _ctor_name(node: ast.AST) -> str | None:
 
 
 class _EngineNames(ast.NodeVisitor):
-    """First sweep: names/attributes bound to engine constructions."""
+    """First sweep: names/attributes bound to engine (and bucket)
+    constructions."""
 
     def __init__(self) -> None:
         self.names: set[str] = set()
+        self.buckets: set[str] = set()
+
+    def _record(self, ctor: str | None, targets: list[ast.AST]) -> None:
+        if ctor not in ENGINE_CTORS and ctor not in BUCKET_CTORS:
+            return
+        into = self.names if ctor in ENGINE_CTORS else self.buckets
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                into.add(tgt.id)
+            elif isinstance(tgt, ast.Attribute):
+                into.add(tgt.attr)
 
     def visit_Assign(self, node: ast.Assign) -> None:
-        if _ctor_name(node.value) in ENGINE_CTORS:
-            for tgt in node.targets:
-                if isinstance(tgt, ast.Name):
-                    self.names.add(tgt.id)
-                elif isinstance(tgt, ast.Attribute):
-                    self.names.add(tgt.attr)
+        self._record(_ctor_name(node.value), node.targets)
         self.generic_visit(node)
 
     def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
-        if node.value is not None and _ctor_name(node.value) in ENGINE_CTORS:
-            if isinstance(node.target, ast.Name):
-                self.names.add(node.target.id)
-            elif isinstance(node.target, ast.Attribute):
-                self.names.add(node.target.attr)
+        if node.value is not None:
+            self._record(_ctor_name(node.value), [node.target])
         self.generic_visit(node)
 
 
 class _GatewayScan(ast.NodeVisitor):
-    def __init__(self, src: SourceFile, engine_names: set[str]):
+    def __init__(self, src: SourceFile, engine_names: set[str],
+                 bucket_names: set[str]):
         self.src = src
         self.engine_names = engine_names
+        self.bucket_names = bucket_names
         self.findings: list[Finding] = []
 
     def _base_name(self, node: ast.Attribute) -> str | None:
         base = node.value
+        if isinstance(base, ast.Subscript):
+            base = base.value  # buckets["t"].level — name the mapping
         if isinstance(base, ast.Name):
             return base.id
         if isinstance(base, ast.Attribute):
             return base.attr
         return None
+
+    def _flag_level_write(self, target: ast.AST, node: ast.AST) -> None:
+        if not (isinstance(target, ast.Attribute)
+                and target.attr == "level"):
+            return
+        base = self._base_name(target)
+        if base is None:
+            return
+        if base not in self.bucket_names and "bucket" not in base.lower():
+            return
+        self.findings.append(Finding(
+            "gw-lease-bypass", self.src.rel_path,
+            node.lineno, node.col_offset,
+            "token-bucket level written outside the lease path — "
+            "replicated admission state changes only through lease "
+            "grant/renew/deposit, or the federation's global-rate "
+            "contract silently desyncs",
+            hint="route through LeaseBroker.grant/deposit or "
+                 "LeasedBucket.credit (pbs_tpu.gateway.federation); "
+                 "spend via the bucket's own take()"))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            self._flag_level_write(tgt, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._flag_level_write(node.target, node)
+        self.generic_visit(node)
 
     def visit_Call(self, node: ast.Call) -> None:
         func = node.func
@@ -137,17 +185,19 @@ class _GatewayScan(ast.NodeVisitor):
 
 class GatewayDisciplinePass(Pass):
     id = "gateway-discipline"
-    rules = ("gw-direct-submit", "gw-direct-dispatch")
+    rules = ("gw-direct-submit", "gw-direct-dispatch", "gw-lease-bypass")
     description = ("serving requests enter through the gateway front "
-                   "door (admission, fair queue, routed dispatch); "
-                   "direct engine submits and backend dispatches "
-                   "outside pbs_tpu/gateway/ are flagged")
+                   "door (admission, fair queue, routed dispatch) and "
+                   "replicated admission state moves only through the "
+                   "lease path; direct engine submits, backend "
+                   "dispatches, and bucket-level writes outside "
+                   "pbs_tpu/gateway/ are flagged")
 
     def run(self, src: SourceFile, ctx: CheckContext) -> list[Finding]:
         if src.tree is None or _exempt(src.rel_path):
             return []
         names = _EngineNames()
         names.visit(src.tree)
-        scan = _GatewayScan(src, names.names)
+        scan = _GatewayScan(src, names.names, names.buckets)
         scan.visit(src.tree)
         return scan.findings

@@ -686,6 +686,16 @@ def cmd_sim(args) -> int:
     return 0
 
 
+def _print_federation_events(report: dict, problem_label: str) -> None:
+    """Shared tail of the federation report renderers (chaos + demo):
+    the membership timeline and any invariant problems."""
+    for e in report["events"]:
+        print(f"  t={e['tick_ns'] / 1e6:>8.1f}ms "
+              f"{e['event']:<10} {e['gateway']}")
+    for prob in report["problems"]:
+        print(f"  {problem_label}: {prob}")
+
+
 def cmd_chaos(args) -> int:
     """Seeded chaos run (pbs_tpu.faults): controller + agents over the
     sim workload catalog under an armed FaultPlan, end-state invariants
@@ -693,9 +703,53 @@ def cmd_chaos(args) -> int:
     ``--plan gateway`` attacks the serving front door instead
     (pbs_tpu.gateway: admission sheds/stalls, misroutes, a backend
     kill) with the "no admitted request lost" invariant.
+    ``--plan federation`` attacks the front-door TIER
+    (gateway/federation.py: gateway deaths, partitions, lease
+    expiries, plus a seeded drain + rejoin schedule) with the
+    no-job-lost AND no-rate-inflation invariants.
     ``--selfcheck`` runs the scenario twice and requires identical
     digests. Exit 0 = every invariant held."""
     from pbs_tpu.faults import FaultPlan, run_chaos
+
+    if args.plan == "federation":
+        from pbs_tpu.gateway import run_federation_chaos
+
+        kw = dict(workload=args.workload, seed=args.seed,
+                  n_gateways=args.gateways, n_tenants=args.tenants,
+                  ticks=args.rounds * 80, trace_path=args.trace)
+        report = run_federation_chaos(**kw)
+        ok = report["ok"]
+        if args.selfcheck:
+            again = run_federation_chaos(**kw)
+            match = (again["trace_digest"] == report["trace_digest"]
+                     and again["report_digest"] == report["report_digest"])
+            report["selfcheck"] = {
+                "digest_match": match, "second_ok": again["ok"],
+                "second_digest": again["trace_digest"],
+            }
+            ok = ok and match and again["ok"]
+        if args.json:
+            print(json.dumps(report, indent=1, sort_keys=True))
+        else:
+            st = report["stats"]
+            print(f"federation chaos workload={report['workload']} "
+                  f"seed={report['seed']} gateways={report['gateways']} "
+                  f"ticks={report['ticks']}")
+            print(f"admitted={st['admitted']} completed={st['completed']} "
+                  f"handoffs={st['handoffs']} remaps={st['remaps']} "
+                  f"lease_refusals={st['lease_refusals']} "
+                  f"faults_fired={sum(report['faults_fired'].values())}")
+            for k, v in report["faults_fired"].items():
+                print(f"  {k:<32} {v}")
+            _print_federation_events(report, "INVARIANT VIOLATED")
+            if args.selfcheck:
+                sc = report["selfcheck"]
+                print(f"selfcheck: digest_match={sc['digest_match']} "
+                      f"second_ok={sc['second_ok']}")
+            print(f"trace_digest={report['trace_digest']}")
+            print(f"report_digest={report['report_digest']}")
+            print("ok" if ok else "FAILED")
+        return 0 if ok else 1
 
     if args.plan == "gateway":
         from pbs_tpu.gateway import run_gateway_chaos
@@ -800,6 +854,11 @@ def cmd_gateway(args) -> int:
     ``pbst gateway demo``  — the fault-free gateway scenario over the
     sim workload catalog (seeded arrivals, simulated backends): prints
     admission/fairness/queue-delay stats per SLO class.
+    ``pbst gateway demo --federated`` — the same arrivals through the
+    FEDERATED tier (``--gateways`` members, consistent-hash placement,
+    leased admission): no injected faults, but the seeded drain +
+    rejoin schedule still runs, so the handoff/remap machinery shows
+    in the stats (docs/GATEWAY.md "Federation").
     ``pbst gateway stats --ledger F`` — render a gateway telemetry
     ledger (the per-class slots) the way ``pbst dump`` renders a
     partition's.
@@ -836,6 +895,34 @@ def cmd_gateway(args) -> int:
     # demo: the chaos harness with no faults and no backend kill.
     from pbs_tpu.faults import FaultPlan
     from pbs_tpu.gateway import run_gateway_chaos
+
+    if args.federated:
+        from pbs_tpu.gateway import run_federation_chaos
+
+        report = run_federation_chaos(
+            workload=args.workload, seed=args.seed,
+            n_gateways=args.gateways,
+            backends_per_gateway=args.backends,
+            n_tenants=args.tenants,
+            ticks=args.ticks, plan=FaultPlan(seed=args.seed))
+        if args.json:
+            print(json.dumps(report, indent=1, sort_keys=True))
+            return 0 if report["ok"] else 1
+        st = report["stats"]
+        print(f"federated gateway demo workload={report['workload']} "
+              f"seed={report['seed']} gateways={report['gateways']} "
+              f"tenants={report['tenants']} ticks={report['ticks']}")
+        print(f"admitted={st['admitted']} completed={st['completed']} "
+              f"handoffs={st['handoffs']} remaps={st['remaps']} "
+              f"shed={st['shed']}")
+        for name, m in st["members"].items():
+            print(f"  {name:<8} admitted={m['admitted']:>5} "
+                  f"adopted={m['adopted']:>4} queued={m['queued']:>4} "
+                  f"inflight={m['inflight']:>3}"
+                  f"{'  draining' if m['draining'] else ''}")
+        _print_federation_events(report, "PROBLEM")
+        print("ok" if report["ok"] else "FAILED")
+        return 0 if report["ok"] else 1
 
     report = run_gateway_chaos(
         workload=args.workload, seed=args.seed,
@@ -1202,10 +1289,13 @@ def main(argv=None) -> int:
                     help="workload mix (see docs/SIM.md)")
     sp.add_argument("--seed", type=int, default=0)
     sp.add_argument("--agents", type=int, default=3)
+    sp.add_argument("--gateways", type=int, default=3,
+                    help="federation members (--plan federation)")
     sp.add_argument("--tenants", type=int, default=4)
     sp.add_argument("--rounds", type=int, default=5)
     sp.add_argument("--plan", default="chaos",
-                    help="'chaos', 'rpc', 'none', or a FaultPlan JSON path")
+                    help="'chaos', 'rpc', 'gateway', 'federation', "
+                         "'none', or a FaultPlan JSON path")
     sp.add_argument("--trace", default=None,
                     help="write the fault trace JSONL here")
     sp.add_argument("--no-replication", action="store_true")
@@ -1220,7 +1310,14 @@ def main(argv=None) -> int:
     sp.add_argument("--workload", default="mixed",
                     help="workload mix (see docs/SIM.md)")
     sp.add_argument("--seed", type=int, default=0)
-    sp.add_argument("--backends", type=int, default=3)
+    sp.add_argument("--backends", type=int, default=3,
+                    help="backend pool size (per MEMBER with "
+                         "--federated)")
+    sp.add_argument("--federated", action="store_true",
+                    help="drive the federated tier (gateway/federation"
+                         ".py) instead of one gateway")
+    sp.add_argument("--gateways", type=int, default=3,
+                    help="federation members (with --federated)")
     sp.add_argument("--tenants", type=int, default=4)
     sp.add_argument("--ticks", type=int, default=400,
                     help="gateway pump rounds (1 ms of virtual time each)")

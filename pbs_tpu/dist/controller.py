@@ -25,6 +25,7 @@ import threading
 from typing import Any
 
 from pbs_tpu.dist.rpc import RpcClient, RpcError
+from pbs_tpu.utils.clock import SEC, MonotonicClock
 
 
 class ClusterRoundError(RuntimeError):
@@ -54,6 +55,11 @@ class AgentHandle:
     consecutive_faults: int = 0
     breaker: str = "closed"  # closed | open | half_open
     breaker_cooldown: int = 0
+    #: When the controller last OBSERVED this agent (heartbeat answered
+    #: or missed, op completed or faulted — any interaction that
+    #: informed alive/breaker state). 0 = never observed. The
+    #: backend_health() staleness stamp derives from this.
+    observed_ns: int = 0
 
 
 @dataclasses.dataclass
@@ -81,7 +87,9 @@ class Controller:
                  subject: str = "controller",
                  auth_token: str | None = None,
                  breaker_threshold: int = 3,
-                 breaker_cooldown: int = 2):
+                 breaker_cooldown: int = 2,
+                 clock=None,
+                 health_ttl_ns: int | None = None):
         self.agents: dict[str, AgentHandle] = {}
         self.jobs: dict[str, JobRecord] = {}
         self.dead_after_missed = dead_after_missed
@@ -90,6 +98,19 @@ class Controller:
         #: half-open probe round.
         self.breaker_threshold = breaker_threshold
         self.breaker_cooldown = breaker_cooldown
+        #: Observation-time source (injectable for deterministic tests).
+        self.clock = clock if clock is not None else MonotonicClock()
+        #: Staleness bound on the backend_health() view: an agent not
+        #: observed within this window reads "stale" and the gateway
+        #: treats its entry as unknown instead of trusting it. Default:
+        #: the breaker's half-open window — ``breaker_cooldown``
+        #: heartbeats at the nominal 1 Hz heartbeat cadence.
+        self.health_ttl_ns = (int(health_ttl_ns) if health_ttl_ns is not None
+                              else max(1, int(breaker_cooldown)) * SEC)
+        #: Lease authority for the federated gateway tier — attached by
+        #: FederatedGateway (gateway/federation.py) so per-tenant
+        #: token-bucket levels are leased through the controller.
+        self.admission_broker = None
         self.last_round_errors: dict[str, Exception] = {}
         # XSM identity presented on every job-mutating agent op; under
         # an enforcing agent policy, grant this label (or pass your own).
@@ -123,6 +144,7 @@ class Controller:
                                         max_retries=0),
                         address=(address[0], int(address[1])))
         h.info = h.client.call("info")
+        h.observed_ns = self.clock.now_ns()
         self.agents[name] = h
         return h
 
@@ -142,6 +164,7 @@ class Controller:
         after retries). Enough consecutive faults — or one fault on a
         half-open probe — quarantines the host."""
         h.consecutive_faults += 1
+        h.observed_ns = self.clock.now_ns()  # a fault IS an observation
         if (h.breaker == "half_open"
                 or h.consecutive_faults >= self.breaker_threshold):
             h.breaker = "open"
@@ -150,6 +173,7 @@ class Controller:
     def _op_ok(self, h: AgentHandle) -> None:
         h.consecutive_faults = 0
         h.breaker = "closed"
+        h.observed_ns = self.clock.now_ns()
 
     def _op(self, h: AgentHandle, op: str, **kwargs: Any) -> Any:
         """A mutating agent op with breaker bookkeeping: EVERY op path
@@ -175,6 +199,9 @@ class Controller:
         ``run`` op still reads alive. Returns {agent: alive}."""
 
         def _beat(h: AgentHandle) -> None:
+            # Either outcome is an observation: the view's freshness is
+            # about how recently we LOOKED, not about what we saw.
+            h.observed_ns = self.clock.now_ns()
             if h.probe.try_ping():
                 if not h.alive and not self._reconcile(h):
                     # Fence failed: keep it dead; a later heartbeat
@@ -741,15 +768,56 @@ class Controller:
         per agent — no RPC here, so the gateway's dispatch loop can
         consult it every tick. The gateway vetoes backends whose names
         match agents that are dead or breaker-open, reusing exactly the
-        health state ``place()``/``available_agents()`` rank on."""
+        health state ``place()``/``available_agents()`` rank on.
+
+        Every entry carries its observation time and a ``stale`` flag
+        (older than ``health_ttl_ns``, the breaker's half-open window):
+        a view nobody has refreshed is NOT truth, and the gateway
+        treats stale entries as unknown — no veto, ranked last —
+        instead of trusting them."""
+        now = self.clock.now_ns()
         return {
             name: {
                 "alive": h.alive,
                 "breaker": h.breaker,
                 "load": int(h.info.get("n_jobs", 0)),
+                "observed_ns": h.observed_ns,
+                "stale": now - h.observed_ns > self.health_ttl_ns,
             }
             for name, h in self.agents.items()
         }
+
+    # -- admission leasing (the federated gateway tier's authority) ------
+
+    def attach_admission_broker(self, broker) -> None:
+        """Install the lease authority for federated admission
+        (gateway/federation.py): per-tenant token-bucket levels are
+        minted in one global bank and reach a gateway only through a
+        lease grant routed here, so a tenant spraying requests across N
+        gateways cannot get N× its global rate."""
+        self.admission_broker = broker
+
+    def admission_lease(self, tenant: str, gateway: str, want: float,
+                        now_ns: int, ttl_ns: int):
+        """Grant ``gateway`` up to ``want`` tokens of ``tenant``'s
+        global bucket (bounded by the bank's level) under a lease that
+        expires at ``now_ns + ttl_ns``. Returns the Lease, or None for
+        an unknown tenant."""
+        if self.admission_broker is None:
+            raise RuntimeError("no admission broker attached")
+        return self.admission_broker.grant(tenant, gateway, want,
+                                           now_ns, ttl_ns)
+
+    def admission_deposit(self, tenant: str, gateway: str, tokens: float,
+                          now_ns: int) -> float:
+        """Return a draining gateway's unspent lease tokens to the
+        bank (capped at the global burst; the excess is destroyed —
+        conservative, never inflationary). Returns the amount the bank
+        accepted."""
+        if self.admission_broker is None:
+            raise RuntimeError("no admission broker attached")
+        return self.admission_broker.deposit(tenant, gateway, tokens,
+                                             now_ns)
 
     def cluster_dump(self) -> dict[str, Any]:
         out: dict[str, Any] = {"agents": {}, "jobs": {}}

@@ -25,6 +25,9 @@ point                 fault kinds                                 seam
 ``ckpt.write``        torn, delay                                 ckpt/checkpoint.py
 ``gateway.admit``     shed, delay                                 gateway/gateway.py
 ``gateway.route``     misroute                                    gateway/gateway.py
+``gateway.death``     kill                                        gateway/federation.py
+``gateway.partition``  partition                                  gateway/federation.py
+``lease.expire``      expire                                      gateway/federation.py
 ====================  ==========================================  ==============
 """
 
@@ -45,6 +48,9 @@ POINTS: dict[str, tuple[str, ...]] = {
     "ckpt.write": ("torn", "delay"),
     "gateway.admit": ("shed", "delay"),
     "gateway.route": ("misroute",),
+    "gateway.death": ("kill",),
+    "gateway.partition": ("partition",),
+    "lease.expire": ("expire",),
 }
 
 
@@ -186,4 +192,28 @@ class FaultPlan:
             FaultSpec("gateway.admit", "delay", p=0.05,
                       args={"delay_ns": 2_000_000}),
             FaultSpec("gateway.route", "misroute", p=0.10),
+        )).validate()
+
+    @classmethod
+    def federation(cls, seed: int = 0) -> "FaultPlan":
+        """The ``pbst chaos --plan federation`` plan: the front-door
+        TIER under fire. Gateways die outright (at most once each —
+        streams are keyed by gateway name, and the federation's quorum
+        guard never fences the last front door), partitions come and
+        go, admission-lease renewals are refused (keyed
+        ``gateway:tenant``), and the single-gateway admission/routing
+        faults ride along at reduced rates. The invariants under this
+        plan (docs/GATEWAY.md "Federation"): no admitted request lost
+        across a GATEWAY death, global admitted cost bounded by the
+        global bucket plus the accounted conservative lease slack, and
+        same seed ⇒ same digest."""
+        return cls(seed=seed, specs=(
+            FaultSpec("gateway.death", "kill", p=0.004, after=30,
+                      times=1),
+            FaultSpec("gateway.partition", "partition", p=0.004, times=2,
+                      args={"duration_ns": 25_000_000}),
+            FaultSpec("lease.expire", "expire", p=0.10),
+            FaultSpec("gateway.admit", "shed", p=0.01,
+                      args={"retry_after_ns": 10_000_000}),
+            FaultSpec("gateway.route", "misroute", p=0.05),
         )).validate()

@@ -23,6 +23,13 @@ from pbs_tpu.gateway.admission import (
 )
 from pbs_tpu.gateway.backends import Backend, BatcherBackend, SimServeBackend
 from pbs_tpu.gateway.fairqueue import DeficitRoundRobin, Request
+from pbs_tpu.gateway.federation import (
+    FederatedGateway,
+    HashRing,
+    Lease,
+    LeaseBroker,
+    LeasedBucket,
+)
 from pbs_tpu.gateway.feedback import sched_feedback_sink
 from pbs_tpu.gateway.gateway import (
     GW_LEDGER_SLOTS,
@@ -32,10 +39,10 @@ from pbs_tpu.gateway.gateway import (
 
 
 def __getattr__(name: str):
-    # The chaos harness pulls in the sim workload catalog; keep that
+    # The chaos harnesses pull in the sim workload catalog; keep that
     # import lazy so `pbs_tpu.gateway` stays cheap for serving callers
     # (the same pattern as pbs_tpu.faults.run_chaos).
-    if name in ("run_gateway_chaos", "quota_for"):
+    if name in ("run_gateway_chaos", "run_federation_chaos", "quota_for"):
         from pbs_tpu.gateway import chaos
 
         return getattr(chaos, name)
@@ -48,9 +55,14 @@ __all__ = [
     "Backend",
     "BatcherBackend",
     "DeficitRoundRobin",
+    "FederatedGateway",
     "GW_LEDGER_SLOTS",
     "Gateway",
+    "HashRing",
     "INTERACTIVE",
+    "Lease",
+    "LeaseBroker",
+    "LeasedBucket",
     "Request",
     "SLO_CLASSES",
     "Shed",
@@ -59,6 +71,7 @@ __all__ = [
     "TenantQuota",
     "TokenBucket",
     "quota_for",
+    "run_federation_chaos",
     "run_gateway_chaos",
     "sched_feedback_sink",
 ]

@@ -102,19 +102,33 @@ class AdmissionController:
     """
 
     def __init__(self, max_queued_total: int = 256,
-                 default_quota: TenantQuota | None = None):
+                 default_quota: TenantQuota | None = None,
+                 bucket_factory=None):
         self.max_queued_total = int(max_queued_total)
         #: Quota applied to tenants never registered explicitly; None =
         #: unknown tenants are shed outright (closed-world gateways).
         self.default_quota = default_quota
+        #: The lease path's constructor hook (gateway/federation.py):
+        #: ``(tenant, quota, now_ns) -> bucket`` returning anything with
+        #: TokenBucket's ``take``/``retry_after_ns`` surface. A
+        #: federated gateway installs a factory that builds
+        #: :class:`~pbs_tpu.gateway.federation.LeasedBucket` slices of
+        #: the tenant's GLOBAL bucket; None = plain local TokenBucket.
+        self.bucket_factory = bucket_factory
         self.quotas: dict[str, TenantQuota] = {}
         self._buckets: dict[str, TokenBucket] = {}
         self.sheds: dict[str, int] = {}  # reason -> count
 
+    def _make_bucket(self, tenant: str, quota: TenantQuota,
+                     now_ns: int) -> TokenBucket:
+        if self.bucket_factory is not None:
+            return self.bucket_factory(tenant, quota, now_ns)
+        return TokenBucket(quota.rate, quota.burst, now_ns)
+
     def register(self, tenant: str, quota: TenantQuota,
                  now_ns: int = 0) -> None:
         self.quotas[tenant] = quota
-        self._buckets[tenant] = TokenBucket(quota.rate, quota.burst, now_ns)
+        self._buckets[tenant] = self._make_bucket(tenant, quota, now_ns)
 
     def quota_of(self, tenant: str) -> TenantQuota | None:
         q = self.quotas.get(tenant)
@@ -153,8 +167,8 @@ class AdmissionController:
             return self._shed("cost-over-burst", SEC)
         bucket = self._buckets.get(tenant)
         if bucket is None:  # default-quota tenant: lazily materialize
-            bucket = self._buckets[tenant] = TokenBucket(
-                quota.rate, quota.burst, now_ns)
+            bucket = self._buckets[tenant] = self._make_bucket(
+                tenant, quota, now_ns)
         if not bucket.take(cost, now_ns):
             return self._shed("quota", bucket.retry_after_ns(cost, now_ns))
         return None

@@ -1,25 +1,39 @@
-"""Gateway chaos harness: the front door under a seeded FaultPlan.
+"""Gateway chaos harnesses: the front door (and the front-door TIER)
+under a seeded FaultPlan.
 
-The ``pbst chaos --plan gateway`` engine — the gateway's twin of
-``faults.chaos.run_chaos`` (which attacks the cluster control plane).
-Here the attack surface is the front door itself: injected admission
-sheds, stalled admissions, and misroutes, plus a deterministic backend
-kill mid-run. Everything runs on a :class:`VirtualClock` with seeded
-arrivals, so the run — and therefore the fault-trace digest — is a
-pure function of ``(workload, seed, plan, shape)``.
+``run_gateway_chaos`` is the ``pbst chaos --plan gateway`` engine — the
+gateway's twin of ``faults.chaos.run_chaos`` (which attacks the cluster
+control plane). Here the attack surface is the front door itself:
+injected admission sheds, stalled admissions, and misroutes, plus a
+deterministic backend kill mid-run. ``run_federation_chaos`` is the
+``--plan federation`` engine: N gateways behind consistent-hash
+placement with leased admission (gateway/federation.py), attacked with
+gateway DEATH, partitions, and lease expiries from the plan plus a
+seeded drain + rejoin schedule. Everything runs on a
+:class:`VirtualClock` with seeded arrivals, so each run — and therefore
+its fault-trace digest — is a pure function of ``(workload, seed, plan,
+shape)``.
 
-The invariant this harness exists to gate (docs/GATEWAY.md):
+The invariants these harnesses exist to gate (docs/GATEWAY.md):
 
 - **no admitted request lost** — at every point, ``admitted ==
   completed + queued + inflight``; after the drain phase with a live
-  backend remaining, ``admitted == completed`` exactly. Sheds are only
-  ever explicit (retry-after attached) and only at admission.
-- **determinism** — same seed ⇒ same digest AND same shed/requeue
-  counts (``pbst chaos --plan gateway --selfcheck``).
+  backend (federation: a live gateway) remaining, ``admitted ==
+  completed`` exactly. Sheds are only ever explicit (retry-after
+  attached) and only at admission.
+- **no rate inflation** (federation) — per tenant, every admitted cost
+  unit is token-backed: leased spend traces to bank mints (global
+  rate × time + global burst) and conservative spend — the bounded
+  lease slack — stays under the degraded-mode budget, so spraying N
+  gateways never yields N× the global rate.
+- **determinism** — same seed ⇒ same digest AND same books
+  (``pbst chaos --plan gateway|federation --selfcheck``).
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 from typing import Any
 
 import numpy as np
@@ -28,9 +42,10 @@ from pbs_tpu.faults import injector as faults_mod
 from pbs_tpu.faults.plan import FaultPlan
 from pbs_tpu.gateway.admission import INTERACTIVE, TenantQuota
 from pbs_tpu.gateway.backends import SimServeBackend
+from pbs_tpu.gateway.federation import FederatedGateway
 from pbs_tpu.gateway.gateway import Gateway
 from pbs_tpu.sim.workload import build_workload
-from pbs_tpu.utils.clock import MS, VirtualClock
+from pbs_tpu.utils.clock import MS, SEC, VirtualClock
 
 
 def quota_for(tenant_name: str, slo: str, weight: int) -> TenantQuota:
@@ -42,6 +57,24 @@ def quota_for(tenant_name: str, slo: str, weight: int) -> TenantQuota:
                            slo=slo, max_queued=64)
     return TenantQuota(rate=300.0, burst=120.0, weight=weight,
                        slo=slo, max_queued=128)
+
+
+def catalog_arrivals(tenants, seed: int, tag: int) -> dict:
+    """One independent seeded arrival stream per catalog tenant
+    (``tag`` separates the harnesses' stream families)."""
+    return {t.name: np.random.default_rng([int(seed), int(tag), i])
+            for i, t in enumerate(tenants)}
+
+
+def draw_arrival(t, rng) -> tuple[bool, int]:
+    """This tick's (fire, cost) for one tenant — the shared arrival
+    model both chaos harnesses pin goldens on (interactive: frequent
+    small requests; batch: rare big ones). Draw ORDER is part of the
+    determinism contract: the cost is drawn whether or not it fires."""
+    u = float(rng.random())
+    if t.slo == INTERACTIVE:
+        return u < 0.35, 1 + int(rng.integers(0, 3))
+    return u < 0.15, 4 + int(rng.integers(0, 9))
 
 
 def run_gateway_chaos(workload: str = "mixed", seed: int = 0,
@@ -72,11 +105,10 @@ def run_gateway_chaos(workload: str = "mixed", seed: int = 0,
         tenants = build_workload(workload, seed=seed, n_tenants=n_tenants)
         gw = Gateway(backends, clock=clock, max_queued=64 * len(tenants),
                      trace_capacity=8192, ledger_path=ledger_path)
-        arrivals = {}
-        for i, t in enumerate(tenants):
+        for t in tenants:
             gw.register_tenant(
                 t.name, quota_for(t.name, t.slo, t.params.weight))
-            arrivals[t.name] = np.random.default_rng([int(seed), 7, i])
+        arrivals = catalog_arrivals(tenants, seed, tag=7)
 
         kill_at = ticks // 3 if kill_backend and len(backends) > 1 else -1
         shed_results = 0
@@ -95,12 +127,7 @@ def run_gateway_chaos(workload: str = "mixed", seed: int = 0,
             if tick == kill_at:
                 backends[0].fail()
             for t in tenants:
-                rng = arrivals[t.name]
-                u = float(rng.random())
-                if t.slo == INTERACTIVE:
-                    fire, cost = u < 0.35, 1 + int(rng.integers(0, 3))
-                else:
-                    fire, cost = u < 0.15, 4 + int(rng.integers(0, 9))
+                fire, cost = draw_arrival(t, arrivals[t.name])
                 if not fire:
                     continue
                 r = gw.submit(t.name, {"tick": tick}, cost=cost)
@@ -158,6 +185,219 @@ def run_gateway_chaos(workload: str = "mixed", seed: int = 0,
         "stats": st,
         "faults_fired": dict(sorted(fault_counts.items())),
         "trace_digest": inj.trace_digest(),
+        "problems": problems,
+        "ok": not problems,
+    }
+    return report
+
+
+# -- the federated tier under fire -------------------------------------------
+
+
+def _federation_member(name: str, salt: int, clock, tick_ns: int,
+                       seed: int, n_backends: int,
+                       n_tenants: int) -> Gateway:
+    """One federation member with its own backend pool. Backend seeds
+    derive from (seed, salt, index) so every member's service jitter is
+    an independent, replayable stream. Service runs SLOWER than the
+    tick (3 ticks per cost unit) so queues and in-flight work actually
+    form at the members — a gateway death must reliably catch
+    casualties for the failover path to be under test at all."""
+    backends = [
+        SimServeBackend(f"{name}b{j}", n_slots=2,
+                        service_ns_per_cost=3 * tick_ns,
+                        seed=seed * 1009 + salt * 31 + j)
+        for j in range(max(1, int(n_backends)))
+    ]
+    return Gateway(backends, clock=clock, max_queued=64 * max(1, n_tenants),
+                   name=name)
+
+
+def run_federation_chaos(workload: str = "mixed", seed: int = 0,
+                         n_gateways: int = 3,
+                         backends_per_gateway: int = 2,
+                         n_tenants: int = 4,
+                         ticks: int = 400, tick_ns: int = 1 * MS,
+                         plan: FaultPlan | None = None,
+                         trace_path: str | None = None,
+                         drain_rejoin: bool = True) -> dict:
+    """One seeded federated-gateway chaos scenario; returns the report
+    dict (``ok`` = every invariant held). Gateway deaths, partitions,
+    and lease expiries come from the armed plan; a drain of a seeded
+    victim at ``ticks/3`` and a fresh-member rejoin at ``2·ticks/3``
+    come from the harness schedule (both pure functions of ``seed``).
+    Installs the plan process-wide for the duration."""
+    plan = plan if plan is not None else FaultPlan.federation(seed)
+    inj = faults_mod.install(plan, trace_path=trace_path)
+    problems: list[str] = []
+    try:
+        clock = VirtualClock()
+        members = [
+            _federation_member(f"gw{i}", i, clock, tick_ns, seed,
+                               backends_per_gateway, n_tenants)
+            for i in range(max(1, int(n_gateways)))
+        ]
+        fed = FederatedGateway(members, clock=clock,
+                               renew_period_ns=4 * tick_ns,
+                               lease_ttl_ns=6 * tick_ns)
+        tenants = build_workload(workload, seed=seed, n_tenants=n_tenants)
+        quotas: dict[str, TenantQuota] = {}
+        for t in tenants:
+            quotas[t.name] = quota_for(t.name, t.slo, t.params.weight)
+            fed.register_tenant(t.name, quotas[t.name])
+        arrivals = catalog_arrivals(tenants, seed, tag=11)
+        sched_rng = np.random.default_rng([int(seed), 13])
+        drain_at = ticks // 3 if drain_rejoin else -1
+        rejoin_at = (2 * ticks) // 3 if drain_rejoin else -1
+
+        start_ns = clock.now_ns()
+        admitted_cost: dict[str, float] = {}
+        shed_results = 0
+        completions: list[tuple[str, dict]] = []
+
+        def _check_books(where: str) -> None:
+            acct = fed.completed + fed.queued() + fed.inflight_count()
+            if fed.admitted != acct:
+                problems.append(
+                    f"{where}: admitted {fed.admitted} != completed "
+                    f"{fed.completed} + queued {fed.queued()} + "
+                    f"inflight {fed.inflight_count()}")
+
+        for tick in range(int(ticks)):
+            if tick == drain_at and len(fed.members) > 1:
+                candidates = [n for n in sorted(fed.members)
+                              if n not in fed._draining]
+                if len(candidates) > 1:
+                    victim = candidates[
+                        int(sched_rng.integers(0, len(candidates)))]
+                    fed.drain(victim)
+            if tick == rejoin_at:
+                fed.add(_federation_member(
+                    "gwr0", 97, clock, tick_ns, seed,
+                    backends_per_gateway, n_tenants))
+            for t in tenants:
+                fire, cost = draw_arrival(t, arrivals[t.name])
+                if not fire:
+                    continue
+                r = fed.submit(t.name, {"tick": tick}, cost=cost)
+                if r.admitted:
+                    admitted_cost[t.name] = \
+                        admitted_cost.get(t.name, 0.0) + cost
+                else:
+                    shed_results += 1
+                    if r.retry_after_ns <= 0:
+                        problems.append(
+                            f"shed of {t.name} at tick {tick} carries "
+                            f"no retry-after ({r.reason})")
+            completions.extend(fed.tick())
+            if tick % 50 == 0:
+                _check_books(f"tick {tick}")
+            clock.advance(tick_ns)
+
+        # Drain: no new arrivals; pump until idle (bounded — partitions
+        # heal on the same clock, so convergence only needs ticks).
+        for _ in range(int(ticks) * 6):
+            if not fed.busy():
+                break
+            completions.extend(fed.tick())
+            clock.advance(tick_ns)
+
+        _check_books("end")
+        if fed.busy():
+            problems.append(
+                f"drain did not converge: queued {fed.queued()}, "
+                f"inflight {fed.inflight_count()}")
+        elif fed.admitted != fed.completed:
+            problems.append(
+                f"admitted requests lost across gateway death: "
+                f"admitted {fed.admitted}, completed {fed.completed}")
+        seen_rids: set[str] = set()
+        for rid, _ in completions:
+            if rid in seen_rids:
+                problems.append(f"request {rid} completed twice")
+            seen_rids.add(rid)
+
+        # No-rate-inflation: every admitted cost unit is token-backed.
+        elapsed_s = (clock.now_ns() - start_ns) / SEC
+        audit = fed.lease_audit()
+        for tname, a in sorted(audit.items()):
+            q = quotas.get(tname)
+            if q is None:  # default-quota tenant (not in this harness)
+                continue
+            eps = 1e-6 * max(1.0, a["granted"])
+            # Deposited tokens legitimately cycle back out (drain →
+            # deposit → re-grant), so the issue bound is gross:
+            # everything granted traces to a mint or a return.
+            if a["granted"] > a["minted"] + a["deposited"] + eps:
+                problems.append(
+                    f"{tname}: bank over-issued (granted "
+                    f"{a['granted']:.3f} > minted {a['minted']:.3f} "
+                    f"+ deposited {a['deposited']:.3f})")
+            if a["minted"] > q.burst + q.rate * elapsed_s + 1e-6:
+                problems.append(
+                    f"{tname}: minted {a['minted']:.3f} beyond "
+                    f"burst + rate*t = "
+                    f"{q.burst + q.rate * elapsed_s:.3f}")
+            accounted = (a["leased_spent"] + a["held"] + a["deposited"]
+                         + a["destroyed"])
+            if accounted > a["granted"] + eps:
+                problems.append(
+                    f"{tname}: token conservation violated "
+                    f"(spent+held+deposited+destroyed {accounted:.3f} "
+                    f"> granted {a['granted']:.3f})")
+            cost = admitted_cost.get(tname, 0.0)
+            backed = a["leased_spent"] + a["conservative_spent"]
+            if abs(cost - backed) > 1e-6 * max(1.0, cost):
+                problems.append(
+                    f"{tname}: admitted cost {cost:.3f} not token-"
+                    f"backed (leased+conservative = {backed:.3f})")
+            # The bounded lease slack: conservative fraction is at most
+            # 1/(2N) per member, so even every member degraded at once
+            # stays under half the global budget.
+            slack_bound = 0.5 * (q.rate * elapsed_s + q.burst) + 1e-6
+            if a["conservative_spent"] > slack_bound:
+                problems.append(
+                    f"{tname}: conservative slack "
+                    f"{a['conservative_spent']:.3f} exceeds bound "
+                    f"{slack_bound:.3f}")
+        st = fed.stats()
+        shed_books = sum(st["shed"].values())
+        if shed_results != shed_books:
+            problems.append(
+                f"shed accounting drift: {shed_results} shed results, "
+                f"{shed_books} in the books")
+    finally:
+        faults_mod.uninstall()
+
+    fault_counts: dict[str, int] = {}
+    for rec in inj.records:
+        k = f"{rec['point']}:{rec['fault']}"
+        fault_counts[k] = fault_counts.get(k, 0) + 1
+    if trace_path is not None:
+        inj.write_trace()
+    events = [{"tick_ns": e["now_ns"], "event": e["event"],
+               "gateway": e["gateway"]} for e in fed.events]
+    # The scenario digest: a second determinism witness over the BOOKS
+    # (the fault-trace digest only proves the injector replayed; this
+    # proves the federation's response did too).
+    digest_src = json.dumps({
+        "admitted": fed.admitted, "completed": fed.completed,
+        "handoffs": fed.handoffs, "events": events,
+        "admitted_cost": {k: round(v, 6)
+                          for k, v in sorted(admitted_cost.items())},
+        "shed": st["shed"],
+    }, sort_keys=True, separators=(",", ":"))
+    report: dict[str, Any] = {
+        "workload": workload, "seed": seed, "gateways": n_gateways,
+        "tenants": n_tenants, "ticks": ticks,
+        "plan": plan.as_dict(),
+        "events": events,
+        "stats": st,
+        "lease_audit": {t: {k: round(v, 6) for k, v in a.items()}
+                        for t, a in sorted(audit.items())},
+        "faults_fired": dict(sorted(fault_counts.items())),
+        "trace_digest": inj.trace_digest(),
+        "report_digest": hashlib.sha256(digest_src.encode()).hexdigest(),
         "problems": problems,
         "ok": not problems,
     }

@@ -116,6 +116,49 @@ class DeficitRoundRobin:
         d[req.tenant] = max(d.get(req.tenant, 0.0), float(req.cost))
         self._depth += 1
 
+    # -- federation handoff (docs/GATEWAY.md "Federation") ---------------
+
+    def take_tenant(self, cls: str, tenant: str
+                    ) -> tuple[list[Request], float]:
+        """Remove and return a tenant's queued FIFO and its carried DRR
+        deficit — the handoff payload a draining or dead gateway hands
+        to the federation. The requests keep their FIFO order and the
+        deficit travels with them, so the tenant resumes its dispatch
+        cycle at the adopting gateway instead of restarting with fresh
+        credit (or, worse, forfeiting credit it had already earned)."""
+        fifo = self._fifos[cls].pop(tenant, None)
+        reqs = list(fifo) if fifo else []
+        self._depth -= len(reqs)
+        deficit = self._deficit[cls].pop(tenant, 0.0)
+        try:
+            self._ring[cls].remove(tenant)
+        except ValueError:
+            pass  # tenant had nothing queued here
+        return reqs, deficit
+
+    def restore_tenant(self, cls: str, tenant: str,
+                       requests: list[Request],
+                       deficit: float = 0.0) -> None:
+        """Inverse of :meth:`take_tenant` at the adopting gateway:
+        requests enter at the FRONT in their original order (they are
+        casualties of a gateway drain/death being repaired, not new
+        arrivals) and the carried deficit merges with any local credit
+        (max, never sum — a handoff must not double a tenant's
+        credit)."""
+        if not requests:
+            return
+        fifo = self._activate(cls, tenant, front=True)
+        for r in reversed(requests):
+            fifo.appendleft(r)
+        self._depth += len(requests)
+        d = self._deficit[cls]
+        d[tenant] = max(d.get(tenant, 0.0), float(deficit))
+
+    def tenants(self, cls: str) -> list[str]:
+        """Tenants with queued requests in ``cls``, sorted (the
+        deterministic iteration order handoff loops rely on)."""
+        return sorted(t for t, f in self._fifos[cls].items() if f)
+
     # -- dispatch order --------------------------------------------------
 
     def _quantum_for(self, tenant: str) -> float:

@@ -1,0 +1,848 @@
+"""Federated gateway tier: N front doors, one global admission contract.
+
+ROADMAP item 1: a single :class:`~pbs_tpu.gateway.gateway.Gateway` pump
+is the serialization point — and the single point of failure — for
+every tenant. This module shards the front door itself, on the XOS
+model (PAPERS.md, arXiv 1901.00825): per-tenant policy (admission
+state, SLO, queue credit) travels with the *tenant*, never dies with
+the *box*.
+
+- **Placement** — consistent-hash tenant→gateway placement
+  (:class:`HashRing`, sha256 virtual nodes). A membership change
+  (add/drain/death) remaps only the arcs the changed node owned —
+  ~K/N of tenants, never a full reshuffle. The property tests pin the
+  exact form: removal moves only the removed node's tenants; an add
+  steals tenants only for the new node.
+
+- **Replicated admission** — per-tenant token-bucket levels are leased
+  through one authority (:class:`LeaseBroker`; routed through the dist
+  :class:`~pbs_tpu.dist.controller.Controller` when one is attached).
+  Tokens are *minted* only at the bank (global rate × time, capped by
+  the global burst) and reach a gateway only through a lease grant, so
+  a tenant spraying requests across N gateways cannot get N× its
+  global rate: every admitted cost unit is traceable to a mint, and
+  ``lease_audit()`` proves it. When a lease lapses (authority
+  unreachable, injected ``lease.expire``), admission *degrades* to a
+  conservative local bucket (:class:`LeasedBucket`) instead of
+  stalling — small requests keep flowing at a fraction of the fair
+  share, and the scrip this mints is accounted separately
+  (``conservative_spent``, the "bounded lease slack" the chaos
+  harness asserts small).
+
+- **Failover** — the PR 4 invariant hardens from *backend* death to
+  *gateway* death: "admitted ⇒ completed-or-requeued, never lost."
+  The federation holds the authoritative record of each member's
+  queue and inflight table; a killed member's requests hand off to
+  the survivors per the new ring — FIFO order preserved, DRR deficits
+  carried (``DeficitRoundRobin.take_tenant``/``restore_tenant``) — and
+  a *draining* member additionally deposits its unspent lease tokens
+  back to the bank. A dead member's unspent tokens die with it
+  (``destroyed``: accounted, never re-minted — conservative).
+
+Single-threaded like the member gateways: the owner pumps ``tick()``.
+The fault seams (``gateway.death``, ``gateway.partition``,
+``lease.expire``) are consulted in sorted member order, so a seeded
+:class:`~pbs_tpu.faults.plan.FaultPlan` replays exactly
+(docs/FAULTS.md; ``pbst chaos --plan federation``).
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import hashlib
+
+from pbs_tpu.faults import injector as _faults
+from pbs_tpu.gateway.admission import SLO_CLASSES, TenantQuota, TokenBucket
+from pbs_tpu.gateway.gateway import Gateway, SubmitResult
+from pbs_tpu.utils.clock import MS, SEC
+
+#: Default lease cadence: renew every period, die after ttl. The ttl is
+#: deliberately < 2 renew periods, so ONE refused renewal opens a short
+#: degraded window — lease loss is a condition the tier lives with, not
+#: an edge case.
+DEFAULT_RENEW_PERIOD_NS = 4 * MS
+DEFAULT_LEASE_TTL_NS = 6 * MS
+
+
+def _hash64(key: str) -> int:
+    """Stable 64-bit point on the ring. sha256, never ``hash()`` — str
+    hashing is salted per process and would silently reshuffle every
+    placement on restart (the injector's rule)."""
+    return int.from_bytes(hashlib.sha256(key.encode()).digest()[:8], "big")
+
+
+class HashRing:
+    """Consistent-hash placement with virtual nodes.
+
+    Each node owns ``vnodes`` points on a 2^64 ring; a key maps to the
+    first node point at or after its hash (wrapping). Disruption on
+    membership change is therefore bounded to the changed node's own
+    arcs: removal remaps exactly the keys it owned (~K/N), and an add
+    steals keys only for itself.
+    """
+
+    def __init__(self, vnodes: int = 64):
+        if vnodes < 1:
+            raise ValueError("vnodes must be >= 1")
+        self.vnodes = int(vnodes)
+        self._nodes: set[str] = set()
+        self._points: list[int] = []
+        self._owners: list[str] = []
+
+    def _rebuild(self) -> None:
+        pairs = sorted(
+            (_hash64(f"{node}#{i}"), node)
+            for node in self._nodes for i in range(self.vnodes))
+        self._points = [p for p, _ in pairs]
+        self._owners = [o for _, o in pairs]
+
+    def add(self, node: str) -> None:
+        if node in self._nodes:
+            raise ValueError(f"ring already has node {node!r}")
+        self._nodes.add(node)
+        self._rebuild()
+
+    def remove(self, node: str) -> None:
+        """Remove if present (idempotent: a drained member has already
+        left the ring when its death is reported)."""
+        if node in self._nodes:
+            self._nodes.discard(node)
+            self._rebuild()
+
+    def nodes(self) -> list[str]:
+        return sorted(self._nodes)
+
+    def lookup(self, key: str) -> str | None:
+        if not self._points:
+            return None
+        i = bisect.bisect_left(self._points, _hash64(key))
+        if i == len(self._points):
+            i = 0  # wrap
+        return self._owners[i]
+
+
+# -- the lease protocol ------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Lease:
+    """One grant: ``tokens`` left the bank for ``gateway``'s slice of
+    ``tenant``'s bucket, valid until ``expires_ns``. A lease with 0
+    tokens is still a lease — validity means the authority answered;
+    the token count is just the allowance it could afford."""
+
+    tenant: str
+    gateway: str
+    tokens: float
+    expires_ns: int
+
+
+class GlobalBucket:
+    """The bank: a tenant's one true token supply.
+
+    Tokens are *minted* here only — ``rate`` per second, capped by the
+    ``burst`` headroom — and only leave through :meth:`grant`. The
+    mint/grant/deposit odometers never reset, so conservation is
+    checkable after any run: ``granted <= minted`` and
+    ``spent + held + deposited + destroyed <= granted``.
+    """
+
+    def __init__(self, rate: float, burst: float, now_ns: int):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.level = float(burst)
+        self.minted = float(burst)
+        self.granted = 0.0
+        self.deposited = 0.0
+        self._last_ns = int(now_ns)
+
+    def _refill(self, now_ns: int) -> None:
+        dt_ns = max(0, int(now_ns) - self._last_ns)
+        self._last_ns = max(self._last_ns, int(now_ns))
+        mint = min(self.rate * dt_ns / SEC, self.burst - self.level)
+        if mint > 0:
+            self.level += mint
+            self.minted += mint
+
+    def grant(self, want: float, now_ns: int) -> float:
+        self._refill(now_ns)
+        x = min(float(want), self.level)
+        if x <= 0:
+            return 0.0
+        self.level -= x
+        self.granted += x
+        return x
+
+    def deposit(self, tokens: float, now_ns: int) -> float:
+        """Accept returned tokens up to the burst headroom; the excess
+        is destroyed (conservative — a deposit must never let the bank
+        exceed the burst it advertises). Returns the accepted amount."""
+        self._refill(now_ns)
+        x = min(float(tokens), self.burst - self.level)
+        if x <= 0:
+            return 0.0
+        self.level += x
+        self.deposited += x
+        return x
+
+
+class LeaseBroker:
+    """The lease authority: one :class:`GlobalBucket` per tenant plus
+    the active lease table. In clustered deployments this attaches to
+    the dist Controller (``Controller.attach_admission_broker``) so
+    grants ride the controller surface; standalone federations own one
+    directly. All methods are driven from the federation's
+    single-threaded pump."""
+
+    def __init__(self) -> None:
+        self.banks: dict[str, GlobalBucket] = {}
+        self.quotas: dict[str, TenantQuota] = {}
+        self.leases: dict[tuple[str, str], Lease] = {}
+
+    def register(self, tenant: str, quota: TenantQuota,
+                 now_ns: int) -> None:
+        if tenant not in self.banks:
+            self.banks[tenant] = GlobalBucket(quota.rate, quota.burst,
+                                              now_ns)
+            self.quotas[tenant] = quota
+
+    def grant(self, tenant: str, gateway: str, want: float,
+              now_ns: int, ttl_ns: int) -> Lease | None:
+        bank = self.banks.get(tenant)
+        if bank is None:
+            return None
+        tokens = bank.grant(want, now_ns)
+        lease = Lease(tenant, gateway, tokens, int(now_ns) + int(ttl_ns))
+        self.leases[(tenant, gateway)] = lease
+        return lease
+
+    def deposit(self, tenant: str, gateway: str, tokens: float,
+                now_ns: int) -> float:
+        bank = self.banks.get(tenant)
+        if bank is None:
+            return 0.0
+        self.leases.pop((tenant, gateway), None)
+        return bank.deposit(tokens, now_ns)
+
+    def revoke(self, gateway: str) -> None:
+        """Forget a retired gateway's leases — its tokens either came
+        back through deposits (drain) or died with the box (death);
+        either way the active-lease table must not keep advertising a
+        dead member as a holder."""
+        for k in [k for k in self.leases if k[1] == gateway]:
+            del self.leases[k]
+
+    def audit(self) -> dict[str, dict[str, float]]:
+        """Per-tenant odometers — one half of the no-rate-inflation
+        witness (the federation's ``lease_audit`` joins the gateway
+        half)."""
+        return {
+            t: {"minted": b.minted, "granted": b.granted,
+                "deposited": b.deposited, "bank_level": b.level}
+            for t, b in sorted(self.banks.items())
+        }
+
+
+class LeasedBucket:
+    """A gateway's slice of one tenant's global bucket. Duck-types
+    :class:`~pbs_tpu.gateway.admission.TokenBucket`'s
+    ``take``/``retry_after_ns`` surface, so the admission controller
+    is unchanged — only the token *source* differs:
+
+    - **leased** — ``level`` holds prepaid tokens that arrived through
+      :meth:`credit` (a broker grant). No local minting: sustained
+      rate is whatever the bank can afford, which is the tenant's
+      global rate split across its gateways.
+    - **degraded** — the lease lapsed (authority unreachable, or an
+      injected ``lease.expire`` refused the renewal). Prepaid tokens
+      remain spendable (they were genuinely granted — the bank never
+      reclaims granted tokens, so spending them cannot double-issue),
+      and beyond them a conservative emergency bucket mints scrip at a
+      small fraction of the fair share, *starting empty* — degradation
+      mints by time spent degraded, never by the transition itself.
+      Successful renewal drops the emergency bucket (unspent scrip
+      expires) and resumes the leased mode.
+
+    ``leased_spent`` / ``conservative_spent`` are the odometers the
+    no-rate-inflation audit reads: every admitted cost unit is one or
+    the other.
+    """
+
+    def __init__(self, tenant: str, gateway: str, quota: TenantQuota,
+                 capacity: float, conservative_rate: float,
+                 conservative_burst: float, renew_period_ns: int,
+                 now_ns: int):
+        self.tenant = tenant
+        self.gateway = gateway
+        self.quota = quota
+        self.capacity = float(capacity)  # slice cap; re-sliced on N change
+        self.level = 0.0  # prepaid tokens (grants only)
+        #: A legal request bigger than the slice (cost in (capacity,
+        #: burst]) cannot be covered by capacity-bounded top-ups alone —
+        #: without this it would shed "quota" with a retry hint that can
+        #: never come true. A failed oversized take records the need and
+        #: the next renewals borrow toward it (never past the global
+        #: burst, and still only what the bank can afford), exactly what
+        #: the one-gateway bucket would have held anyway.
+        self.pending_need = 0.0
+        self.expires_ns = int(now_ns)  # no lease yet
+        self.renew_period_ns = int(renew_period_ns)
+        self.leased_spent = 0.0
+        self.conservative_spent = 0.0
+        self.degraded_takes = 0
+        self._cons_rate = float(conservative_rate)
+        self._cons_burst = float(conservative_burst)
+        self._cons: TokenBucket | None = None
+
+    def leased(self, now_ns: int) -> bool:
+        return int(now_ns) < self.expires_ns
+
+    def reslice(self, capacity: float, conservative_rate: float,
+                conservative_burst: float) -> None:
+        """Membership changed: both the slice cap AND the degraded-mode
+        floor re-split, so Σ slice caps ≤ global burst and Σ emergency
+        rates stay ≤ half the global rate whatever N becomes (a floor
+        pinned at creation time would sum past the bound after
+        add/remove cycles). A live emergency bucket re-rates in place,
+        its level clamped to the new burst — never minted by the
+        change."""
+        self.capacity = float(capacity)
+        self._cons_rate = float(conservative_rate)
+        self._cons_burst = float(conservative_burst)
+        if self._cons is not None:
+            self._cons.rate = self._cons_rate
+            self._cons.burst = self._cons_burst
+            self._cons.level = min(self._cons.level, self._cons_burst)
+
+    def credit(self, tokens: float, now_ns: int, ttl_ns: int) -> None:
+        """The lease path: a broker grant lands here, and ONLY here —
+        this is the sole writer of leased level besides ``take`` (the
+        ``gw-lease-bypass`` check flags any other)."""
+        self.level += float(tokens)
+        self.expires_ns = int(now_ns) + int(ttl_ns)
+        self._cons = None  # recovery: unspent emergency scrip expires
+
+    def _emergency(self, now_ns: int) -> TokenBucket:
+        if self._cons is None:
+            cons = TokenBucket(self._cons_rate, self._cons_burst, now_ns)
+            cons.level = 0.0  # scrip accrues with degraded TIME only
+            self._cons = cons
+        return self._cons
+
+    def take(self, cost: float, now_ns: int) -> bool:
+        if self.level >= cost:
+            self.level -= cost
+            self.leased_spent += cost
+            if cost >= self.pending_need:
+                # The starving request (or a bigger one) got served.
+                # A SMALLER take must not clear the flag — interleaved
+                # small traffic would forever reset the borrow target
+                # before a renewal could reach it.
+                self.pending_need = 0.0
+            return True
+        if cost > self.capacity:
+            # Oversized-but-legal (leased OR degraded): flag the borrow
+            # target so the renewal loop — resuming renewals counts —
+            # can accumulate past the slice cap.
+            self.pending_need = max(self.pending_need,
+                                    min(float(cost), self.quota.burst))
+        if self.leased(now_ns):
+            return False  # in-lease exhaustion: wait for the next top-up
+        self.degraded_takes += 1
+        if self._emergency(now_ns).take(cost, now_ns):
+            self.conservative_spent += cost
+            return True
+        return False
+
+    def retry_after_ns(self, cost: float, now_ns: int) -> int:
+        if self.leased(now_ns):
+            return max(1, self.renew_period_ns)
+        if cost > self._cons_burst:
+            # The emergency bucket can NEVER cover this request; its
+            # refill horizon would be a retry hint that cannot come
+            # true (the admission module's cost-over-burst lesson).
+            # The honest hint is the lease-recovery cadence.
+            return max(1, self.renew_period_ns)
+        return self._emergency(now_ns).retry_after_ns(cost, now_ns)
+
+
+# -- the federation ----------------------------------------------------------
+
+
+class FederatedGateway:
+    """N member gateways behind one submit surface.
+
+    Members arrive fully built (each with its own backends) and MUST
+    share the federation's clock — placement, leases, and the fault
+    schedule are all functions of one timeline. The federation routes
+    ``submit`` by consistent hash (falling back to the least-loaded
+    serviceable member when the home is dead, draining, partitioned,
+    or has no routable backend under a FRESH controller health view),
+    pumps every live member in ``tick``, renews admission leases, and
+    repairs membership changes with requeue handoff.
+    """
+
+    def __init__(self, members: list[Gateway], controller=None,
+                 clock=None, vnodes: int = 64,
+                 renew_period_ns: int = DEFAULT_RENEW_PERIOD_NS,
+                 lease_ttl_ns: int = DEFAULT_LEASE_TTL_NS,
+                 conservative_frac: float | None = None):
+        if not members:
+            raise ValueError("federation needs at least one gateway")
+        self.clock = clock if clock is not None else members[0].clock
+        self.controller = controller
+        self.broker = LeaseBroker()
+        if controller is not None and hasattr(controller,
+                                              "attach_admission_broker"):
+            controller.attach_admission_broker(self.broker)
+        self.ring = HashRing(vnodes)
+        self.renew_period_ns = int(renew_period_ns)
+        self.lease_ttl_ns = int(lease_ttl_ns)
+        #: Emergency-bucket share of the fair share when a lease lapses;
+        #: None = 1/(2·N) at bucket-creation time, so even every member
+        #: degrading at once stays under half the global rate.
+        self.conservative_frac = conservative_frac
+        self.members: dict[str, Gateway] = {}
+        self.quotas: dict[str, TenantQuota] = {}
+        self._draining: set[str] = set()
+        self._partitioned: dict[str, int] = {}  # name -> heal deadline
+        self._retired: list[Gateway] = []  # dead/removed, kept for audit
+        self.admitted = 0
+        self.completed = 0
+        self.handoffs = 0
+        self.remaps = 0  # membership changes (ring epochs)
+        self.lease_refusals = 0
+        self.fed_sheds: dict[str, int] = {}
+        self.destroyed: dict[str, float] = {}  # tokens dead boxes took down
+        self.events: list[dict] = []
+        self._last_renew_ns: int | None = None
+        self._health_cache: tuple[int, dict] = (-1, {})
+        for gw in members:
+            self._attach(gw)
+
+    # -- membership ------------------------------------------------------
+
+    def _attach(self, gw: Gateway) -> None:
+        if gw.name in self.members:
+            raise ValueError(f"duplicate gateway name {gw.name!r}")
+        if gw.clock is not self.clock:
+            raise ValueError(
+                f"gateway {gw.name!r} does not share the federation "
+                "clock; placement and leases need one timeline")
+        if gw.admission.quotas or gw.admission._buckets:
+            # A member arriving with its OWN registered tenants holds
+            # plain local buckets that mint at the full tenant rate —
+            # an invisible bypass of the federation's global-rate
+            # contract (absent from lease_audit, N× for a sprayer).
+            raise ValueError(
+                f"gateway {gw.name!r} has locally registered tenants "
+                f"({sorted(gw.admission.quotas) or sorted(gw.admission._buckets)}); "
+                "members join bare — register tenants through "
+                "FederatedGateway.register_tenant, the lease path")
+        self.members[gw.name] = gw
+        gw.admission.bucket_factory = self._bucket_factory(gw.name)
+        self.ring.add(gw.name)
+
+    def _bucket_factory(self, gw_name: str):
+        def make(tenant: str, quota: TenantQuota,
+                 now_ns: int) -> LeasedBucket:
+            n = self._slice_count()
+            frac = self._conservative_share(n)
+            return LeasedBucket(
+                tenant, gw_name, quota,
+                capacity=quota.burst / n,
+                conservative_rate=quota.rate * frac,
+                conservative_burst=max(1.0, quota.burst * frac),
+                renew_period_ns=self.renew_period_ns, now_ns=now_ns)
+        return make
+
+    def _slice_count(self) -> int:
+        """Members that hold admission slices: active and not draining
+        (a draining member deposited its tokens back and takes no new
+        submissions)."""
+        return max(1, len([n for n in self.members
+                           if n not in self._draining]))
+
+    def _conservative_share(self, n: int) -> float:
+        return (self.conservative_frac
+                if self.conservative_frac is not None
+                else 1.0 / (2.0 * n))
+
+    def _reslice(self) -> None:
+        """Recompute slice capacities AND degraded-mode floors after a
+        membership change: the global burst stays split across the
+        members that can admit (Σ caps ≤ burst), and the conservative
+        emergency rates re-split too (Σ ≤ rate/2) — a floor pinned at
+        bucket-creation N would sum past the global rate after enough
+        add/remove cycles."""
+        n = self._slice_count()
+        frac = self._conservative_share(n)
+        for gw in self.members.values():
+            for b in gw.admission._buckets.values():
+                if isinstance(b, LeasedBucket):
+                    b.reslice(b.quota.burst / n, b.quota.rate * frac,
+                              max(1.0, b.quota.burst * frac))
+
+    def add(self, gw: Gateway) -> None:
+        """Live membership add (scale-out or rejoin): the new member
+        takes over only its own ring arcs (~K/N tenants remap to it),
+        learns every known tenant, and gets initial leases."""
+        now = self.clock.now_ns()
+        self._attach(gw)
+        for tenant, quota in sorted(self.quotas.items()):
+            gw.register_tenant(tenant, quota, now_ns=now)
+        self._reslice()
+        self.remaps += 1
+        self.events.append({"now_ns": now, "event": "add",
+                            "gateway": gw.name})
+        self._renew_all(now, force=True)
+
+    def drain(self, name: str) -> None:
+        """Graceful removal, phase 1: leave the ring (new placements
+        remap immediately), hand queued requests off NOW — FIFO order
+        and DRR deficits carried — and deposit unspent lease tokens
+        back to the bank. The member keeps pumping until its inflight
+        requests complete; ``tick`` retires it at zero."""
+        gw = self.members[name]
+        if name in self._draining:
+            return
+        now = self.clock.now_ns()
+        self.events.append({"now_ns": now, "event": "drain",
+                            "gateway": name})
+        self.ring.remove(name)
+        self._draining.add(name)
+        for tenant in sorted(gw.admission._buckets):
+            b = gw.admission._buckets[tenant]
+            if isinstance(b, LeasedBucket) and b.level > 0:
+                self._deposit(tenant, name, b.level, now)
+                b.level = 0.0
+                b.expires_ns = now  # lease released
+        self._handoff_queued(gw)
+        self._reslice()
+        self.remaps += 1
+
+    def kill(self, name: str) -> None:
+        """Gateway death: the front door dies with requests queued,
+        requests inflight on its backends, and unspent lease tokens.
+        The federation — the authoritative record of every member's
+        state, the controller's view of the box — repairs it: queued
+        FIFOs hand off with their deficits, inflight casualties requeue
+        at the survivors' front (oldest first), the dead box's backends
+        are fenced, and its unspent tokens are accounted ``destroyed``
+        (never re-minted: death is conservative, not inflationary)."""
+        gw = self.members.pop(name)  # no longer an adoption target
+        now = self.clock.now_ns()
+        self.events.append({"now_ns": now, "event": "kill",
+                            "gateway": name})
+        self.ring.remove(name)
+        self._draining.discard(name)
+        self._partitioned.pop(name, None)
+        for b in gw.backends:
+            fail = getattr(b, "fail", None)
+            if fail is not None:
+                fail()
+        for tenant in sorted(gw.admission._buckets):
+            b = gw.admission._buckets[tenant]
+            if isinstance(b, LeasedBucket) and b.level > 0:
+                self.destroyed[tenant] = (
+                    self.destroyed.get(tenant, 0.0) + b.level)
+                b.level = 0.0
+        self._reslice()
+        self.remaps += 1
+        self._handoff_queued(gw)
+        # Inflight casualties: requeue_front per request, so iterate
+        # newest-first — the oldest casualty must end up at the head.
+        casualties = sorted(gw.inflight.values(),
+                            key=lambda r: (r.submit_ns, r.rid),
+                            reverse=True)
+        gw.inflight.clear()
+        for req in casualties:
+            target = self._handoff_target(req.tenant)
+            target.adopt(req)
+            self.handoffs += 1
+        self.broker.revoke(name)
+        self._retired.append(gw)
+
+    def _handoff_queued(self, gw: Gateway) -> None:
+        for cls in SLO_CLASSES:
+            for tenant in gw.queue.tenants(cls):
+                reqs, deficit = gw.queue.take_tenant(cls, tenant)
+                if not reqs:
+                    continue
+                target = self._handoff_target(tenant)
+                target.adopt_tenant(cls, tenant, reqs, deficit)
+                self.handoffs += len(reqs)
+
+    def _handoff_target(self, tenant: str) -> Gateway:
+        """The adopting member for a casualty: the tenant's new home if
+        routable, else the least-loaded unpartitioned member, else ANY
+        remaining member (a draining or partitioned member adopting
+        work delays its exit — never-lost beats drain latency)."""
+        home = self.ring.lookup(tenant)
+        if home is not None and home in self.members \
+                and home not in self._partitioned:
+            return self.members[home]
+        ranked = sorted(self.members.items())
+        pool = ([g for n, g in ranked if n not in self._partitioned
+                 and n not in self._draining]
+                or [g for n, g in ranked if n not in self._draining]
+                or [g for _, g in ranked])
+        if not pool:
+            raise RuntimeError("no gateway left to adopt casualties")
+        return min(pool, key=lambda g: (self._member_load(g), g.name))
+
+    def _retire(self, name: str) -> None:
+        gw = self.members.pop(name)
+        self._draining.discard(name)
+        self._partitioned.pop(name, None)
+        self.ring.remove(name)
+        self.broker.revoke(name)
+        self._retired.append(gw)
+
+    # -- tenants ---------------------------------------------------------
+
+    def register_tenant(self, tenant: str, quota: TenantQuota) -> None:
+        now = self.clock.now_ns()
+        self.quotas[tenant] = quota
+        self.broker.register(tenant, quota, now)
+        for name in sorted(self.members):
+            self.members[name].register_tenant(tenant, quota, now_ns=now)
+        # Initial grants for THIS tenant only — a full renewal round
+        # here would be O(T²·N) over a registration loop and would
+        # consume other tenants' lease.expire fault streams before the
+        # run starts.
+        for name in sorted(self.members):
+            if name in self._partitioned or name in self._draining:
+                continue
+            b = self.members[name].admission._buckets.get(tenant)
+            if isinstance(b, LeasedBucket):
+                self._renew_one(name, tenant, b, now)
+
+    # -- routing + intake ------------------------------------------------
+
+    def _member_load(self, gw: Gateway) -> int:
+        return gw.queue.depth() + len(gw.inflight)
+
+    def _member_serviceable(self, gw: Gateway, health: dict) -> bool:
+        """At least one backend could take a dispatch: alive, and not
+        vetoed by a FRESH controller health entry (stale entries are
+        unknown, not verdicts — the staleness satellite's rule)."""
+        for b in gw.backends:
+            if not b.alive():
+                continue
+            h = health.get(b.name)
+            if (h is not None and not h.get("stale", False)
+                    and (not h["alive"] or h["breaker"] == "open")):
+                continue
+            return True
+        return False
+
+    def _health(self) -> dict:
+        """The controller view, snapshotted once per clock instant —
+        submit bursts within a tick reuse it instead of rebuilding the
+        per-agent dict per request (the member pumps' once-per-tick
+        discipline, applied to intake)."""
+        if self.controller is None:
+            return {}
+        now = self.clock.now_ns()
+        stamp, view = self._health_cache
+        if stamp != now:
+            view = self.controller.backend_health()
+            self._health_cache = (now, view)
+        return view
+
+    def route(self, tenant: str) -> Gateway | None:
+        """The tenant's home member per the ring, or — when the home is
+        dead, draining, partitioned, or has no routable backend — the
+        least-loaded serviceable member (cross-gateway least-loaded
+        routing over the same ``Controller.backend_health()`` view the
+        member pumps use). None = no front door can serve at all."""
+        health = self._health()
+        live = [self.members[n] for n in sorted(self.members)
+                if n not in self._partitioned and n not in self._draining]
+        live = [g for g in live if self._member_serviceable(g, health)]
+        if not live:
+            return None
+        home = self.ring.lookup(tenant)
+        for g in live:
+            if g.name == home:
+                return g
+        return min(live, key=lambda g: (self._member_load(g), g.name))
+
+    def submit(self, tenant: str, payload, cost: int = 1,
+               slo: str | None = None) -> SubmitResult:
+        target = self.route(tenant)
+        if target is None:
+            # Every front door is dead/partitioned: an explicit shed
+            # with a backoff hint, never a hang or a silent drop.
+            self.fed_sheds["no-gateway"] = \
+                self.fed_sheds.get("no-gateway", 0) + 1
+            return SubmitResult(False, None, "no-gateway", 50 * MS)
+        r = target.submit(tenant, payload, cost=cost, slo=slo)
+        if r.admitted:
+            self.admitted += 1
+        return r
+
+    # -- leases ----------------------------------------------------------
+
+    def _grant(self, tenant: str, gateway: str, want: float,
+               now_ns: int) -> Lease | None:
+        if self.controller is not None and hasattr(self.controller,
+                                                   "admission_lease"):
+            return self.controller.admission_lease(
+                tenant, gateway, want, now_ns, self.lease_ttl_ns)
+        return self.broker.grant(tenant, gateway, want, now_ns,
+                                 self.lease_ttl_ns)
+
+    def _deposit(self, tenant: str, gateway: str, tokens: float,
+                 now_ns: int) -> float:
+        if self.controller is not None and hasattr(self.controller,
+                                                   "admission_deposit"):
+            return self.controller.admission_deposit(
+                tenant, gateway, tokens, now_ns)
+        return self.broker.deposit(tenant, gateway, tokens, now_ns)
+
+    def _renew_all(self, now_ns: int, force: bool = False) -> None:
+        """One renewal round: every reachable member tops every leased
+        bucket back up to its slice capacity and extends its lease.
+        The ``lease.expire`` fault sits exactly where a real authority
+        timeout would: the renewal simply does not happen, and the
+        bucket degrades at expiry. Partitioned members cannot renew —
+        their leases lapse naturally, which is the degraded-mode story,
+        not a special case."""
+        if (not force and self._last_renew_ns is not None
+                and now_ns - self._last_renew_ns < self.renew_period_ns):
+            return
+        self._last_renew_ns = now_ns
+        for name in sorted(self.members):
+            if name in self._partitioned or name in self._draining:
+                continue
+            gw = self.members[name]
+            for tenant in sorted(gw.admission._buckets):
+                b = gw.admission._buckets[tenant]
+                if isinstance(b, LeasedBucket):
+                    self._renew_one(name, tenant, b, now_ns)
+
+    def _renew_one(self, name: str, tenant: str, b: LeasedBucket,
+                   now_ns: int) -> None:
+        f = _faults.consult("lease.expire", f"{name}:{tenant}")
+        if f is not None:
+            self.lease_refusals += 1
+            return
+        # Top up to the slice cap — or past it toward a recorded
+        # oversized-request need (bounded by the global burst; the bank
+        # still only grants what it holds).
+        want = max(b.capacity, b.pending_need) - b.level
+        lease = self._grant(tenant, name, max(0.0, want), now_ns)
+        if lease is not None:
+            b.credit(lease.tokens, now_ns, self.lease_ttl_ns)
+
+    # -- the pump --------------------------------------------------------
+
+    def tick(self) -> list[tuple[str, dict]]:
+        """One federation round: fire membership fault seams, heal due
+        partitions, renew leases, pump every reachable member, retire
+        drained members that emptied. Returns this tick's completions
+        across all members."""
+        now = self.clock.now_ns()
+        for name in sorted(self.members):
+            if name in self._partitioned:
+                continue
+            f = _faults.consult("gateway.partition", name)
+            if f is not None:
+                self._partitioned[name] = now + int(
+                    f.args.get("duration_ns", 20 * MS))
+                self.events.append({"now_ns": now, "event": "partition",
+                                    "gateway": name})
+        for name in sorted(self.members):
+            if len(self.members) <= 1:
+                break  # quorum guard: never fence the last front door
+            f = _faults.consult("gateway.death", name)
+            if f is not None:
+                self.kill(name)
+        for name in sorted(self._partitioned):
+            if now >= self._partitioned[name]:
+                del self._partitioned[name]
+                self.events.append({"now_ns": now, "event": "heal",
+                                    "gateway": name})
+        self._renew_all(now)
+        done: list[tuple[str, dict]] = []
+        for name in sorted(self.members):
+            if name in self._partitioned:
+                continue
+            done.extend(self.members[name].tick())
+        self.completed += len(done)
+        for name in sorted(self._draining):
+            gw = self.members.get(name)
+            if gw is not None and not gw.busy():
+                self.events.append({"now_ns": now, "event": "remove",
+                                    "gateway": name})
+                self._retire(name)
+        return done
+
+    # -- observability ---------------------------------------------------
+
+    def queued(self) -> int:
+        return sum(gw.queue.depth() for gw in self.members.values())
+
+    def inflight_count(self) -> int:
+        return sum(len(gw.inflight) for gw in self.members.values())
+
+    def busy(self) -> bool:
+        return bool(self.queued() or self.inflight_count())
+
+    def stats(self) -> dict:
+        shed: dict[str, int] = dict(self.fed_sheds)
+        for gw in list(self.members.values()) + self._retired:
+            for k, v in gw.admission.sheds.items():
+                shed[k] = shed.get(k, 0) + v
+        members = {}
+        for name in sorted(self.members):
+            gw = self.members[name]
+            members[name] = {
+                "draining": name in self._draining,
+                "partitioned": name in self._partitioned,
+                "queued": gw.queue.depth(),
+                "inflight": len(gw.inflight),
+                "admitted": gw.admitted,
+                "adopted": gw.adopted,
+            }
+        return {
+            "admitted": self.admitted,
+            "completed": self.completed,
+            "queued": self.queued(),
+            "inflight": self.inflight_count(),
+            "handoffs": self.handoffs,
+            "remaps": self.remaps,
+            "lease_refusals": self.lease_refusals,
+            "shed": dict(sorted(shed.items())),
+            "ring": self.ring.nodes(),
+            "members": members,
+            "retired": sorted(g.name for g in self._retired),
+        }
+
+    def lease_audit(self) -> dict[str, dict[str, float]]:
+        """The no-rate-inflation witness, per tenant: bank odometers
+        (minted/granted/deposited) joined with the gateway-side spend
+        odometers, unspent ``held`` tokens, and tokens ``destroyed`` by
+        gateway death. The chaos harness asserts the conservation laws
+        over this view; see docs/GATEWAY.md."""
+        out: dict[str, dict[str, float]] = {}
+        everyone = list(self.members.values()) + self._retired
+        for tenant, bank in self.broker.audit().items():
+            leased_spent = conservative_spent = held = 0.0
+            for gw in everyone:
+                b = gw.admission._buckets.get(tenant)
+                if isinstance(b, LeasedBucket):
+                    leased_spent += b.leased_spent
+                    conservative_spent += b.conservative_spent
+                    held += b.level
+            out[tenant] = {
+                **bank,
+                "leased_spent": leased_spent,
+                "conservative_spent": conservative_spent,
+                "held": held,
+                "destroyed": self.destroyed.get(tenant, 0.0),
+            }
+        return out

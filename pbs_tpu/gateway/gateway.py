@@ -94,12 +94,16 @@ class Gateway:
         feedback_sink: Callable[[str, int, int], None] | None = None,
         feedback_period_ns: int = 10 * MS,
         drr_quantum: int = 16,
+        name: str = "gw",
     ):
         if not backends:
             raise ValueError("gateway needs at least one backend")
         names = [b.name for b in backends]
         if len(set(names)) != len(names):
             raise ValueError(f"duplicate backend names: {names}")
+        #: Identity within a federation (gateway/federation.py); also
+        #: the request-id prefix, so rids stay unique across members.
+        self.name = str(name)
         self.backends = list(backends)
         self.clock = clock or MonotonicClock()
         now = self.clock.now_ns()
@@ -149,6 +153,7 @@ class Gateway:
         self.completed = 0
         self.requeued = 0
         self.dispatched = 0
+        self.adopted = 0  # requests admitted at ANOTHER federated member
         self._delays = {cls: deque(maxlen=1024) for cls in SLO_CLASSES}
         self._latencies = {cls: deque(maxlen=1024) for cls in SLO_CLASSES}
         self.completions: deque = deque(maxlen=4096)  # (rid, info)
@@ -207,7 +212,7 @@ class Gateway:
             self._emit_shed(now, tenant, cls, shed)
             return SubmitResult(False, None, shed.reason,
                                 shed.retry_after_ns)
-        rid = f"gw-{next(self._rids)}"
+        rid = f"{self.name}-{next(self._rids)}"
         req = Request(rid=rid, tenant=tenant, slo=cls, cost=cost,
                       payload=payload, submit_ns=now,
                       penalty_ns=penalty_ns)
@@ -216,6 +221,31 @@ class Gateway:
         self._emit(now, Ev.GW_ADMIT, self._slot_of(tenant),
                    self._cls_code(cls), cost, self.queue.depth())
         return SubmitResult(True, rid)
+
+    # -- federation custody transfer (docs/GATEWAY.md "Federation") ------
+
+    def adopt(self, req: Request) -> None:
+        """Take custody of one request admitted at ANOTHER gateway —
+        the federation failover path for a dead member's in-flight
+        casualties. No admission charge (the request already paid at
+        its original front door); it enters at the head of the fair
+        queue exactly like a backend-loss casualty."""
+        now = self.clock.now_ns()
+        req.backend = None
+        req.requeues += 1
+        self.adopted += 1
+        self.queue.requeue_front(req)
+        self._emit(now, Ev.GW_REQUEUE, self._slot_of(req.tenant),
+                   self._cls_code(req.slo), self._backend_slot(None))
+
+    def adopt_tenant(self, cls: str, tenant: str, requests: list[Request],
+                     deficit: float = 0.0) -> None:
+        """Batch custody transfer of a tenant's queued FIFO from a
+        draining or dead federated member: order preserved at the front
+        of the queue, DRR deficit carried so the tenant resumes its
+        cycle instead of restarting with fresh credit."""
+        self.queue.restore_tenant(cls, tenant, requests, deficit)
+        self.adopted += len(requests)
 
     # -- the pump --------------------------------------------------------
 
@@ -299,7 +329,15 @@ class Gateway:
         dead agents of the same name never take dispatches), ranked
         least-loaded first, name-tiebroken for determinism. ``health``
         lets the dispatch loop snapshot the controller view once per
-        tick instead of rebuilding it per request."""
+        tick instead of rebuilding it per request.
+
+        A STALE health entry (older than the controller's
+        ``health_ttl_ns`` — nobody has heartbeat the agent inside the
+        breaker's half-open window) is treated as *unknown*, not as
+        truth: it neither vetoes the backend (a stale "dead" may have
+        recovered) nor vouches for it (a stale "alive" may have died) —
+        the backend stays eligible on its own liveness but ranks behind
+        every backend with a fresh healthy view."""
         if health is None:
             health = (self.controller.backend_health()
                       if self.controller is not None else {})
@@ -308,10 +346,13 @@ class Gateway:
             if not b.alive():
                 continue
             h = health.get(b.name)
-            if h is not None and (not h["alive"] or h["breaker"] == "open"):
+            stale = bool(h.get("stale", False)) if h is not None else False
+            if (h is not None and not stale
+                    and (not h["alive"] or h["breaker"] == "open")):
                 continue
-            out.append(b)
-        return sorted(out, key=lambda b: (b.depth(), b.name))
+            out.append((1 if stale else 0, b))
+        out.sort(key=lambda p: (p[0], p[1].depth(), p[1].name))
+        return [b for _, b in out]
 
     def _dispatch(self, now: int) -> None:
         health = (self.controller.backend_health()
@@ -454,10 +495,12 @@ class Gateway:
         bypass = sum(getattr(b, "bypass_submits", 0)
                      for b in self.backends)
         return {
+            "name": self.name,
             "admitted": self.admitted,
             "completed": self.completed,
             "dispatched": self.dispatched,
             "requeued": self.requeued,
+            "adopted": self.adopted,
             "inflight": len(self.inflight),
             "queued": self.queue.depth(),
             "shed": dict(sorted(self.admission.sheds.items())),

@@ -25,3 +25,19 @@ def push(backend, req, now_ns):
     # gw-direct-dispatch: routing skipped — nothing requeues this
     # request when the backend dies.
     return backend.dispatch_request(req, now_ns)
+
+
+from pbs_tpu.gateway.admission import TokenBucket
+
+
+def refund(admission, tenant):
+    # gw-lease-bypass: hand-editing replicated admission state — the
+    # federation's global-rate contract never sees these tokens.
+    admission._buckets[tenant].level += 50.0
+
+
+def top_up(now_ns):
+    bucket = TokenBucket(10.0, 5.0, now_ns)
+    # gw-lease-bypass: minting tokens nobody audited.
+    bucket.level = 1e9
+    return bucket

@@ -19,3 +19,13 @@ class Server:
 def pump(gw):
     # Dispatch belongs to the gateway pump, not callers.
     return gw.tick()
+
+
+def refund(broker, tenant, gateway, tokens, now_ns):
+    # The sanctioned return path: unspent tokens go back to the bank.
+    return broker.deposit(tenant, gateway, tokens, now_ns)
+
+
+def top_up(fed, now_ns):
+    # Leases, not level writes: the broker grants, the bucket credits.
+    fed._renew_all(now_ns, force=True)

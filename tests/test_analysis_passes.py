@@ -48,7 +48,7 @@ def test_all_rules_fire_on_bad_tree():
         "sched-ops-missing", "sched-ops-signature", "sched-ops-clamp",
         "counter-raw-cache", "counter-raw-threshold",
         "net-raw-socket", "net-raw-transport",
-        "gw-direct-submit", "gw-direct-dispatch",
+        "gw-direct-submit", "gw-direct-dispatch", "gw-lease-bypass",
         "perf-rec-loop", "perf-emit-in-loop",
     }
 

@@ -74,6 +74,7 @@ def test_sweep_rejects_non_positive_batches(bad):
     assert "tokens_per_s" not in proc.stdout
 
 
+@pytest.mark.slow  # ~8 s knob-sweep soak (tier-1 wall rescue)
 def test_good_knobs_reach_result_with_extras():
     rc, out, err, _ = _run_worker(
         {"PBST_BENCH_BATCH": "2", "PBST_BENCH_LOSS_CHUNKS": "4",

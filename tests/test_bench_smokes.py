@@ -62,6 +62,7 @@ def test_bench_serving_tiny_covers_the_matrix():
         assert row["acceptance"] >= 0.9, row
 
 
+@pytest.mark.slow  # ~9 s longctx smoke (tier-1 wall rescue)
 def test_bench_longctx_tiny_emits_points():
     proc, rows = _run("bench_longctx.py", {"PBST_LONGCTX_TINY": "1"})
     assert proc.returncode == 0, proc.stderr[-800:]

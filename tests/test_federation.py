@@ -1,0 +1,506 @@
+"""pbs_tpu.gateway.federation: placement, leases, handoff, staleness.
+
+The satellite coverage for the federated tier (docs/GATEWAY.md
+"Federation"): the consistent-hash ring's bounded-disruption property,
+lease-expiry degradation to the conservative bucket (and recovery
+without double-spend), DRR deficit carry across a gateway handoff, the
+never-lost invariant across a gateway DEATH, and the staleness rule on
+``Controller.backend_health()``. The seeded chaos proofs live in
+tests/test_federation_chaos.py.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from pbs_tpu.dist.controller import AgentHandle, Controller
+from pbs_tpu.faults import FaultPlan
+from pbs_tpu.faults import injector as faults
+from pbs_tpu.faults.plan import FaultSpec
+from pbs_tpu.gateway import (
+    BATCH,
+    INTERACTIVE,
+    DeficitRoundRobin,
+    FederatedGateway,
+    Gateway,
+    HashRing,
+    LeasedBucket,
+    Request,
+    SimServeBackend,
+    TenantQuota,
+)
+from pbs_tpu.utils.clock import MS, SEC, VirtualClock
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    faults.uninstall()
+
+
+def _member(name: str, clock, n_backends: int = 2,
+            service_ns: int = 1 * MS, n_slots: int = 2) -> Gateway:
+    backends = [SimServeBackend(f"{name}b{j}", n_slots=n_slots,
+                                service_ns_per_cost=service_ns, seed=j)
+                for j in range(n_backends)]
+    return Gateway(backends, clock=clock, max_queued=512, name=name)
+
+
+def _pump(fed: FederatedGateway, clock: VirtualClock, ticks: int,
+          tick_ns: int = 1 * MS) -> list:
+    done = []
+    for _ in range(ticks):
+        done.extend(fed.tick())
+        clock.advance(tick_ns)
+    return done
+
+
+# -- consistent-hash ring: bounded disruption -------------------------------
+
+
+def test_ring_remove_moves_only_the_removed_nodes_tenants():
+    ring = HashRing(vnodes=64)
+    for i in range(5):
+        ring.add(f"g{i}")
+    tenants = [f"tenant-{i}" for i in range(1000)]
+    before = {t: ring.lookup(t) for t in tenants}
+    ring.remove("g2")
+    after = {t: ring.lookup(t) for t in tenants}
+    moved = [t for t in tenants if before[t] != after[t]]
+    # The exact bounded-disruption property: ONLY g2's tenants moved.
+    assert moved and all(before[t] == "g2" for t in moved)
+    assert all(after[t] != "g2" for t in tenants)
+    # ~K/N with vnode smoothing; generous cap against hash clumping.
+    assert len(moved) / len(tenants) < 0.45
+
+
+def test_ring_readd_restores_placement_and_add_only_steals():
+    ring = HashRing(vnodes=64)
+    for i in range(5):
+        ring.add(f"g{i}")
+    tenants = [f"tenant-{i}" for i in range(1000)]
+    before = {t: ring.lookup(t) for t in tenants}
+    ring.remove("g2")
+    ring.add("g2")
+    # Consistency: membership round-trip is placement identity.
+    assert {t: ring.lookup(t) for t in tenants} == before
+    ring.add("g9")
+    after = {t: ring.lookup(t) for t in tenants}
+    moved = [t for t in tenants if after[t] != before[t]]
+    # An add steals arcs only for ITSELF.
+    assert moved and all(after[t] == "g9" for t in moved)
+    assert len(moved) / len(tenants) < 0.45
+
+
+def test_ring_spreads_load_across_nodes():
+    ring = HashRing(vnodes=64)
+    for i in range(4):
+        ring.add(f"g{i}")
+    counts: dict[str, int] = {}
+    for i in range(2000):
+        counts[ring.lookup(f"t{i}")] = counts.get(ring.lookup(f"t{i}"), 0) + 1
+    # Every node owns a real share (vnodes smooth the arcs).
+    assert set(counts) == {"g0", "g1", "g2", "g3"}
+    assert min(counts.values()) > 2000 * 0.05
+
+
+# -- DRR deficit carry (the handoff payload satellite) ----------------------
+
+
+def _req(rid: str, tenant: str, cost: int) -> Request:
+    return Request(rid=rid, tenant=tenant, slo=BATCH, cost=cost,
+                   payload=None, submit_ns=0)
+
+
+def test_drr_take_restore_carries_deficit_and_order():
+    q = DeficitRoundRobin(quantum=4)
+    q.set_weight("a", 256)
+    for i in range(2):
+        q.push(_req(f"a{i}", "a", 10))
+    assert q.pop().rid == "a0"
+    # One pop at cost 10 / quantum 4: three top-ups (12) minus 10.
+    reqs, deficit = q.take_tenant(BATCH, "a")
+    assert [r.rid for r in reqs] == ["a1"]
+    assert deficit == pytest.approx(2.0)
+    assert q.depth() == 0
+
+    q2 = DeficitRoundRobin(quantum=4)
+    q2.set_weight("a", 256)
+    q2.restore_tenant(BATCH, "a", reqs, deficit)
+    assert q2.depth() == 1
+    assert q2._deficit[BATCH]["a"] == pytest.approx(2.0)
+    assert q2.pop().rid == "a1"
+
+
+def test_drr_restore_merges_with_max_never_sum():
+    q = DeficitRoundRobin(quantum=4)
+    q.push(_req("x0", "x", 4))
+    q._deficit[BATCH]["x"] = 3.0
+    q.restore_tenant(BATCH, "x", [_req("x1", "x", 4)], deficit=2.0)
+    # Carried 2.0 merges into existing 3.0 by max: no credit doubling.
+    assert q._deficit[BATCH]["x"] == pytest.approx(3.0)
+    # The restored request sits at the FRONT.
+    assert q.pop().rid == "x1"
+
+
+# -- leases: expiry degrades, recovery does not double-spend ----------------
+
+
+def test_lease_expiry_degrades_to_conservative_and_recovers():
+    clock = VirtualClock()
+    members = [_member("gw0", clock), _member("gw1", clock)]
+    quota = TenantQuota(rate=1000.0, burst=20.0, slo=INTERACTIVE,
+                        max_queued=256)
+    # Refuse EVERY renewal for tenant "t" (any member) from the start.
+    faults.install(FaultPlan(seed=0, specs=(
+        FaultSpec("lease.expire", "expire", p=1.0, key="*:t"),)))
+    fed = FederatedGateway(members, clock=clock,
+                           renew_period_ns=4 * MS, lease_ttl_ns=6 * MS)
+    fed.register_tenant("t", quota)
+    home = fed.ring.lookup("t")
+    bucket = fed.members[home].admission._buckets["t"]
+    assert isinstance(bucket, LeasedBucket)
+    assert bucket.level == 0.0  # every grant was refused
+    assert not bucket.leased(clock.now_ns())
+
+    # Degraded admission does not STALL: sheds carry retry-after, and
+    # the conservative bucket (1/(2N) of the fair share, starting
+    # empty) admits once degraded time accrues scrip.
+    admitted = sheds = 0
+    for _ in range(200):
+        r = fed.submit("t", None, cost=1)
+        if r.admitted:
+            admitted += 1
+        else:
+            sheds += 1
+            assert r.retry_after_ns > 0
+        _pump(fed, clock, 1)
+    assert admitted > 0 and sheds > 0
+    audit = fed.lease_audit()["t"]
+    assert audit["leased_spent"] == 0.0
+    assert audit["conservative_spent"] == pytest.approx(float(admitted))
+    # The slack is bounded by the conservative rate: 1/(2N) = 1/4 of
+    # 1000/s over 0.2 s = 50, plus the conservative burst.
+    assert audit["conservative_spent"] <= 0.25 * 1000 * 0.2 + 5 + 1e-6
+
+    # Recovery: renewals succeed again; admission resumes LEASED and
+    # the books stay exact (no double-spend from the transition). The
+    # lease returns at the NEXT renewal round, so pump past one first.
+    faults.uninstall()
+    _pump(fed, clock, 8)
+    audit = fed.lease_audit()["t"]
+    degraded_spent = audit["conservative_spent"]
+    admitted = int(degraded_spent)  # nothing admitted while idle
+    recovered = 0
+    for _ in range(100):
+        r = fed.submit("t", None, cost=1)
+        if r.admitted:
+            recovered += 1
+        _pump(fed, clock, 1)
+    assert recovered > 0
+    audit = fed.lease_audit()["t"]
+    assert bucket.leased(clock.now_ns())
+    assert audit["conservative_spent"] == pytest.approx(degraded_spent)
+    assert audit["leased_spent"] > 0
+    # Every admitted cost unit is token-backed, before and after.
+    assert (audit["leased_spent"] + audit["conservative_spent"]
+            == pytest.approx(float(admitted + recovered)))
+    assert audit["granted"] <= audit["minted"] + audit["deposited"] + 1e-6
+
+
+def test_spraying_gateways_cannot_exceed_global_rate():
+    """The N× spray attack: a tenant hammering the federation at every
+    tick still admits no more than ONE global bucket's worth."""
+    clock = VirtualClock()
+    members = [_member(f"gw{i}", clock, n_backends=2, n_slots=8)
+               for i in range(3)]
+    quota = TenantQuota(rate=2000.0, burst=30.0, slo=INTERACTIVE,
+                        max_queued=512)
+    fed = FederatedGateway(members, clock=clock,
+                           renew_period_ns=2 * MS, lease_ttl_ns=3 * MS)
+    fed.register_tenant("sprayer", quota)
+    cost_admitted = 0
+    ticks = 500
+    for _ in range(ticks):
+        for _ in range(8):  # spray: far over quota every tick
+            if fed.submit("sprayer", None, cost=1).admitted:
+                cost_admitted += 1
+        _pump(fed, clock, 1)
+    elapsed_s = ticks * 1 * MS / SEC
+    # Global contract: rate * t + burst — NOT 3x it. (No lease ever
+    # lapses here, so there is zero conservative slack in the books.)
+    assert cost_admitted <= quota.rate * elapsed_s + quota.burst + 1e-6
+    assert cost_admitted > 0.8 * quota.rate * elapsed_s  # and it serves
+    audit = fed.lease_audit()["sprayer"]
+    assert audit["conservative_spent"] == 0.0
+
+
+def test_oversized_but_legal_request_is_not_starved():
+    """cost in (burst/N, burst] passes the global cost-over-burst gate
+    but exceeds the slice cap: renewals must borrow past the cap toward
+    the recorded need instead of shedding 'quota' with a retry hint
+    that can never come true."""
+    clock = VirtualClock()
+    members = [_member(f"gw{i}", clock, n_slots=4) for i in range(4)]
+    quota = TenantQuota(rate=1000.0, burst=120.0, slo=BATCH,
+                        max_queued=256)
+    fed = FederatedGateway(members, clock=clock,
+                           renew_period_ns=2 * MS, lease_ttl_ns=3 * MS)
+    fed.register_tenant("big", quota)  # slice cap = 30 per member
+    admitted = small = 0
+    for tick in range(400):
+        if fed.submit("big", None, cost=40).admitted:  # 30 < 40 <= 120
+            admitted += 1
+        # Interleaved SMALL traffic (well under the global rate, so
+        # accumulation is possible at all) must not reset the borrow
+        # target: a smaller served take may not clear pending_need.
+        if tick % 4 == 0 and fed.submit("big", None, cost=1).admitted:
+            small += 1
+        _pump(fed, clock, 1)
+    assert admitted > 0, "oversized-but-legal requests livelocked"
+    assert small > 0
+    # And the books still balance: borrowing is bank-granted, not mint.
+    audit = fed.lease_audit()["big"]
+    assert audit["granted"] <= audit["minted"] + audit["deposited"] + 1e-6
+    assert audit["leased_spent"] == pytest.approx(40.0 * admitted + small)
+
+
+def test_degraded_midsize_request_gets_honest_retry_hint():
+    """Degraded mode, cost in (conservative burst, slice capacity]:
+    the emergency bucket can never cover it, so the retry hint must be
+    the lease-recovery cadence — not the emergency bucket's refill
+    horizon, which would retry-livelock a contract-following client —
+    and the need is recorded so resumed renewals borrow toward it."""
+    from pbs_tpu.gateway.federation import LeasedBucket
+
+    quota = TenantQuota(rate=1000.0, burst=50.0, slo=BATCH)
+    b = LeasedBucket("t", "gw0", quota, capacity=12.5,
+                     conservative_rate=125.0, conservative_burst=6.25,
+                     renew_period_ns=4 * MS, now_ns=0)
+    # No lease ever granted: degraded from the start.
+    assert not b.take(10, 1 * MS)
+    assert b.retry_after_ns(10, 1 * MS) == 4 * MS  # honest: renew cadence
+    # Within the slice cap, an ordinary renewal covers it — no borrow
+    # flag needed; only costs ABOVE capacity record a pending need.
+    assert b.pending_need == 0.0
+    assert not b.take(20, 1 * MS)  # capacity 12.5 < 20 <= burst
+    assert b.pending_need == pytest.approx(20.0)
+    # A coverable small request still gets the emergency bucket's own
+    # refill horizon (1 token at 125/s from empty: ~8 ms), not the
+    # renew cadence.
+    hint = b.retry_after_ns(1, 1 * MS)
+    assert hint == pytest.approx(8 * MS, rel=0.01)
+    # Recovery: a smaller served take does NOT clear the borrow target;
+    # only serving a cost >= the need does.
+    b.credit(10.0, 2 * MS, 6 * MS)
+    assert b.take(10, 2 * MS)
+    assert b.pending_need == pytest.approx(20.0)
+    b.credit(20.0, 3 * MS, 6 * MS)
+    assert b.take(20, 3 * MS)
+    assert b.pending_need == 0.0
+
+
+def test_members_with_local_tenants_are_rejected():
+    """A member arriving with its own registered tenants holds plain
+    full-rate local buckets — an invisible bypass of the global-rate
+    contract — so the federation refuses it at attach time."""
+    clock = VirtualClock()
+    pre = _member("gw0", clock)
+    pre.register_tenant("t", TenantQuota(rate=100.0, burst=10.0))
+    with pytest.raises(ValueError, match="locally registered"):
+        FederatedGateway([pre], clock=clock)
+    fed = FederatedGateway([_member("gw1", clock)], clock=clock)
+    pre2 = _member("gw2", clock)
+    pre2.register_tenant("t", TenantQuota(rate=100.0, burst=10.0))
+    with pytest.raises(ValueError, match="locally registered"):
+        fed.add(pre2)
+
+
+def test_broker_revokes_leases_of_retired_members():
+    clock = VirtualClock()
+    members = [_member("gw0", clock), _member("gw1", clock)]
+    fed = FederatedGateway(members, clock=clock)
+    fed.register_tenant("t", TenantQuota(rate=100.0, burst=10.0,
+                                         slo=BATCH))
+    assert {g for _, g in fed.broker.leases} == {"gw0", "gw1"}
+    fed.kill("gw1")
+    # A dead member must not keep advertising live leases.
+    assert {g for _, g in fed.broker.leases} == {"gw0"}
+
+
+def test_reslice_rebounds_conservative_floor_after_add():
+    """The degraded-mode floor re-splits on membership change: after
+    1 → 4 members the per-member emergency rates must sum to half the
+    global rate, not Σ 1/(2·N_at_creation) (which exceeds the global
+    rate itself)."""
+    clock = VirtualClock()
+    g0 = _member("gw0", clock)
+    quota = TenantQuota(rate=1000.0, burst=40.0, slo=BATCH)
+    fed = FederatedGateway([g0], clock=clock)
+    fed.register_tenant("t", quota)
+    assert fed.members["gw0"].admission._buckets["t"]._cons_rate \
+        == pytest.approx(500.0)  # 1/(2·1)
+    for name in ("gw1", "gw2", "gw3"):
+        fed.add(_member(name, clock))
+    rates = [fed.members[n].admission._buckets["t"]._cons_rate
+             for n in sorted(fed.members)]
+    assert rates == pytest.approx([125.0] * 4)  # 1/(2·4) each
+    assert sum(rates) == pytest.approx(quota.rate / 2)
+    caps = [fed.members[n].admission._buckets["t"].capacity
+            for n in sorted(fed.members)]
+    assert sum(caps) == pytest.approx(quota.burst)
+
+
+# -- failover: the never-lost invariant across gateway death ----------------
+
+
+def test_gateway_death_hands_off_queued_and_inflight():
+    clock = VirtualClock()
+    members = [_member("gw0", clock, n_backends=1, service_ns=5 * MS),
+               _member("gw1", clock, n_backends=1, service_ns=5 * MS)]
+    fed = FederatedGateway(members, clock=clock)
+    q = TenantQuota(rate=1e6, burst=1e6, slo=BATCH, max_queued=256)
+    fed.register_tenant("t0", q)
+    fed.register_tenant("t1", q)
+    rids = []
+    for i in range(24):
+        r = fed.submit(f"t{i % 2}", None, cost=2)
+        assert r.admitted
+        rids.append(r.rid)
+    done = _pump(fed, clock, 3)
+    # Kill whichever member holds MORE work, so the handoff moves both
+    # queued and inflight requests.
+    victim = max(fed.members.values(),
+                 key=lambda g: g.queue.depth() + len(g.inflight)).name
+    assert fed.members[victim].queue.depth() > 0
+    assert len(fed.members[victim].inflight) > 0
+    fed.kill(victim)
+    assert fed.handoffs > 0
+    done += _pump(fed, clock, 600)
+    assert sorted(r for r, _ in done) == sorted(rids)  # nothing lost
+    assert fed.admitted == fed.completed == 24
+    assert not fed.busy()
+    survivor = next(iter(fed.members.values()))
+    assert survivor.adopted > 0
+    assert victim in [g.name for g in fed._retired]
+
+
+def test_gateway_drain_hands_off_queued_with_deposit():
+    clock = VirtualClock()
+    members = [_member("gw0", clock, n_backends=1, service_ns=5 * MS),
+               _member("gw1", clock, n_backends=1, service_ns=5 * MS)]
+    fed = FederatedGateway(members, clock=clock)
+    q = TenantQuota(rate=500.0, burst=40.0, slo=BATCH, max_queued=256)
+    fed.register_tenant("t0", q)
+    rids = []
+    # 6 × cost 2 = 12 of the home's 20-token slice: tokens REMAIN
+    # unspent at drain time, so the deposit path has something to move.
+    for i in range(6):
+        r = fed.submit("t0", None, cost=2)
+        if r.admitted:
+            rids.append(r.rid)
+    assert rids
+    home = fed.ring.lookup("t0")
+    fed.drain(home)
+    # Draining member left the ring; its unspent tokens went back to
+    # the bank instead of dying with the box.
+    assert home not in fed.ring.nodes()
+    assert fed.lease_audit()["t0"]["deposited"] > 0
+    done = _pump(fed, clock, 800)
+    assert sorted(r for r, _ in done) == sorted(rids)
+    assert fed.admitted == fed.completed == len(rids)
+    # Drain completed: the member retired once its inflight emptied.
+    assert home not in fed.members
+    # New submissions keep flowing through the survivors.
+    assert fed.submit("t0", None, cost=1).admitted
+
+
+# -- staleness: an unrefreshed health view is unknown, not truth ------------
+
+
+def test_stale_breaker_view_does_not_veto_but_ranks_last():
+    clock = VirtualClock()
+    ctl = Controller(clock=clock, health_ttl_ns=5 * SEC)
+    h = AgentHandle("b0", client=None, probe=None)
+    h.breaker = "open"
+    h.observed_ns = clock.now_ns()
+    ctl.agents["b0"] = h
+    # Service far longer than the staleness window: b1 stays busy
+    # across the fresh→stale transition, so the waiter's fate isolates
+    # the veto decision.
+    b0 = SimServeBackend("b0", n_slots=1, service_ns_per_cost=20 * SEC)
+    b1 = SimServeBackend("b1", n_slots=1, service_ns_per_cost=20 * SEC)
+    gw = Gateway([b0, b1], clock=clock, controller=ctl,
+                 quotas={"t": TenantQuota(rate=1e6, burst=1e6)})
+    for _ in range(2):
+        gw.submit("t", None)
+    gw.tick()
+    # FRESH open breaker: vetoed — b1 takes one, the other waits.
+    assert b0.depth() == 0 and b1.depth() == 1
+    assert gw.queue.depth() == 1
+
+    clock.advance(6 * SEC)  # past health_ttl_ns: the view is stale
+    assert ctl.backend_health()["b0"]["stale"] is True
+    gw.tick()
+    # Stale "open" is UNKNOWN, not a verdict: b0 becomes eligible
+    # again (ranked last, but b1 is full) and takes the waiter.
+    assert b0.depth() == 1
+    assert gw.queue.depth() == 0
+
+
+def test_stale_alive_view_is_not_trusted_for_ranking():
+    clock = VirtualClock()
+    ctl = Controller(clock=clock, health_ttl_ns=1 * SEC)
+    h = AgentHandle("b0", client=None, probe=None)
+    h.observed_ns = clock.now_ns()
+    ctl.agents["b0"] = h
+    clock.advance(2 * SEC)
+    b0 = SimServeBackend("b0", n_slots=4, service_ns_per_cost=1 * MS)
+    b1 = SimServeBackend("b1", n_slots=4, service_ns_per_cost=1 * MS)
+    gw = Gateway([b0, b1], clock=clock, controller=ctl,
+                 quotas={"t": TenantQuota(rate=1e6, burst=1e6)})
+    gw.submit("t", None)
+    gw.tick()
+    # b0's glowing-but-stale view loses to the unknown-but-unflagged
+    # b1: conservative routing prefers what nothing contradicts.
+    assert b1.depth() == 1 and b0.depth() == 0
+
+
+# -- the controller is the lease authority when attached --------------------
+
+
+def test_controller_routes_admission_leases():
+    clock = VirtualClock()
+    members = [_member("gw0", clock), _member("gw1", clock)]
+    ctl = Controller(clock=clock)
+    calls = {"lease": 0, "deposit": 0}
+    real_lease, real_deposit = ctl.admission_lease, ctl.admission_deposit
+
+    def lease(*a, **kw):
+        calls["lease"] += 1
+        return real_lease(*a, **kw)
+
+    def deposit(*a, **kw):
+        calls["deposit"] += 1
+        return real_deposit(*a, **kw)
+
+    ctl.admission_lease, ctl.admission_deposit = lease, deposit
+    fed = FederatedGateway(members, controller=ctl, clock=clock)
+    # Attaching wired the federation's broker through the controller.
+    assert ctl.admission_broker is fed.broker
+    quota = TenantQuota(rate=100.0, burst=10.0, slo=BATCH)
+    fed.register_tenant("t", quota)
+    assert calls["lease"] > 0  # grants rode the controller surface
+    assert any(k[0] == "t" for k in fed.broker.leases)
+    home = fed.ring.lookup("t")
+    fed.submit("t", None, cost=1)
+    fed.drain(home)
+    assert calls["deposit"] > 0  # and so did the drain deposit
+
+
+def test_controller_without_broker_raises():
+    ctl = Controller()
+    with pytest.raises(RuntimeError):
+        ctl.admission_lease("t", "gw0", 1.0, 0, 1000)
+    with pytest.raises(RuntimeError):
+        ctl.admission_deposit("t", "gw0", 1.0, 0)

@@ -1,0 +1,175 @@
+"""``pbst chaos --plan federation``: the front-door TIER under fire.
+
+Tier-1 carries one fixed-seed scenario with TWO golden digests (same
+CI contract as tests/test_chaos_smoke.py: random streams and sha256
+are platform-stable, so a digest change means injection — or the
+federation's response to it — changed; review it like a golden file)
+plus the acceptance invariants: admitted ⇒ completed-or-requeued
+across a GATEWAY death, drain, partition, and rejoin; global admitted
+cost token-backed (no N× rate by spraying gateways, bounded
+conservative slack); same seed ⇒ same digests. The full
+workload-catalog soak and the CLI selfcheck live behind ``slow``.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from pbs_tpu.cli.pbst import main
+from pbs_tpu.faults import FaultPlan
+from pbs_tpu.faults import injector as faults
+from pbs_tpu.gateway import run_federation_chaos
+from pbs_tpu.sim.workload import workload_names
+
+#: Golden digests for (mixed, seed=0, 3 gateways, 4 tenants, 240
+#: ticks) under FaultPlan.federation(0). Regenerate via ``python -c
+#: "from pbs_tpu.gateway import run_federation_chaos; r =
+#: run_federation_chaos(ticks=240); print(r['trace_digest']);
+#: print(r['report_digest'])"`` after an intentional injection,
+#: arrival-model, or federation-behavior change.
+GOLDEN_TRACE_DIGEST = (
+    "71a188673b85cf80a67a721b247443d22e3776a09ad491fc6a5356553218d6de")
+GOLDEN_REPORT_DIGEST = (
+    "1ba265a705067e8d8761aaa8d57c23b30e38c25839b29c9f1debf380b5667242")
+
+SMOKE_KW = dict(workload="mixed", seed=0, n_gateways=3, n_tenants=4,
+                ticks=240)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    faults.uninstall()
+
+
+def test_federation_chaos_smoke_invariants_and_golden_digests():
+    r = run_federation_chaos(**SMOKE_KW)
+    assert r["problems"] == []
+    assert r["ok"] is True
+    assert sum(r["faults_fired"].values()) > 0
+    events = [e["event"] for e in r["events"]]
+    # The full membership story actually happened in this seed: a
+    # partition, the scheduled drain, a gateway DEATH, and the rejoin.
+    assert {"kill", "drain", "remove", "add", "partition"} <= set(events)
+    st = r["stats"]
+    # The acceptance invariant: nothing admitted was lost across a
+    # front-door death.
+    assert st["admitted"] == st["completed"] > 0
+    assert st["handoffs"] > 0  # the death/drain had casualties; repaired
+    assert st["lease_refusals"] > 0  # degraded admission was exercised
+    assert r["trace_digest"] == GOLDEN_TRACE_DIGEST
+    assert r["report_digest"] == GOLDEN_REPORT_DIGEST
+
+
+def test_federation_chaos_deterministic_books():
+    """Same seed ⇒ same digests AND same books; a different seed moves
+    them (the streams are live, not constants)."""
+    a = run_federation_chaos(**SMOKE_KW)
+    b = run_federation_chaos(**SMOKE_KW)
+    assert a["trace_digest"] == b["trace_digest"]
+    assert a["report_digest"] == b["report_digest"]
+    assert a["stats"]["shed"] == b["stats"]["shed"]
+    assert a["stats"]["handoffs"] == b["stats"]["handoffs"]
+    assert a["events"] == b["events"]
+    assert a["lease_audit"] == b["lease_audit"]
+    c = run_federation_chaos(**{**SMOKE_KW, "seed": 1})
+    assert c["trace_digest"] != a["trace_digest"]
+
+
+def test_federation_chaos_no_rate_inflation_books():
+    """The audit identities the harness gates on, re-derived here so a
+    report format drift cannot silently weaken the invariant."""
+    r = run_federation_chaos(**SMOKE_KW)
+    for tenant, a in r["lease_audit"].items():
+        # Issue bound: everything granted traces to a mint or a return.
+        assert a["granted"] <= a["minted"] + a["deposited"] + 1e-6, tenant
+        # Conservation: spent + parked + returned + died <= granted.
+        accounted = (a["leased_spent"] + a["held"] + a["deposited"]
+                     + a["destroyed"])
+        assert accounted <= a["granted"] + 1e-6, tenant
+
+
+def test_federation_chaos_cli_json():
+    rc = main(["chaos", "--plan", "federation", "--workload", "mixed",
+               "--seed", "0", "--gateways", "3", "--tenants", "4",
+               "--rounds", "2", "--json"])
+    assert rc == 0
+
+
+def test_federation_chaos_cli_text(capsys):
+    rc = main(["chaos", "--plan", "federation", "--rounds", "2"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "federation chaos" in out and "report_digest=" in out
+    assert out.rstrip().endswith("ok")
+
+
+def test_federated_demo_cli(capsys):
+    rc = main(["gateway", "demo", "--federated", "--ticks", "160"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "federated gateway demo" in out and "ok" in out
+
+
+def test_federation_respects_plan_files():
+    """A FaultPlan JSON naming the federation points drives the
+    harness like any stock plan (the docs/FAULTS.md schema)."""
+    plan = FaultPlan.from_dict({
+        "seed": 5,
+        "specs": [
+            {"point": "gateway.death", "fault": "kill", "p": 0.02,
+             "after": 20, "times": 1},
+            {"point": "lease.expire", "fault": "expire", "p": 0.3},
+        ],
+    })
+    r = run_federation_chaos(workload="stable", seed=5, n_gateways=3,
+                             n_tenants=2, ticks=200, plan=plan,
+                             drain_rejoin=False)
+    assert r["ok"] is True, r["problems"]
+    assert r["faults_fired"].get("gateway.death:kill", 0) >= 1
+    assert r["faults_fired"].get("lease.expire:expire", 0) > 0
+
+
+def test_federation_quorum_guard_never_fences_last_gateway():
+    """A kill-happy plan cannot take the tier to zero front doors: the
+    quorum guard skips the death seam at one remaining member, and the
+    run still converges with nothing lost."""
+    plan = FaultPlan.from_dict({
+        "seed": 9,
+        "specs": [
+            {"point": "gateway.death", "fault": "kill", "p": 0.2},
+        ],
+    })
+    r = run_federation_chaos(workload="stable", seed=9, n_gateways=3,
+                             n_tenants=2, ticks=200, plan=plan,
+                             drain_rejoin=False)
+    assert r["ok"] is True, r["problems"]
+    kills = [e for e in r["events"] if e["event"] == "kill"]
+    assert len(kills) == 2  # of 3 members; the last one is never fenced
+    st = r["stats"]
+    assert st["admitted"] == st["completed"] > 0
+
+
+@pytest.mark.slow
+def test_federation_chaos_soak_full_catalog():
+    # Acceptance sweep: every sim workload under the federation plan,
+    # twice each (digest equality = the determinism criterion).
+    for name in workload_names():
+        a = run_federation_chaos(workload=name, seed=0, ticks=600)
+        assert a["ok"] is True, (name, a["problems"])
+        b = run_federation_chaos(workload=name, seed=0, ticks=600)
+        assert b["trace_digest"] == a["trace_digest"], name
+        assert b["report_digest"] == a["report_digest"], name
+
+
+@pytest.mark.slow
+def test_federation_chaos_seed_sweep():
+    for seed in range(8):
+        r = run_federation_chaos(workload="mixed", seed=seed, ticks=400)
+        assert r["ok"] is True, (seed, r["problems"])
+
+
+@pytest.mark.slow
+def test_federation_chaos_cli_selfcheck():
+    assert main(["chaos", "--plan", "federation", "--seed", "0",
+                 "--selfcheck"]) == 0

@@ -14,6 +14,7 @@ workload cooperation.
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from pbs_tpu.runtime.job import Job, SchedParams
 from pbs_tpu.runtime.partition import Partition
@@ -114,6 +115,7 @@ def test_foreign_job_without_jit_stage_still_runs():
     assert job.compiled is None
 
 
+@pytest.mark.slow  # ~10 s adaptation soak (tier-1 wall rescue); the other foreign-tenant pins stay tier-1
 def test_feedback_adapts_foreign_quantum():
     """The verdict's done-bar: a foreign plain-jax.jit tenant's
     measured phases drive the feedback policy — the HBM-bound tenant's

@@ -219,19 +219,23 @@ def test_controller_backend_health_feeds_routing():
     backends. No sockets needed: the view reads cached handle state."""
     from pbs_tpu.dist.controller import AgentHandle, Controller
 
-    ctl = Controller()
+    clock = VirtualClock()
+    ctl = Controller(clock=clock)
     h = AgentHandle("b0", client=None, probe=None)
     h.info = {"n_jobs": 3}
     h.breaker = "open"
+    h.observed_ns = clock.now_ns()
     ctl.agents["b0"] = h
     dead = AgentHandle("b1", client=None, probe=None)
     dead.alive = False
+    dead.observed_ns = clock.now_ns()
     ctl.agents["b1"] = dead
     assert ctl.backend_health() == {
-        "b0": {"alive": True, "breaker": "open", "load": 3},
-        "b1": {"alive": False, "breaker": "closed", "load": 0},
+        "b0": {"alive": True, "breaker": "open", "load": 3,
+               "observed_ns": 0, "stale": False},
+        "b1": {"alive": False, "breaker": "closed", "load": 0,
+               "observed_ns": 0, "stale": False},
     }
-    clock = VirtualClock()
     b0 = SimServeBackend("b0", n_slots=2, service_ns_per_cost=1 * MS)
     b1 = SimServeBackend("b1", n_slots=2, service_ns_per_cost=1 * MS)
     b2 = SimServeBackend("b2", n_slots=2, service_ns_per_cost=1 * MS)

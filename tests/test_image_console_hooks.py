@@ -33,6 +33,7 @@ TINY = dict(vocab=64, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2,
 # -- images (pygrub) --------------------------------------------------------
 
 
+@pytest.mark.slow  # ~8 s cold-boot image soak (tier-1 wall rescue)
 def test_cold_boot_image_runs(tmp_path):
     path = str(tmp_path / "img")
     save_image(path, "transformer", TINY,

@@ -70,6 +70,7 @@ def test_remat_matches():
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+@pytest.mark.slow  # ~10 s parity soak (tier-1 wall rescue)
 def test_sharded_train_matches_single_device():
     """dp=2 x tp=4 sharded step == single-device step (same math,
     XLA-inserted collectives)."""

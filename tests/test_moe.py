@@ -218,6 +218,7 @@ def test_moe_loss_decreases_and_num_params():
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+@pytest.mark.slow  # ~14 s parity soak (tier-1 wall rescue)
 def test_expert_parallel_matches_single_device():
     """dp=2 x ep=4 sharded MoE step == single-device step."""
     from pbs_tpu.parallel import (
@@ -248,6 +249,7 @@ def test_expert_parallel_matches_single_device():
 # -- serving (KV-cached decode) ---------------------------------------------
 
 
+@pytest.mark.slow  # ~13 s decode-parity soak (tier-1 wall rescue)
 def test_moe_cached_generate_matches_uncached_decode():
     """Cache correctness for the MoE family: greedy cached generation
     must match the no-cache reference (re-running the full forward on

@@ -160,6 +160,7 @@ def test_spec_serving_guards(models):
         spec.submit([1, 2, 3], max_new_tokens=29)  # 3+29+4 > 32
 
 
+@pytest.mark.slow  # ~8 s int8-target sweep (tier-1 wall rescue)
 def test_spec_serving_int8_target(models):
     """The deployment shape: big int8-quantized target + small fp
     draft. Exactness holds vs the plain engine on the SAME quantized
