@@ -433,6 +433,156 @@ def main() -> None:
     sys.stdout.flush()
 
 
+#: Fixed bar for the chip-free serving fallback's ``vs_baseline``
+#: (tiny-model CPU gateway+batcher tokens/s): round-over-round movement
+#: stays visible even when the chip claim is held for every round.
+#: Set ~1.5x the first measured number (3190 tok/s on this container),
+#: same spirit as the flagship's 40%-MFU aspiration bar.
+SERVING_BAR_TOKENS_S = 5000.0
+
+
+def _serving_fallback_main() -> None:
+    """Chip-free serving benchmark (ROADMAP item 5a): the full
+    gateway + ContinuousBatcher stack on CPU — admission, DRR fair
+    queue, dispatch, decode — measured end to end. Tokens/s is the
+    headline; latency quantiles come from the gateway's log2
+    histograms (pbs_tpu.obs.spans; docs/TRACING.md), the same
+    estimator ``pbst slo report`` uses. Prints exactly ONE JSON line,
+    like the flagship worker."""
+
+    def _int_env(name: str, default: int) -> int:
+        raw = os.environ.get(name)
+        if not raw:
+            return default
+        try:
+            v = int(raw)
+        except ValueError:
+            raise SystemExit(f"{name} must be an int: {raw!r}")
+        if v < 1:
+            raise SystemExit(f"{name} must be >= 1: {v}")
+        return v
+
+    requests = _int_env("PBST_BENCH_SERVING_REQUESTS", 32)
+    max_new = _int_env("PBST_BENCH_SERVING_MAX_NEW", 8)
+    slots = _int_env("PBST_BENCH_SERVING_SLOTS", 4)
+    _mark("importing jax (cpu)")
+    import jax
+
+    # The ONLY reliable pin (docs/OPS.md; test_chip_invariants): env
+    # vars are ignored under the ambient chip plugin, and this
+    # benchmark must NEVER touch the chip — it runs precisely because
+    # the chip claim is held.
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pbs_tpu.gateway import BatcherBackend, Gateway, TenantQuota
+    from pbs_tpu.models import TransformerConfig, init_params
+    from pbs_tpu.models.serving import ContinuousBatcher
+
+    cfg = TransformerConfig(
+        vocab=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq=128, dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = ContinuousBatcher(cfg, params, n_slots=slots,
+                            prompt_bucket=16, max_len=64)
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, 128, size=6)) for _ in range(4)]
+    # Warmup DIRECTLY on the engine, before the gateway exists:
+    # compile time must not land in the gateway's latency histograms
+    # (a multi-second compile in the p99 bucket would swamp the
+    # steady-state signal the fallback exists to produce).
+    _mark("warmup decode (compiles)")
+    eng.submit(prompts[0], 2)
+    while eng.has_work():
+        eng.step()
+    gw = Gateway(
+        [BatcherBackend("engine", eng)],
+        quotas={"bench": TenantQuota(rate=1e9, burst=1e9,
+                                     slo="interactive",
+                                     max_queued=max(64, requests))})
+    _mark(f"timing {requests} requests x {max_new} tokens")
+    t0 = time.perf_counter()
+    shed = 0
+    for i in range(requests):
+        r = gw.submit("bench", {"prompt": prompts[i % len(prompts)],
+                                "max_new": max_new})
+        if not r.admitted:
+            shed += 1
+    done = []
+    while gw.busy():
+        done += gw.tick()
+    dt = time.perf_counter() - t0
+    tokens = sum(i.get("tokens", 0) for _, i in done)
+    toks_per_s = tokens / dt if dt > 0 else 0.0
+    print(json.dumps({
+        "metric": "gateway_serving_throughput",
+        "value": round(toks_per_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(toks_per_s / SERVING_BAR_TOKENS_S, 4),
+        "p50_latency_ms": round(
+            gw.hist.class_quantile("interactive", "e2e", 0.50) / 1e6, 3),
+        "p99_latency_ms": round(
+            gw.hist.class_quantile("interactive", "e2e", 0.99) / 1e6, 3),
+        "requests": requests,
+        "completions": len(done),
+        "shed": shed,
+        "tokens": int(tokens),
+        "device": str(jax.devices()[0]),
+        "fallback_from": "flagship_train_throughput",
+    }))
+    sys.stdout.flush()
+
+
+def _try_serving_fallback(reason: str) -> bool:
+    """When the chip claim is held, run the chip-free serving
+    benchmark in a CHILD (the parent keeps its no-jax/no-hang
+    invariant) and emit ITS measurement instead of a
+    ``flagship_train_throughput = 0.0`` error row — five rounds of
+    zeros taught us a red chip must not mean zero perf signal.
+    Returns True when the fallback JSON was printed."""
+    import shlex
+
+    if os.environ.get("PBST_BENCH_SERVING_FALLBACK", "1").lower() in (
+            "0", "false", "no"):
+        return False
+    cmd_s = os.environ.get("PBST_BENCH_FALLBACK_CMD")
+    cmd = (shlex.split(cmd_s) if cmd_s else
+           [sys.executable, os.path.abspath(__file__),
+            "--serving-fallback"])
+    try:
+        timeout_s = float(os.environ.get(
+            "PBST_BENCH_FALLBACK_TIMEOUT_S", "240"))
+    except ValueError:
+        timeout_s = 240.0
+    sys.stderr.write(
+        "[bench] chip claim unavailable; running the chip-free "
+        "gateway serving fallback (CPU)\n")
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    except (OSError, subprocess.TimeoutExpired) as e:
+        sys.stderr.write(f"[bench] serving fallback failed: {e}\n")
+        return False
+    sys.stderr.write(proc.stderr[-2000:])
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    if proc.returncode != 0 or not lines:
+        sys.stderr.write(
+            f"[bench] serving fallback rc={proc.returncode}; "
+            "no JSON — falling back to the error row\n")
+        return False
+    try:
+        doc = json.loads(lines[-1])
+    except ValueError:
+        return False
+    doc.setdefault("fallback_reason", reason)
+    print(json.dumps(doc))
+    sys.stdout.flush()
+    return True
+
+
 def _supervise() -> None:
     """Run the benchmark in a child with a deadline; the parent has no
     JAX state so it can neither hang nor crash, and always emits the
@@ -590,6 +740,13 @@ def _supervise() -> None:
             break
         if attempt == 0:
             time.sleep(RETRY_SLEEP_S)
+    # Bench rescue (ROADMAP item 5a): a held claim degrades to the
+    # chip-free serving benchmark — a real number with latency
+    # quantiles — never a zero row. Deadlines on an ACQUIRED chip stay
+    # errors: the chip worked, the protocol didn't, and a fallback
+    # number would mask that.
+    if "claim-unavailable" in last_err and _try_serving_fallback(last_err):
+        return
     print(
         json.dumps(
             {
@@ -607,5 +764,7 @@ def _supervise() -> None:
 if __name__ == "__main__":
     if "--worker" in sys.argv:
         main()
+    elif "--serving-fallback" in sys.argv:
+        _serving_fallback_main()
     else:
         _supervise()

@@ -22,6 +22,7 @@ from pbs_tpu.analysis.counterapi import CounterApiPass
 from pbs_tpu.analysis.gatewaypass import GatewayDisciplinePass
 from pbs_tpu.analysis.locks import LockDisciplinePass
 from pbs_tpu.analysis.netdiscipline import NetDisciplinePass
+from pbs_tpu.analysis.obspass import ObsDisciplinePass
 from pbs_tpu.analysis.perfpass import PerfDisciplinePass
 from pbs_tpu.analysis.schedops import SchedOpsPass
 from pbs_tpu.analysis.units import TimeUnitPass
@@ -35,6 +36,7 @@ ALL_PASSES: tuple[type[Pass], ...] = (
     NetDisciplinePass,
     GatewayDisciplinePass,
     PerfDisciplinePass,
+    ObsDisciplinePass,
 )
 
 

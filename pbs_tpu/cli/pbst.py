@@ -119,6 +119,8 @@ def cmd_top(args) -> int:
 def cmd_trace(args) -> int:
     from pbs_tpu.obs.trace import chrome_trace, format_records
 
+    if args.file == "spans":
+        return _cmd_trace_spans(args)
     recs = np.load(args.file)
     if getattr(args, "chrome", None):
         with open(args.chrome, "w") as f:
@@ -128,6 +130,98 @@ def cmd_trace(args) -> int:
         return 0
     for line in format_records(recs):
         print(line)
+    return 0
+
+
+def _load_spans(path: str, rids_path: str | None):
+    """Span artifacts from an obs dir (pbst gateway demo --obs) or a
+    bare spans.npy + sidecar (docs/TRACING.md)."""
+    import os
+
+    from pbs_tpu.obs.spans import SpanAssembler, load_span_artifacts
+
+    if os.path.isdir(path):
+        recs, side = load_span_artifacts(path)
+    else:
+        recs = np.load(path)
+        side_path = rids_path or os.path.join(
+            os.path.dirname(os.path.abspath(path)), "spans.json")
+        with open(side_path) as f:
+            side = json.load(f)
+    asm = SpanAssembler(recs, side.get("rids", []),
+                        side.get("members"), side.get("tenant_table"))
+    return asm, side
+
+
+def _cmd_trace_spans(args) -> int:
+    """``pbst trace spans OBS`` — reconstruct request timelines from
+    drained SPAN_* records: per-rid chains (text), stable JSON
+    (--json), or Chrome trace-event JSON (--chrome)."""
+    from pbs_tpu.obs.trace import Ev
+
+    if not args.spans_path:
+        print("pbst: trace spans needs a path (obs dir or spans.npy)",
+              file=sys.stderr)
+        return 2
+    asm, side = _load_spans(args.spans_path, args.rids)
+    if getattr(args, "chrome", None):
+        with open(args.chrome, "w") as f:
+            json.dump(asm.chrome_trace(), f)
+        print(f"wrote {len(asm.chains)} span(s) to {args.chrome} "
+              "(chrome://tracing / Perfetto)")
+        return 0
+    if args.json:
+        doc = {
+            "version": 1,
+            "spans": asm.summary(),
+            "problems": asm.validate(),
+            "chains": {
+                rid: [[ts, Ev(ev).name, *a] for ts, ev, *a in chain]
+                for rid, chain in sorted(asm.chains.items())
+            },
+        }
+        print(json.dumps(doc, indent=1, sort_keys=True))
+        return 0
+    from pbs_tpu.obs.spans import SPAN_ARGS
+
+    members = side.get("members", [])
+    tenant_table = side.get("tenant_table", [])
+
+    def _member(m: int) -> str:
+        return members[m] if 0 <= m < len(members) else f"m{m}"
+
+    for rid, chain in sorted(asm.chains.items()):
+        slot = chain[0][2]
+        tenant = (tenant_table[slot] if 0 <= slot < len(tenant_table)
+                  else f"tenant{slot}")
+        print(f"span {rid} tenant={tenant}")
+        for ts, ev, *a in chain:
+            nargs, member_at = SPAN_ARGS.get(int(ev), (len(a), None))
+            shown = a[:nargs]
+            if member_at is None:  # HANDOFF: from -> to member pair
+                member = " -> ".join(_member(m) for m in shown[:2])
+            else:
+                member = _member(shown[member_at]) \
+                    if member_at < len(shown) else ""
+            print(f"  [{ts / 1e9:.6f}] {Ev(ev).name:<14} "
+                  f"{' '.join(map(str, shown))}"
+                  f"{'  @' + member if member else ''}")
+    problems = asm.validate()
+    for p in problems:
+        print(f"PROBLEM: {p}")
+    return 1 if problems else 0
+
+
+def cmd_slo(args) -> int:
+    """``pbst slo report OBS`` — per-tenant p50/p95/p99 + SLO
+    burn-rate from span artifacts, stable JSON on stdout
+    (docs/TRACING.md)."""
+    asm, side = _load_spans(args.obs, None)
+    report = asm.slo_report(tenants=side.get("tenants"),
+                            run_meta=side.get("run"))
+    if side.get("lost"):
+        report["lost_records"] = int(side["lost"])
+    print(json.dumps(report, indent=1, sort_keys=True))
     return 0
 
 
@@ -716,7 +810,8 @@ def cmd_chaos(args) -> int:
 
         kw = dict(workload=args.workload, seed=args.seed,
                   n_gateways=args.gateways, n_tenants=args.tenants,
-                  ticks=args.rounds * 80, trace_path=args.trace)
+                  ticks=args.rounds * 80, trace_path=args.trace,
+                  obs_dir=args.obs)
         report = run_federation_chaos(**kw)
         ok = report["ok"]
         if args.selfcheck:
@@ -756,7 +851,8 @@ def cmd_chaos(args) -> int:
 
         kw = dict(workload=args.workload, seed=args.seed,
                   n_backends=args.agents, n_tenants=args.tenants,
-                  ticks=args.rounds * 80, trace_path=args.trace)
+                  ticks=args.rounds * 80, trace_path=args.trace,
+                  obs_dir=args.obs)
         report = run_gateway_chaos(**kw)
         ok = report["ok"]
         if args.selfcheck:
@@ -864,6 +960,8 @@ def cmd_gateway(args) -> int:
     partition's.
     """
     if args.action == "stats":
+        import os
+
         from pbs_tpu.gateway.gateway import GW_LEDGER_SLOTS
         from pbs_tpu.telemetry import Counter, Ledger
 
@@ -871,26 +969,42 @@ def cmd_gateway(args) -> int:
             print("pbst: gateway stats needs --ledger", file=sys.stderr)
             return 2
         led = Ledger.file_backed(args.ledger, readonly=True)
+        # Histogram sidecar (docs/TRACING.md): quantiles from the SAME
+        # log2 histograms `pbst slo report` and the gateway's own
+        # shed/boost decisions read — not a cumulative-sum mean that
+        # hides the tail. Falls back to means on a pre-histogram
+        # ledger.
+        hist = None
+        if os.path.exists(args.ledger + ".hist.meta.json"):
+            from pbs_tpu.obs.spans import LatencyHistograms
+
+            hist = LatencyHistograms.attach(args.ledger + ".hist")
+        tail_hdr = (
+            f"{'qdelay_p50_ms':>14} {'qdelay_p99_ms':>14} "
+            f"{'e2e_p99_ms':>11}" if hist is not None else
+            f"{'avg_qdelay_ms':>14} {'avg_service_ms':>15}")
         print(f"{'class':<14} {'completed':>10} {'dispatched':>10} "
-              f"{'shed':>6} {'requeued':>8} {'cost':>8} "
-              f"{'avg_qdelay_ms':>14} {'avg_service_ms':>15}")
+              f"{'shed':>6} {'requeued':>8} {'cost':>8} " + tail_hdr)
         for cls, slot in GW_LEDGER_SLOTS.items():
             snap = led.snapshot(slot)
             dispatched = int(snap[Counter.SCHED_COUNT])
             completed = int(snap[Counter.STEPS_RETIRED])
-            # The ledger counters are cumulative sums; render the
-            # per-request means an operator reads as latency figures.
-            qdelay = (int(snap[Counter.RUNQ_WAIT_NS]) / 1e6
-                      / max(1, dispatched))
-            service = (int(snap[Counter.DEVICE_TIME_NS]) / 1e6
-                       / max(1, completed))
+            if hist is not None:
+                tail = (
+                    f"{hist.class_quantile(cls, 'queue', 0.50) / 1e6:>14.3f} "
+                    f"{hist.class_quantile(cls, 'queue', 0.99) / 1e6:>14.3f} "
+                    f"{hist.class_quantile(cls, 'e2e', 0.99) / 1e6:>11.3f}")
+            else:
+                qdelay = (int(snap[Counter.RUNQ_WAIT_NS]) / 1e6
+                          / max(1, dispatched))
+                service = (int(snap[Counter.DEVICE_TIME_NS]) / 1e6
+                           / max(1, completed))
+                tail = f"{qdelay:>14.3f} {service:>15.3f}"
             print(f"{cls:<14} {completed:>10} "
                   f"{dispatched:>10} "
                   f"{int(snap[Counter.COMPILES]):>6} "
                   f"{int(snap[Counter.YIELDS]):>8} "
-                  f"{int(snap[Counter.TOKENS]):>8} "
-                  f"{qdelay:>14.3f} "
-                  f"{service:>15.3f}")
+                  f"{int(snap[Counter.TOKENS]):>8} " + tail)
         return 0
     # demo: the chaos harness with no faults and no backend kill.
     from pbs_tpu.faults import FaultPlan
@@ -904,7 +1018,8 @@ def cmd_gateway(args) -> int:
             n_gateways=args.gateways,
             backends_per_gateway=args.backends,
             n_tenants=args.tenants,
-            ticks=args.ticks, plan=FaultPlan(seed=args.seed))
+            ticks=args.ticks, plan=FaultPlan(seed=args.seed),
+            obs_dir=args.obs)
         if args.json:
             print(json.dumps(report, indent=1, sort_keys=True))
             return 0 if report["ok"] else 1
@@ -928,7 +1043,8 @@ def cmd_gateway(args) -> int:
         workload=args.workload, seed=args.seed,
         n_backends=args.backends, n_tenants=args.tenants,
         ticks=args.ticks, plan=FaultPlan(seed=args.seed),
-        ledger_path=args.ledger, kill_backend=False)
+        ledger_path=args.ledger, kill_backend=False,
+        obs_dir=args.obs)
     if args.json:
         print(json.dumps(report, indent=1, sort_keys=True))
         return 0 if report["ok"] else 1
@@ -1069,11 +1185,33 @@ def main(argv=None) -> int:
     sp.add_argument("--prefix-cache", type=int, default=4)
     sp.set_defaults(fn=cmd_serve_demo)
 
-    sp = sub.add_parser("trace", help="format a trace dump (xentrace)")
-    sp.add_argument("file")
+    sp = sub.add_parser(
+        "trace",
+        help="format a trace dump (xentrace); 'trace spans OBS' "
+             "reconstructs request timelines (docs/TRACING.md)")
+    sp.add_argument("file",
+                    help="trace .npy to format, or the literal word "
+                         "'spans' for span-timeline mode")
+    sp.add_argument("spans_path", nargs="?",
+                    help="with 'spans': obs dir (pbst gateway demo "
+                         "--obs) or spans.npy")
+    sp.add_argument("--rids", metavar="SPANS.json",
+                    help="span sidecar when spans_path is a bare .npy "
+                         "(default: spans.json next to it)")
+    sp.add_argument("--json", action="store_true",
+                    help="with 'spans': stable JSON chains instead of "
+                         "the text timelines")
     sp.add_argument("--chrome", metavar="OUT.json",
                     help="write Chrome trace-event JSON instead")
     sp.set_defaults(fn=cmd_trace)
+
+    sp = sub.add_parser(
+        "slo", help="per-tenant SLO report from span artifacts "
+                    "(docs/TRACING.md)")
+    sp.add_argument("action", choices=["report"])
+    sp.add_argument("obs", help="obs dir written by pbst gateway demo "
+                                "--obs / pbst chaos --obs")
+    sp.set_defaults(fn=cmd_slo)
 
     sp = sub.add_parser("store", help="store ops (xenstore)")
     sp.add_argument("op", choices=["ls", "read", "write", "rm"])
@@ -1298,6 +1436,9 @@ def main(argv=None) -> int:
                          "'none', or a FaultPlan JSON path")
     sp.add_argument("--trace", default=None,
                     help="write the fault trace JSONL here")
+    sp.add_argument("--obs", default=None, metavar="DIR",
+                    help="write span artifacts here (gateway/"
+                         "federation plans; docs/TRACING.md)")
     sp.add_argument("--no-replication", action="store_true")
     sp.add_argument("--selfcheck", action="store_true",
                     help="run twice; digests must match")
@@ -1323,6 +1464,9 @@ def main(argv=None) -> int:
                     help="gateway pump rounds (1 ms of virtual time each)")
     sp.add_argument("--ledger", default=None,
                     help="gateway telemetry ledger file (stats action)")
+    sp.add_argument("--obs", default=None, metavar="DIR",
+                    help="write span artifacts here for pbst trace "
+                         "spans / pbst slo report (docs/TRACING.md)")
     sp.add_argument("--json", action="store_true")
     sp.set_defaults(fn=cmd_gateway)
 

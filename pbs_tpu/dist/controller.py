@@ -60,6 +60,10 @@ class AgentHandle:
     #: informed alive/breaker state). 0 = never observed. The
     #: backend_health() staleness stamp derives from this.
     observed_ns: int = 0
+    #: Backend attribution (docs/TRACING.md): observed service-time p99
+    #: for the co-named serving backend, published by a gateway's
+    #: histogram export (``note_backend_service``). 0 = never reported.
+    service_p99_ns: int = 0
 
 
 @dataclasses.dataclass
@@ -783,9 +787,21 @@ class Controller:
                 "load": int(h.info.get("n_jobs", 0)),
                 "observed_ns": h.observed_ns,
                 "stale": now - h.observed_ns > self.health_ttl_ns,
+                "service_p99_ns": h.service_p99_ns,
             }
             for name, h in self.agents.items()
         }
+
+    def note_backend_service(self, name: str, p99_ns: int) -> None:
+        """Backend attribution from the serving tier: a gateway
+        publishes the co-named backend's histogram-derived service p99
+        (pbs_tpu.obs.spans) so the health view carries a *measured*
+        service figure, not just a job-count load proxy. Unknown names
+        are ignored — the gateway may front backends the cluster
+        controller does not manage."""
+        h = self.agents.get(name)
+        if h is not None:
+            h.service_p99_ns = int(p99_ns)
 
     # -- admission leasing (the federated gateway tier's authority) ------
 

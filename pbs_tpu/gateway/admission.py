@@ -84,6 +84,16 @@ class TokenBucket:
         return int(deficit / self.rate * SEC) + 1
 
 
+#: Shed reasons -> stable small ints for trace args (GW_SHED and
+#: SPAN_SHED records carry the code, never the string). Lives next to
+#: :class:`Shed` so the taxonomy and its wire encoding stay in one
+#: place; 0 is reserved for "unknown reason".
+SHED_REASON_CODES = {
+    "quota": 1, "tenant-queue-full": 2, "queue-full": 3,
+    "unknown-tenant": 4, "injected-shed": 5, "cost-over-burst": 6,
+}
+
+
 @dataclasses.dataclass(frozen=True)
 class Shed:
     """An explicit rejection: why, and when to come back."""
@@ -91,6 +101,10 @@ class Shed:
     reason: str  # "quota" | "tenant-queue-full" | "queue-full" |
     # "cost-over-burst" | "unknown-tenant" | "injected-shed"
     retry_after_ns: int
+
+    @property
+    def reason_code(self) -> int:
+        return SHED_REASON_CODES.get(self.reason, 0)
 
 
 class AdmissionController:

@@ -33,6 +33,12 @@ class Backend:
 
     name: str = "backend"
     capacity: int = 1  # concurrent requests before queueing inside
+    #: Span seam (docs/TRACING.md): the gateway's recorder wiring sets
+    #: this to ``(request, now_ns) -> None``; backends call it when a
+    #: dispatched request actually STARTS executing (enters a run slot
+    #: / the engine), distinguishing backend-internal queueing from
+    #: execution on the request's timeline. None = spans off.
+    exec_hook = None
 
     def alive(self) -> bool:
         return True
@@ -97,6 +103,8 @@ class SimServeBackend(Backend):
             req = self._waiting.popleft()
             self._running.append(
                 (now_ns + self._service_ns(req), now_ns, req))
+            if self.exec_hook is not None:
+                self.exec_hook(req, now_ns)
 
     def dispatch_request(self, req: Request, now_ns: int) -> None:
         if not self._alive:
@@ -148,11 +156,20 @@ class BatcherBackend(Backend):
         #: — admission/fairness bypasses (the gateway-discipline stat).
         self.bypass_submits = 0
         self._dispatching = False
+        self._dispatching_req: tuple[Request, int] | None = None
         prev_hook = getattr(engine, "submit_hook", None)
 
         def _hook(rid: int, prompt_len: int, max_new: int) -> None:
             if not self._dispatching:
                 self.bypass_submits += 1
+            elif (self.exec_hook is not None
+                    and self._dispatching_req is not None):
+                # Span execution attribution rides the same engine
+                # submit_hook seam the bypass counter uses: a gateway
+                # dispatch that reached engine.submit has entered the
+                # execution pipeline (prefill queue), which is this
+                # backend's observable "execution begins".
+                self.exec_hook(*self._dispatching_req)
             if prev_hook is not None:
                 prev_hook(rid, prompt_len, max_new)
 
@@ -166,11 +183,13 @@ class BatcherBackend(Backend):
 
     def dispatch_request(self, req: Request, now_ns: int) -> None:
         self._dispatching = True
+        self._dispatching_req = (req, now_ns)
         try:
             erid = self.engine.submit(req.payload["prompt"],
                                       int(req.payload["max_new"]))
         finally:
             self._dispatching = False
+            self._dispatching_req = None
         self._by_engine_rid[erid] = req
 
     def poll(self, now_ns: int) -> list[tuple[Request, dict]]:

@@ -44,6 +44,7 @@ from pbs_tpu.gateway.admission import INTERACTIVE, TenantQuota
 from pbs_tpu.gateway.backends import SimServeBackend
 from pbs_tpu.gateway.federation import FederatedGateway
 from pbs_tpu.gateway.gateway import Gateway
+from pbs_tpu.obs.spans import SpanAssembler, SpanRecorder
 from pbs_tpu.sim.workload import build_workload
 from pbs_tpu.utils.clock import MS, SEC, VirtualClock
 
@@ -77,13 +78,60 @@ def draw_arrival(t, rng) -> tuple[bool, int]:
     return u < 0.15, 4 + int(rng.integers(0, 9))
 
 
+def _span_continuity(recorder: SpanRecorder, admitted_rids: list[str],
+                     problems: list[str]) -> tuple[SpanAssembler, Any]:
+    """The span-continuity invariant both harnesses gate on
+    (docs/TRACING.md): every admitted rid has a COMPLETE, GAP-FREE
+    chain (admit → terminal complete) in the recorder's ring — across
+    backend loss, gateway death, partitions, drains, and rejoins — and
+    the ring dropped nothing (a lost record would be an unverifiable
+    gap, so it is a failure, not a shrug). Purely an observer: the
+    recorder consumes no randomness, so arming it never moves the
+    run's digests."""
+    if recorder.ring.lost:
+        problems.append(
+            f"span ring dropped {int(recorder.ring.lost)} record(s); "
+            "chains unverifiable (size the ring for the run)")
+    if recorder.dropped_spans:
+        problems.append(
+            f"span recorder dropped {recorder.dropped_spans} new "
+            "span(s) at the intern bound; chains unverifiable (raise "
+            "max_spans for the run)")
+    recs = recorder.drain()
+    asm = SpanAssembler(recs, recorder.rid_table(),
+                        recorder.member_table(),
+                        recorder.tenant_table())
+    chain_problems = asm.validate(admitted_rids)
+    # Cap the spew: one run with a systemic gap would otherwise emit
+    # thousands of identical lines.
+    problems.extend(chain_problems[:20])
+    if len(chain_problems) > 20:
+        problems.append(
+            f"... and {len(chain_problems) - 20} more span-chain "
+            "problem(s)")
+    return asm, recs
+
+
+def _export_obs(recorder: SpanRecorder, recs, obs_dir: str | None,
+                tenants, run_meta: dict) -> None:
+    if obs_dir is None:
+        return
+    recorder.export(
+        obs_dir, run_meta=run_meta,
+        tenants={t.name: {"slo": t.slo,
+                          "slo_target_ns": t.slo_target_ns}
+                 for t in tenants},
+        recs=recs)
+
+
 def run_gateway_chaos(workload: str = "mixed", seed: int = 0,
                       n_backends: int = 3, n_tenants: int = 4,
                       ticks: int = 400, tick_ns: int = 1 * MS,
                       plan: FaultPlan | None = None,
                       trace_path: str | None = None,
                       ledger_path: str | None = None,
-                      kill_backend: bool = True) -> dict:
+                      kill_backend: bool = True,
+                      obs_dir: str | None = None) -> dict:
     """One seeded gateway chaos scenario; returns the report dict
     (``ok`` = every invariant held). Installs the plan process-wide for
     the duration — callers must not have their own plan armed."""
@@ -103,8 +151,10 @@ def run_gateway_chaos(workload: str = "mixed", seed: int = 0,
             for i in range(max(1, int(n_backends)))
         ]
         tenants = build_workload(workload, seed=seed, n_tenants=n_tenants)
+        spans = SpanRecorder(capacity=1 << 16)
         gw = Gateway(backends, clock=clock, max_queued=64 * len(tenants),
-                     trace_capacity=8192, ledger_path=ledger_path)
+                     trace_capacity=8192, ledger_path=ledger_path,
+                     spans=spans)
         for t in tenants:
             gw.register_tenant(
                 t.name, quota_for(t.name, t.slo, t.params.weight))
@@ -114,6 +164,7 @@ def run_gateway_chaos(workload: str = "mixed", seed: int = 0,
         shed_results = 0
         completions: list[tuple[str, dict]] = []
         seen_rids: set[str] = set()
+        admitted_rids: list[str] = []
 
         def _check_books(where: str) -> None:
             acct = gw.completed + gw.queue.depth() + len(gw.inflight)
@@ -131,7 +182,9 @@ def run_gateway_chaos(workload: str = "mixed", seed: int = 0,
                 if not fire:
                     continue
                 r = gw.submit(t.name, {"tick": tick}, cost=cost)
-                if not r.admitted:
+                if r.admitted:
+                    admitted_rids.append(r.rid)
+                else:
                     shed_results += 1
                     if r.retry_after_ns <= 0:
                         problems.append(
@@ -168,6 +221,11 @@ def run_gateway_chaos(workload: str = "mixed", seed: int = 0,
             problems.append(
                 f"shed accounting drift: {shed_results} shed results, "
                 f"{shed_books} in the admission books")
+        asm, span_recs = _span_continuity(spans, admitted_rids, problems)
+        _export_obs(spans, span_recs, obs_dir, tenants, {
+            "harness": "gateway", "workload": workload, "seed": seed,
+            "backends": n_backends, "tenants": n_tenants, "ticks": ticks,
+        })
     finally:
         faults_mod.uninstall()
 
@@ -183,6 +241,7 @@ def run_gateway_chaos(workload: str = "mixed", seed: int = 0,
         "plan": plan.as_dict(),
         "killed_backend": backends[0].name if kill_at >= 0 else None,
         "stats": st,
+        "spans": asm.summary(),
         "faults_fired": dict(sorted(fault_counts.items())),
         "trace_digest": inj.trace_digest(),
         "problems": problems,
@@ -220,7 +279,8 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
                          ticks: int = 400, tick_ns: int = 1 * MS,
                          plan: FaultPlan | None = None,
                          trace_path: str | None = None,
-                         drain_rejoin: bool = True) -> dict:
+                         drain_rejoin: bool = True,
+                         obs_dir: str | None = None) -> dict:
     """One seeded federated-gateway chaos scenario; returns the report
     dict (``ok`` = every invariant held). Gateway deaths, partitions,
     and lease expiries come from the armed plan; a drain of a seeded
@@ -237,9 +297,11 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
                                backends_per_gateway, n_tenants)
             for i in range(max(1, int(n_gateways)))
         ]
+        spans = SpanRecorder(capacity=1 << 16)
         fed = FederatedGateway(members, clock=clock,
                                renew_period_ns=4 * tick_ns,
-                               lease_ttl_ns=6 * tick_ns)
+                               lease_ttl_ns=6 * tick_ns,
+                               spans=spans)
         tenants = build_workload(workload, seed=seed, n_tenants=n_tenants)
         quotas: dict[str, TenantQuota] = {}
         for t in tenants:
@@ -252,6 +314,7 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
 
         start_ns = clock.now_ns()
         admitted_cost: dict[str, float] = {}
+        admitted_rids: list[str] = []
         shed_results = 0
         completions: list[tuple[str, dict]] = []
 
@@ -283,6 +346,7 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
                 if r.admitted:
                     admitted_cost[t.name] = \
                         admitted_cost.get(t.name, 0.0) + cost
+                    admitted_rids.append(r.rid)
                 else:
                     shed_results += 1
                     if r.retry_after_ns <= 0:
@@ -366,6 +430,15 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
             problems.append(
                 f"shed accounting drift: {shed_results} shed results, "
                 f"{shed_books} in the books")
+        # THE federation span invariant: one continuous, gap-free
+        # chain per admitted rid even across gateway.death /
+        # gateway.partition / drain+rejoin — custody transfers stitch,
+        # they do not restart.
+        asm, span_recs = _span_continuity(spans, admitted_rids, problems)
+        _export_obs(spans, span_recs, obs_dir, tenants, {
+            "harness": "federation", "workload": workload, "seed": seed,
+            "gateways": n_gateways, "tenants": n_tenants, "ticks": ticks,
+        })
     finally:
         faults_mod.uninstall()
 
@@ -393,6 +466,7 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
         "plan": plan.as_dict(),
         "events": events,
         "stats": st,
+        "spans": asm.summary(),
         "lease_audit": {t: {k: round(v, 6) for k, v in a.items()}
                         for t, a in sorted(audit.items())},
         "faults_fired": dict(sorted(fault_counts.items())),

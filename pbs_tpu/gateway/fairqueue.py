@@ -84,6 +84,11 @@ class DeficitRoundRobin:
         self._ring: dict[str, deque[str]] = {c: deque() for c in SLO_CLASSES}
         self._weights: dict[str, int] = {}
         self._depth = 0
+        #: DRR deficit the last :meth:`pop`'d tenant had LEFT after
+        #: paying for the dispatched request — the span layer attaches
+        #: it to SPAN_DISPATCH so a timeline shows how much credit the
+        #: tenant dispatched on (docs/TRACING.md).
+        self.last_deficit = 0.0
 
     # -- intake ----------------------------------------------------------
 
@@ -183,6 +188,7 @@ class DeficitRoundRobin:
             head = fifo[0]
             if deficit.get(tenant, 0.0) >= head.cost:
                 deficit[tenant] -= head.cost
+                self.last_deficit = deficit[tenant]
                 self._depth -= 1
                 req = fifo.popleft()
                 if not fifo:  # retire promptly; reset carried deficit
@@ -200,6 +206,7 @@ class DeficitRoundRobin:
         if not fifo:
             return None
         deficit[tenant] = 0.0
+        self.last_deficit = 0.0
         self._depth -= 1
         req = fifo.popleft()
         if not fifo:

@@ -387,10 +387,17 @@ class FederatedGateway:
                  clock=None, vnodes: int = 64,
                  renew_period_ns: int = DEFAULT_RENEW_PERIOD_NS,
                  lease_ttl_ns: int = DEFAULT_LEASE_TTL_NS,
-                 conservative_frac: float | None = None):
+                 conservative_frac: float | None = None,
+                 spans=None):
         if not members:
             raise ValueError("federation needs at least one gateway")
         self.clock = clock if clock is not None else members[0].clock
+        #: ONE SpanRecorder shared by every member (obs/spans.py):
+        #: all members pump on this federation's single thread, so a
+        #: shared ring keeps each request's chain in emission order
+        #: even when custody moves between members — the stitched
+        #: timeline is a property of construction, not of a merge.
+        self.spans = spans
         self.controller = controller
         self.broker = LeaseBroker()
         if controller is not None and hasattr(controller,
@@ -442,6 +449,8 @@ class FederatedGateway:
                 "FederatedGateway.register_tenant, the lease path")
         self.members[gw.name] = gw
         gw.admission.bucket_factory = self._bucket_factory(gw.name)
+        if self.spans is not None:
+            gw.attach_spans(self.spans)
         self.ring.add(gw.name)
 
     def _bucket_factory(self, gw_name: str):
@@ -559,18 +568,25 @@ class FederatedGateway:
         gw.inflight.clear()
         for req in casualties:
             target = self._handoff_target(req.tenant)
+            if self.spans is not None:
+                self.spans.handoff(now, req.rid, name, target.name)
             target.adopt(req)
             self.handoffs += 1
         self.broker.revoke(name)
         self._retired.append(gw)
 
     def _handoff_queued(self, gw: Gateway) -> None:
+        now = self.clock.now_ns()
         for cls in SLO_CLASSES:
             for tenant in gw.queue.tenants(cls):
                 reqs, deficit = gw.queue.take_tenant(cls, tenant)
                 if not reqs:
                     continue
                 target = self._handoff_target(tenant)
+                if self.spans is not None:
+                    for r in reqs:
+                        self.spans.handoff(now, r.rid, gw.name,
+                                           target.name)
                 target.adopt_tenant(cls, tenant, reqs, deficit)
                 self.handoffs += len(reqs)
 
@@ -779,6 +795,8 @@ class FederatedGateway:
                 self.events.append({"now_ns": now, "event": "remove",
                                     "gateway": name})
                 self._retire(name)
+        if self.spans is not None:
+            self.spans.flush()
         return done
 
     # -- observability ---------------------------------------------------

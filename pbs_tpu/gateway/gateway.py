@@ -45,10 +45,10 @@ from pbs_tpu.gateway.admission import (
 )
 from pbs_tpu.gateway.backends import Backend
 from pbs_tpu.gateway.fairqueue import DeficitRoundRobin, Request
+from pbs_tpu.obs.spans import LatencyHistograms, SpanRecorder
 from pbs_tpu.obs.trace import EmitBatch, Ev, TraceBuffer
 from pbs_tpu.telemetry.counters import Counter
 from pbs_tpu.utils.clock import MS, MonotonicClock
-from pbs_tpu.utils.stats import nearest_rank
 
 #: Ledger counter reuse for the per-class gateway slots (the ledger
 #: layout is the fixed 18-counter page; the gateway maps its stats onto
@@ -61,12 +61,6 @@ from pbs_tpu.utils.stats import nearest_rank
 #:   COMPILES       sheds (explicit rejections)
 #:   TOKENS         cost units completed
 GW_LEDGER_SLOTS = {cls: i for i, cls in enumerate(SLO_CLASSES)}
-
-#: Shed reasons -> stable small ints for trace args.
-SHED_REASON_CODES = {
-    "quota": 1, "tenant-queue-full": 2, "queue-full": 3,
-    "unknown-tenant": 4, "injected-shed": 5, "cost-over-burst": 6,
-}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -95,6 +89,8 @@ class Gateway:
         feedback_period_ns: int = 10 * MS,
         drr_quantum: int = 16,
         name: str = "gw",
+        spans: SpanRecorder | None = None,
+        hist_slots: int = 256,
     ):
         if not backends:
             raise ValueError("gateway needs at least one backend")
@@ -139,6 +135,24 @@ class Gateway:
             for slot in GW_LEDGER_SLOTS.values():
                 self._ledger.reset(slot)
             self._write_ledger_meta()
+        #: Allocation-free log2 latency histograms per (tenant, class,
+        #: stage) + per-backend service rows, living in ledger slots —
+        #: file-backed next to the class ledger so `pbst gateway stats`
+        #: and `pbst slo report` attach like any monitor
+        #: (docs/TRACING.md). Always on: stats()/feedback read these.
+        self.hist = LatencyHistograms(
+            num_slots=hist_slots,
+            path=(ledger_path + ".hist") if ledger_path else None)
+        #: Request-span recorder (docs/TRACING.md): injected by a
+        #: federation (shared across members so chains stitch), or
+        #: derived from this gateway's own trace ring when tracing is
+        #: on — span records ride the same EmitBatch as the GW_* class.
+        self.spans: SpanRecorder | None = None
+        if spans is not None:
+            self.attach_spans(spans)
+        elif self.trace is not None:
+            self.attach_spans(SpanRecorder(ring=self.trace,
+                                           batch=self._trace_batch))
         self.feedback_sink = feedback_sink
         self.feedback_period_ns = int(feedback_period_ns)
         self._last_feedback_ns = now
@@ -154,9 +168,26 @@ class Gateway:
         self.requeued = 0
         self.dispatched = 0
         self.adopted = 0  # requests admitted at ANOTHER federated member
+        #: Raw queue-delay window; the feedback watermark tests sum it
+        #: (latency percentiles come from the histograms, not a deque).
         self._delays = {cls: deque(maxlen=1024) for cls in SLO_CLASSES}
-        self._latencies = {cls: deque(maxlen=1024) for cls in SLO_CLASSES}
         self.completions: deque = deque(maxlen=4096)  # (rid, info)
+
+    # -- spans (docs/TRACING.md) -----------------------------------------
+
+    def attach_spans(self, recorder: SpanRecorder) -> None:
+        """Install the span recorder and wire the backend execution
+        hooks. A federation calls this on every member with ONE shared
+        recorder, so a request handed off between members keeps one
+        stitched chain in one ring."""
+        self.spans = recorder
+        for b in self.backends:
+            b.exec_hook = self._span_exec
+
+    def _span_exec(self, req: Request, now_ns: int) -> None:
+        if self.spans is not None:
+            self.spans.exec(now_ns, req.rid,
+                            self._backend_slot(req.backend), self.name)
 
     # -- tenants ---------------------------------------------------------
 
@@ -220,6 +251,10 @@ class Gateway:
         self.admitted += 1
         self._emit(now, Ev.GW_ADMIT, self._slot_of(tenant),
                    self._cls_code(cls), cost, self.queue.depth())
+        if self.spans is not None:
+            cc = self._cls_code(cls)
+            self.spans.admit(now, rid, tenant, cc, cost, self.name)
+            self.spans.enqueue(now, rid, tenant, cc, self.name)
         return SubmitResult(True, rid)
 
     # -- federation custody transfer (docs/GATEWAY.md "Federation") ------
@@ -237,6 +272,9 @@ class Gateway:
         self.queue.requeue_front(req)
         self._emit(now, Ev.GW_REQUEUE, self._slot_of(req.tenant),
                    self._cls_code(req.slo), self._backend_slot(None))
+        if self.spans is not None:
+            self.spans.requeue(now, req.rid, self._backend_slot(None),
+                               self.name)
 
     def adopt_tenant(self, cls: str, tenant: str, requests: list[Request],
                      deficit: float = 0.0) -> None:
@@ -281,7 +319,11 @@ class Gateway:
                 self.completed += 1
                 cls = req.slo
                 lat = now - req.submit_ns + req.penalty_ns
-                self._latencies[cls].append(lat)
+                service_ns = int(info.get("service_ns", 0))
+                self.hist.record(req.tenant, cls, "e2e", lat)
+                self.hist.record(req.tenant, cls, "service", service_ns)
+                self.hist.record(f"be:{b.name}", "*", "service",
+                                 service_ns)
                 info = {**info, "tenant": req.tenant, "slo": cls,
                         "latency_ns": lat,
                         "queue_delay_ns": req.queue_delay_ns}
@@ -289,12 +331,15 @@ class Gateway:
                 self.completions.append((req.rid, info))
                 self._ledger_add(cls, Counter.STEPS_RETIRED, 1)
                 self._ledger_add(cls, Counter.TOKENS, req.cost)
-                self._ledger_add(cls, Counter.DEVICE_TIME_NS,
-                                 int(info.get("service_ns", 0)))
+                self._ledger_add(cls, Counter.DEVICE_TIME_NS, service_ns)
                 self._emit(now, Ev.GW_COMPLETE, self._slot_of(req.tenant),
                            self._cls_code(cls),
                            self._backend_slot(req.backend),
-                           int(info.get("service_ns", 0)))
+                           service_ns)
+                if self.spans is not None:
+                    self.spans.complete(now, req.rid,
+                                        self._backend_slot(b.name),
+                                        service_ns, lat, self.name)
         return out
 
     # backend loss: drain + requeue, never drop
@@ -323,6 +368,10 @@ class Gateway:
                 self._emit(now, Ev.GW_REQUEUE, self._slot_of(req.tenant),
                            self._cls_code(req.slo),
                            self._backend_slot(b.name))
+                if self.spans is not None:
+                    self.spans.requeue(now, req.rid,
+                                       self._backend_slot(b.name),
+                                       self.name)
 
     def _eligible(self, health: dict | None = None) -> list[Backend]:
         """Live backends, controller-health vetted (breaker-open or
@@ -372,10 +421,17 @@ class Gateway:
                 # eligible backend, capacity bound waived — latency
                 # degrades, the request is never lost.
                 target = eligible[-1]
+            first_dispatch = req.dispatch_ns < 0
             req.backend = target.name
             req.dispatch_ns = now
             req.queue_delay_ns = now - req.submit_ns + req.penalty_ns
             self._delays[req.slo].append(req.queue_delay_ns)
+            if first_dispatch:
+                # Requeued casualties re-dispatch with a CUMULATIVE
+                # delay; one histogram sample per request keeps the
+                # quantiles a per-request distribution.
+                self.hist.record(req.tenant, req.slo, "queue",
+                                 req.queue_delay_ns)
             # Settle the feedback watermark: only the wait not already
             # exported by the stuck-queue sentinel (or a previous
             # dispatch, for requeued casualties) enters the channel, so
@@ -387,6 +443,15 @@ class Gateway:
             self._fb_events[req.slo] += 1
             self.inflight[req.rid] = req
             self.dispatched += 1
+            if self.spans is not None:
+                # BEFORE dispatch_request: a backend with a free run
+                # slot fires the exec hook synchronously, and SPAN_EXEC
+                # must land after SPAN_DISPATCH on the chain.
+                self.spans.dispatch(
+                    now, req.rid, self._backend_slot(target.name),
+                    req.queue_delay_ns,
+                    int(max(0.0, self.queue.last_deficit) * 1000),
+                    self.name)
             target.dispatch_request(req, now)
             self._ledger_add(req.slo, Counter.SCHED_COUNT, 1)
             self._ledger_add(req.slo, Counter.RUNQ_WAIT_NS,
@@ -406,10 +471,25 @@ class Gateway:
         denom = self.admitted + shed_total
         shed_ppm = int(1_000_000 * shed_total / denom) if denom else 0
         for cls in SLO_CLASSES:
-            delays = self._delays[cls]
+            # The exported quantiles come from the SAME histograms
+            # stats() and `pbst slo report` read, so shed/boost
+            # decisions and the operator surfaces agree on one
+            # estimator (docs/TRACING.md).
             self._emit(now, Ev.GW_QDELAY, self._cls_code(cls),
-                       int(nearest_rank(delays, 0.50)),
-                       int(nearest_rank(delays, 0.99)), shed_ppm)
+                       self.hist.class_quantile(cls, "queue", 0.50),
+                       self.hist.class_quantile(cls, "queue", 0.99),
+                       shed_ppm)
+        if self.controller is not None and hasattr(
+                self.controller, "note_backend_service"):
+            # Backend attribution for the routing view: the controller
+            # health entries carry each backend's observed service p99
+            # so cross-gateway routing ranks on measured service time,
+            # not just queue depth.
+            for b in self.backends:
+                p99 = self.hist.quantile(f"be:{b.name}", "*",
+                                         "service", 0.99)
+                if p99:
+                    self.controller.note_backend_service(b.name, p99)
         if self.feedback_sink is not None:
             wait_ns = self._fb_delay_ns[INTERACTIVE]
             events = self._fb_events[INTERACTIVE]
@@ -451,9 +531,11 @@ class Gateway:
                    shed: Shed) -> None:
         self._ledger_add(cls, Counter.COMPILES, 1)
         self._emit(now, Ev.GW_SHED, self._slot_of(tenant),
-                   self._cls_code(cls),
-                   SHED_REASON_CODES.get(shed.reason, 0),
+                   self._cls_code(cls), shed.reason_code,
                    shed.retry_after_ns)
+        if self.spans is not None:
+            self.spans.shed(now, tenant, self._cls_code(cls),
+                            shed.reason_code, self.name)
 
     def _ledger_add(self, cls: str, counter: int, delta: int) -> None:
         if self._ledger is not None and delta:
@@ -482,13 +564,21 @@ class Gateway:
         self.flush_trace()
         per_class = {}
         for cls in SLO_CLASSES:
-            d, lt = self._delays[cls], self._latencies[cls]
+            # Histogram-backed (docs/TRACING.md): the same estimator
+            # `pbst slo report` and the feedback export use — not a
+            # windowed deque mean drifting away from the SLO view.
             per_class[cls] = {
                 "queued": self.queue.depth(cls),
-                "qdelay_p50_ns": int(nearest_rank(d, 0.50)),
-                "qdelay_p99_ns": int(nearest_rank(d, 0.99)),
-                "latency_p50_ns": int(nearest_rank(lt, 0.50)),
-                "latency_p99_ns": int(nearest_rank(lt, 0.99)),
+                "qdelay_p50_ns": self.hist.class_quantile(
+                    cls, "queue", 0.50),
+                "qdelay_p99_ns": self.hist.class_quantile(
+                    cls, "queue", 0.99),
+                "latency_p50_ns": self.hist.class_quantile(
+                    cls, "e2e", 0.50),
+                "latency_p95_ns": self.hist.class_quantile(
+                    cls, "e2e", 0.95),
+                "latency_p99_ns": self.hist.class_quantile(
+                    cls, "e2e", 0.99),
             }
         shed_total = sum(self.admission.sheds.values())
         denom = self.admitted + shed_total
@@ -509,7 +599,9 @@ class Gateway:
             "classes": per_class,
             "backends": {
                 b.name: {"alive": b.alive(), "depth": b.depth(),
-                         "capacity": b.capacity}
+                         "capacity": b.capacity,
+                         "service_p99_ns": self.hist.quantile(
+                             f"be:{b.name}", "*", "service", 0.99)}
                 for b in self.backends
             },
         }

@@ -4,10 +4,16 @@ from pbs_tpu.obs.mon import Monitor, SchedHistory
 from pbs_tpu.obs.oprofile import ProfileSession, ProfilerBusy
 from pbs_tpu.obs.perfc import Perfc, perfc
 from pbs_tpu.obs.selftest import CanaryResult, run_selftest, selftest_ok
+from pbs_tpu.obs.spans import (
+    LatencyHistograms,
+    SpanAssembler,
+    SpanRecorder,
+)
 from pbs_tpu.obs.trace import Ev, TraceBuffer, format_records
 
 __all__ = [
-    "CanaryResult", "Console", "Ev", "Monitor", "Perfc", "ProfileSession",
-    "ProfilerBusy", "ProfiledLock", "SchedHistory", "TraceBuffer",
+    "CanaryResult", "Console", "Ev", "LatencyHistograms", "Monitor",
+    "Perfc", "ProfileSession", "ProfilerBusy", "ProfiledLock",
+    "SchedHistory", "SpanAssembler", "SpanRecorder", "TraceBuffer",
     "format_records", "perfc", "run_selftest", "selftest_ok",
 ]

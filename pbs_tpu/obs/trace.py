@@ -88,6 +88,21 @@ class Ev(enum.IntEnum):
     # (telemetry/sampler.py): one record per threshold crossing, staged
     # through an EmitBatch so a quantum's firings cost one ring write
     TELEM_OVERFLOW = 0x0701  # args: ledger_slot, sample_id, counter, value
+    # request spans (0x08xx) — the causal request timeline through the
+    # serving tier (docs/TRACING.md; pbs_tpu.obs.spans). ``span`` is
+    # the recorder-interned id of the gateway rid (stitching key across
+    # federated members), ``member`` the interned gateway name. All
+    # emitted through the SpanRecorder's EmitBatch, never scalar.
+    SPAN_ADMIT = 0x0801  # args: span, tenant_slot, cls, cost, member
+    SPAN_SHED = 0x0802  # args: tenant_slot, cls, reason_code, member
+    SPAN_ENQUEUE = 0x0803  # args: span, tenant_slot, cls, member
+    SPAN_DISPATCH = 0x0804  # args: span, backend_slot, qdelay_ns,
+    #                               deficit_x1000, member
+    SPAN_EXEC = 0x0805  # args: span, backend_slot, member
+    SPAN_COMPLETE = 0x0806  # args: span, backend_slot, service_ns,
+    #                               latency_ns, member
+    SPAN_REQUEUE = 0x0807  # args: span, backend_slot, member
+    SPAN_HANDOFF = 0x0808  # args: span, from_member, to_member
 
 
 class TraceBuffer:

@@ -127,6 +127,51 @@ def _trace_consume(n: int) -> BenchFns:
     return run, reset, None
 
 
+def _span_emit(n: int) -> BenchFns:
+    """One SPAN_* lifecycle emit through the SpanRecorder's EmitBatch
+    staging path (docs/TRACING.md): the cost every gateway dispatch
+    pays when spans are armed, pinned so span overhead is regression-
+    gated like the rest of the substrate."""
+    from pbs_tpu.obs.spans import SpanRecorder
+    from pbs_tpu.obs.trace import TraceBuffer
+
+    ring = TraceBuffer(capacity=n + 512, native=False)
+    rec = SpanRecorder(ring=ring)
+    rec.dispatch(0, "r0", 1, 500, 1000, "gw")  # intern outside timing
+
+    def run() -> int:
+        dispatch = rec.dispatch
+        for i in range(n):
+            dispatch(i, "r0", 1, 500, 1000, "gw")
+        rec.flush()
+        return n
+
+    def reset() -> None:
+        rec.flush()
+        while ring.consume(4096).shape[0]:
+            pass
+
+    return run, reset, None
+
+
+def _hist_record(n: int) -> BenchFns:
+    """One log2-histogram latency sample into a ledger slot
+    (LatencyHistograms.record): the per-completion cost of the SLO
+    observability layer."""
+    from pbs_tpu.obs.spans import LatencyHistograms
+
+    h = LatencyHistograms(num_slots=16)
+    h.record("t0", "interactive", "queue", 1 << 12)  # intern the slot
+
+    def run() -> int:
+        record = h.record
+        for i in range(n):
+            record("t0", "interactive", "queue", 1 << (10 + (i & 15)))
+        return n
+
+    return run, lambda: None, None
+
+
 def _ledger_sample(n: int) -> BenchFns:
     from pbs_tpu.telemetry.counters import NUM_COUNTERS
     from pbs_tpu.telemetry.ledger import Ledger
@@ -214,6 +259,8 @@ BENCHES: dict[str, tuple[Callable[[int], BenchFns], int, int]] = {
     "trace.emit": (_trace_emit, 50_000, 8_192),
     "trace.emit_many": (_trace_emit_many, 65_536, 8_192),
     "trace.consume": (_trace_consume, 65_536, 8_192),
+    "span.emit": (_span_emit, 50_000, 8_192),
+    "hist.record": (_hist_record, 50_000, 8_192),
     # quick keeps >=100 timed snapshot_many calls: fewer lets one
     # scheduler hiccup read as a 2x "regression" in the CI smoke.
     "ledger.sample": (_ledger_sample, 12_800, 6_400),

@@ -48,6 +48,10 @@ class TenantSpec:
     # door queue this tenant's requests ride (pbs_tpu.gateway). Batch
     # by default; latency-sensitive generators override.
     slo: str = "batch"
+    # End-to-end latency target the SLO burn-rate report measures this
+    # tenant against (pbs_tpu.obs.spans; `pbst slo report`). None =
+    # the class default (DEFAULT_SLO_TARGET_NS).
+    slo_target_ns: int | None = None
 
 
 def _rng(seed: int, salt: int) -> np.random.Generator:

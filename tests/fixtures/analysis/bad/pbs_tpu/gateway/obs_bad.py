@@ -1,0 +1,35 @@
+"""Seeded obs-discipline violations (obs-unclosed-span,
+obs-span-emit-in-loop, obs-hist-scan)."""
+
+HIST_BUCKETS = 18
+SPAN_DISPATCH = 0x0804
+
+
+def route_one(span, req, backend):
+    """Begin with no terminal emit anywhere in the function."""
+    span.begin(req.rid)
+    backend.take(req)
+
+
+def route_checked(span, req, backend):
+    """Terminal exists, but the error path exits before it fires."""
+    span.begin(req.rid)
+    if not backend.alive():
+        return None  # span left open on this path
+    backend.take(req)
+    span.end(req.rid)
+    return req.rid
+
+
+def pump_spans(ring, reqs, clock):
+    """Scalar SPAN_* ring emit per event in a loop."""
+    for req in reqs:
+        ring.emit(clock.now_ns(), SPAN_DISPATCH, req.sid, 0)
+
+
+def tail_latency(counts):
+    """Per-bucket Python scan the vectorized quantile helper replaced."""
+    total = 0
+    for b in range(HIST_BUCKETS):
+        total += counts[b]
+    return total

@@ -1,0 +1,34 @@
+"""Behavior twin of obs_bad.py following the span conventions."""
+
+SPAN_DISPATCH = 0x0804
+
+
+def route_one(span, req, backend):
+    # Terminal emit dominates the only exit: the span always closes.
+    span.begin(req.rid)
+    backend.take(req)
+    span.end(req.rid)
+
+
+def route_checked(span, req, backend):
+    # Close before the early exit, then the happy path closes too.
+    span.begin(req.rid)
+    if not backend.alive():
+        span.end(req.rid)
+        return None
+    backend.take(req)
+    span.end(req.rid)
+    return req.rid
+
+
+def pump_spans(span_batch, reqs, clock):
+    # Staged per-event emits are the point of the recorder's
+    # EmitBatch: one vectorized emit_many per watermark.
+    for req in reqs:
+        span_batch.emit(clock.now_ns(), SPAN_DISPATCH, req.sid, 0)
+    span_batch.flush()
+
+
+def tail_latency(hist, cls):
+    # Vectorized: cumsum + searchsorted inside the helper.
+    return hist.class_quantile(cls, "queue", 0.99)

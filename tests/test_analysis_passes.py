@@ -50,6 +50,7 @@ def test_all_rules_fire_on_bad_tree():
         "net-raw-socket", "net-raw-transport",
         "gw-direct-submit", "gw-direct-dispatch", "gw-lease-bypass",
         "perf-rec-loop", "perf-emit-in-loop",
+        "obs-unclosed-span", "obs-span-emit-in-loop", "obs-hist-scan",
     }
 
 
@@ -110,7 +111,8 @@ def test_cli_list_passes(capsys):
     assert main(["check", "--list-passes"]) == 0
     out = capsys.readouterr().out
     for pid in ("lock-discipline", "time-units", "sched-ops",
-                "counter-api", "gateway-discipline", "perf-discipline"):
+                "counter-api", "gateway-discipline", "perf-discipline",
+                "obs-discipline"):
         assert pid in out
 
 

@@ -150,6 +150,58 @@ def test_federation_quorum_guard_never_fences_last_gateway():
     assert st["admitted"] == st["completed"] > 0
 
 
+def test_federation_chaos_span_chains_cover_every_admit():
+    """The new gated invariant (docs/TRACING.md): every admitted rid
+    yields a complete, gap-free span chain — the smoke seed covers
+    death + partition + drain + rejoin + lease expiry at once, and
+    custody transfers show up as handoff events on stitched chains."""
+    r = run_federation_chaos(**SMOKE_KW)
+    assert r["ok"] is True, r["problems"]
+    assert r["spans"]["chains"] == r["stats"]["admitted"] > 0
+    assert r["spans"]["complete"] == r["stats"]["admitted"]
+    assert r["spans"]["handoff_events"] > 0
+
+
+@pytest.mark.parametrize("specs,drain", [
+    # death-heavy: every member but the quorum-guarded last one dies.
+    ([{"point": "gateway.death", "fault": "kill", "p": 0.2}], False),
+    # partition churn: members drop out and heal repeatedly.
+    ([{"point": "gateway.partition", "fault": "partition", "p": 0.05,
+       "args": {"duration_ns": 25_000_000}}], False),
+    # lease collapse: renewals refused half the time -> degraded
+    # admission everywhere, spans must still close.
+    ([{"point": "lease.expire", "fault": "expire", "p": 0.5}], False),
+    # no injected faults at all, but the seeded drain@t/3 +
+    # rejoin@2t/3 schedule still moves custody around.
+    ([], True),
+])
+def test_span_continuity_under_each_disruption(specs, drain):
+    plan = FaultPlan.from_dict({"seed": 11, "specs": specs})
+    r = run_federation_chaos(workload="mixed", seed=11, n_gateways=3,
+                             n_tenants=4, ticks=240, plan=plan,
+                             drain_rejoin=drain)
+    assert r["ok"] is True, r["problems"]
+    assert r["spans"]["chains"] == r["stats"]["admitted"] > 0
+    assert r["spans"]["complete"] == r["stats"]["admitted"]
+
+
+def test_federation_obs_export_feeds_slo_report(tmp_path, capsys):
+    """`pbst chaos --plan federation --obs DIR` writes span artifacts
+    the slo/trace CLIs consume — chains stitched across the chaos
+    run's gateway death included."""
+    import json as _json
+
+    obs = str(tmp_path / "obs")
+    r = run_federation_chaos(**SMOKE_KW, obs_dir=obs)
+    assert r["ok"] is True
+    assert main(["slo", "report", obs]) == 0
+    doc = _json.loads(capsys.readouterr().out)
+    assert doc["spans"]["chains"] == r["stats"]["admitted"]
+    assert doc["run"]["harness"] == "federation"
+    assert sum(t["requests"] for t in doc["tenants"].values()) == \
+        r["stats"]["admitted"]
+
+
 @pytest.mark.slow
 def test_federation_chaos_soak_full_catalog():
     # Acceptance sweep: every sim workload under the federation plan,

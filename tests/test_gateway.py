@@ -232,9 +232,9 @@ def test_controller_backend_health_feeds_routing():
     ctl.agents["b1"] = dead
     assert ctl.backend_health() == {
         "b0": {"alive": True, "breaker": "open", "load": 3,
-               "observed_ns": 0, "stale": False},
+               "observed_ns": 0, "stale": False, "service_p99_ns": 0},
         "b1": {"alive": False, "breaker": "closed", "load": 0,
-               "observed_ns": 0, "stale": False},
+               "observed_ns": 0, "stale": False, "service_p99_ns": 0},
     }
     b0 = SimServeBackend("b0", n_slots=2, service_ns_per_cost=1 * MS)
     b1 = SimServeBackend("b1", n_slots=2, service_ns_per_cost=1 * MS)

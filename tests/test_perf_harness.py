@@ -29,6 +29,7 @@ CHEAP = ["trace.emit", "trace.emit_many", "trace.consume", "ledger.sample"]
 
 def test_bench_registry_names():
     assert {"trace.emit", "trace.emit_many", "trace.consume",
+            "span.emit", "hist.record",
             "ledger.sample", "fairqueue.cycle", "sim.smoke",
             "rpc.roundtrip"} == set(bench_names())
 
