@@ -515,11 +515,21 @@ def _serving_fallback_main() -> None:
     dt = time.perf_counter() - t0
     tokens = sum(i.get("tokens", 0) for _, i in done)
     toks_per_s = tokens / dt if dt > 0 else 0.0
+    # Which observability substrate ran (docs/PERF.md "Native fast
+    # path"): rounds from machines with and without a toolchain are
+    # only comparable when the row says which mode produced it.
+    from pbs_tpu.perf import native_info
+
+    nat = native_info()
     print(json.dumps({
         "metric": "gateway_serving_throughput",
         "value": round(toks_per_s, 1),
         "unit": "tokens/s",
         "vs_baseline": round(toks_per_s / SERVING_BAR_TOKENS_S, 4),
+        "native_available": nat["native_available"],
+        "native_tier": nat["native_tier"],
+        "native_mode": ("native" if nat["native_available"]
+                        else "python"),
         "p50_latency_ms": round(
             gw.hist.class_quantile("interactive", "e2e", 0.50) / 1e6, 3),
         "p99_latency_ms": round(

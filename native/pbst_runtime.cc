@@ -122,6 +122,79 @@ uint64_t pbst_ledger_tsc_start(const uint64_t* buf, int64_t slot) {
   return __atomic_load_n(&(buf + slot * kSlotWords)[1], __ATOMIC_ACQUIRE);
 }
 
+// Vectorized snapshot: the whole slot VECTOR in one C call, with the
+// retry loop PER SLOT (the scalar pbst_ledger_snapshot contract) —
+// each row is individually seqlock-consistent, and a busy writer on
+// one slot cannot burn the other slots' retry budget (an all-slots
+// round would multiply the tear exposure by the vector length; rows
+// of a counter snapshot don't need mutual consistency). out is
+// (n_slots, 18) row-major. Returns the WORST per-slot retry count,
+// -1 if any slot exhausted max_retries, or -2 if any slot falls
+// outside [0, total_slots) — bounds live here because a numpy
+// min/max pre-check costs more than the whole call.
+int pbst_ledger_snapshot_many(const uint64_t* buf, int64_t total_slots,
+                              const int64_t* slots, int n_slots,
+                              uint64_t* out, int max_retries) {
+  for (int i = 0; i < n_slots; i++) {
+    if (slots[i] < 0 || slots[i] >= total_slots) return -2;
+  }
+  int worst = 0;
+  for (int i = 0; i < n_slots; i++) {
+    int rc = pbst_ledger_snapshot(buf, slots[i],
+                                  out + (int64_t)i * kNumCounters,
+                                  max_retries);
+    if (rc < 0) return -1;
+    if (rc > worst) worst = rc;
+  }
+  return worst;
+}
+
+// ---------------------------------------------------------------------------
+// Log2 latency histograms in ledger slots (pbs_tpu/obs/spans.py).
+//
+// The slot IS the histogram: the 18 counter words are the buckets.
+// Bucket b = clamp(bit_length(value) - 1 - shift, 0, 17) — identical
+// to the Python hist_bucket (HIST_SHIFT=13 upstack). The seqlock
+// protocol is the per-record write_begin/write_end of pbst_ledger_add,
+// so N batched records leave byte-identical slot state (version word
+// included) to N scalar calls in either language.
+// ---------------------------------------------------------------------------
+
+static inline int hist_bucket_of(uint64_t value, int shift) {
+  int bl = value ? 64 - __builtin_clzll(value) : 0;  // bit_length
+  int b = bl - 1 - shift;
+  if (b < 0) return 0;
+  return b < kNumCounters - 1 ? b : kNumCounters - 1;
+}
+
+void pbst_hist_record(uint64_t* buf, int64_t slot, uint64_t value,
+                      int shift) {
+  uint64_t* s = slot_ptr(buf, slot);
+  write_begin(s);
+  s[kHeaderWords + hist_bucket_of(value, shift)] += 1;
+  write_end(s);
+}
+
+// Batched variant over parallel (slot, value) vectors: one C call per
+// flushed staging slab instead of one interpreter round-trip per
+// sample. Per-record seqlock discipline (see above). Slots are
+// prevalidated against [0, total_slots) BEFORE any write so a bad
+// batch mutates nothing; returns 0 ok / -2 slot out of range.
+int pbst_hist_record_many(uint64_t* buf, int64_t total_slots,
+                          const int64_t* slots, const uint64_t* values,
+                          int n, int shift) {
+  for (int i = 0; i < n; i++) {
+    if (slots[i] < 0 || slots[i] >= total_slots) return -2;
+  }
+  for (int i = 0; i < n; i++) {
+    uint64_t* s = slot_ptr(buf, slots[i]);
+    write_begin(s);
+    s[kHeaderWords + hist_bucket_of(values[i], shift)] += 1;
+    write_end(s);
+  }
+  return 0;
+}
+
 // ---------------------------------------------------------------------------
 // Lockless SPSC trace ring (xen/common/trace.c analog).
 //
@@ -165,6 +238,35 @@ int pbst_trace_emit(uint64_t* buf, uint64_t ts_ns, uint64_t event,
   rec[5] = a3; rec[6] = a4; rec[7] = a5;
   __atomic_store_n(&buf[0], head + 1, __ATOMIC_RELEASE);
   return 1;
+}
+
+// Batched emit of n records (flat n*8 u64, caller-staged) in at most
+// two wrap-aware memcpy spans — the EmitBatch flush becomes one C
+// call. Returns records written; records that don't fit are dropped
+// tail-first with the lost counter charged, exactly the drop
+// semantics of n scalar pbst_trace_emit calls (and byte-identical to
+// the Python emit_many fallback).
+int pbst_trace_emit_many(uint64_t* buf, const uint64_t* recs, int n) {
+  if (n <= 0) return 0;
+  uint64_t cap = buf[2];
+  uint64_t head = __atomic_load_n(&buf[0], __ATOMIC_RELAXED);
+  uint64_t tail = __atomic_load_n(&buf[1], __ATOMIC_ACQUIRE);
+  uint64_t space = cap - (head - tail);
+  uint64_t k = (uint64_t)n <= space ? (uint64_t)n : space;
+  if (k < (uint64_t)n) {
+    __atomic_fetch_add(&buf[3], (uint64_t)n - k, __ATOMIC_RELAXED);
+  }
+  if (k == 0) return 0;
+  uint64_t start = head % cap;
+  uint64_t k1 = k <= cap - start ? k : cap - start;
+  std::memcpy(buf + kTraceHeaderWords + start * kTraceRecWords, recs,
+              k1 * kTraceRecWords * sizeof(uint64_t));
+  if (k > k1) {
+    std::memcpy(buf + kTraceHeaderWords, recs + k1 * kTraceRecWords,
+                (k - k1) * kTraceRecWords * sizeof(uint64_t));
+  }
+  __atomic_store_n(&buf[0], head + k, __ATOMIC_RELEASE);
+  return (int)k;
 }
 
 // Consume up to max_records into out (flat u64 array). Returns count.

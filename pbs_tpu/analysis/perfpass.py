@@ -21,6 +21,18 @@ paying a scalar ring emit per event. Two rules:
   trailing identifier contains ``batch`` (``self._trace_batch.emit``,
   ``ring_batch.emit``) is an ``EmitBatch``, which exists precisely to
   be called per event.
+- ``perf-native-unchecked``: a call site consuming a
+  ``native_mod.load()`` / ``native_mod.fastcall()`` result without
+  handling the None branch. The native runtime is OPTIONAL by
+  contract (no toolchain → pure-Python fallback); code that does
+  ``native_mod.load().pbst_x(...)``, or stashes the result and never
+  None-checks it, crashes exactly on the hosts the fallback exists
+  for. Guards are recognized as: the result name (or ``self``
+  attribute) appearing in an ``if``/``while``/ternary/``assert``
+  test, or in an ``is None`` / ``is not None`` compare — in the
+  enclosing function for locals, anywhere in the class for
+  attributes. Scoped to the whole tree minus ``runtime/native.py``
+  (the loader itself).
 """
 
 from __future__ import annotations
@@ -38,6 +50,13 @@ HOT_PACKAGES = ("sim/", "gateway/", "telemetry/")
 
 #: Scalar per-event emitters the batching APIs replace in hot loops.
 EMITTERS = ("emit", "trace_emit")
+
+#: The optional-runtime loaders whose results can be None.
+NATIVE_LOADERS = ("load", "fastcall")
+
+#: The loader implementation itself (its internal load() calls are the
+#: machinery the rule protects callers of).
+NATIVE_MACHINERY = ("runtime/native.py",)
 
 
 def _anchored(rel_path: str) -> str:
@@ -112,13 +131,120 @@ class _PerfScan(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+def _is_native_loader(node: ast.Call) -> bool:
+    func = node.func
+    return (isinstance(func, ast.Attribute)
+            and func.attr in NATIVE_LOADERS
+            and "native" in _receiver_ident(func).lower())
+
+
+def _none_guard_idents(scope: ast.AST) -> set[str]:
+    """Identifiers (plain names and attribute names) that appear in a
+    conditional test or an ``is [not] None`` compare inside ``scope``
+    — the shapes a None-branch handler takes."""
+    guarded: set[str] = set()
+
+    def _collect(expr: ast.AST) -> None:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name):
+                guarded.add(sub.id)
+            elif isinstance(sub, ast.Attribute):
+                guarded.add(sub.attr)
+
+    for sub in ast.walk(scope):
+        if isinstance(sub, (ast.If, ast.While, ast.IfExp, ast.Assert)):
+            _collect(sub.test)
+        elif isinstance(sub, ast.Compare):
+            if any(isinstance(c, ast.Constant) and c.value is None
+                   for c in [sub.left, *sub.comparators]):
+                _collect(sub)
+    return guarded
+
+
+class _NativeScan:
+    """perf-native-unchecked: loader results consumed without a None
+    branch. Locals are checked against their enclosing function,
+    ``self.X`` stashes against their whole class (the stash-in-init,
+    branch-at-use idiom of TraceBuffer/Ledger)."""
+
+    def __init__(self, src: SourceFile):
+        self.src = src
+        self.findings: list[Finding] = []
+
+    def _flag(self, node: ast.AST, what: str) -> None:
+        self.findings.append(Finding(
+            "perf-native-unchecked", self.src.rel_path, node.lineno,
+            node.col_offset,
+            f"{what} — native_mod.load()/fastcall() return None when "
+            "the runtime is unavailable (no toolchain, failed build), "
+            "and this site would crash exactly on the hosts the "
+            "pure-Python fallback exists for",
+            hint="branch on the result (`if lib is not None: ...`) "
+                 "and keep the Python path as the fallback "
+                 "(runtime/native.py, docs/PERF.md)"))
+
+    def scan(self, tree: ast.AST) -> None:
+        # Direct uses: an attribute ridden straight off the call.
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Call) and \
+                    _is_native_loader(node.value):
+                self._flag(node, "attribute access directly on a "
+                                 "native loader result")
+        # Stashed results: name assigns per function, self-attribute
+        # assigns per class.
+        for scope in ast.walk(tree):
+            if isinstance(scope, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                self._scan_function(scope)
+            elif isinstance(scope, ast.ClassDef):
+                self._scan_class(scope)
+
+    def _loader_assigns(self, scope: ast.AST):
+        for sub in ast.walk(scope):
+            if isinstance(sub, ast.Assign) and \
+                    isinstance(sub.value, ast.Call) and \
+                    _is_native_loader(sub.value) and \
+                    len(sub.targets) == 1:
+                yield sub, sub.targets[0]
+
+    def _scan_function(self, fn) -> None:
+        guarded = None  # computed lazily: most functions have none
+        for assign, target in self._loader_assigns(fn):
+            if not isinstance(target, ast.Name):
+                continue  # self.X handled at class level
+            if guarded is None:
+                guarded = _none_guard_idents(fn)
+            if target.id not in guarded:
+                self._flag(assign, f"native loader result bound to "
+                                   f"{target.id!r} with no None "
+                                   "branch in this function")
+
+    def _scan_class(self, cls) -> None:
+        guarded = None
+        for assign, target in self._loader_assigns(cls):
+            if not (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                continue
+            if guarded is None:
+                guarded = _none_guard_idents(cls)
+            if target.attr not in guarded:
+                self._flag(assign, f"native loader result stashed on "
+                                   f"self.{target.attr} with no None "
+                                   "branch anywhere in this class")
+
+
 class PerfDisciplinePass(Pass):
     id = "perf-discipline"
-    rules = ("perf-rec-loop", "perf-emit-in-loop")
-    description = ("trace/telemetry hot paths stay vectorized: no "
-                   "per-record TRACE_REC_WORDS loops, no scalar ring "
-                   "emits inside loops in sim/gateway/telemetry "
-                   "(EmitBatch/emit_many are the sanctioned forms)")
+    rules = ("perf-rec-loop", "perf-emit-in-loop",
+             "perf-native-unchecked")
+    description = ("trace/telemetry hot paths stay vectorized and "
+                   "native-optional: no per-record TRACE_REC_WORDS "
+                   "loops, no scalar ring emits inside loops in "
+                   "sim/gateway/telemetry (EmitBatch/emit_many are "
+                   "the sanctioned forms), and every native loader "
+                   "result handles the None/unavailable branch")
 
     def run(self, src: SourceFile, ctx: CheckContext) -> list[Finding]:
         if src.tree is None or _is_test(src.rel_path):
@@ -127,8 +253,14 @@ class PerfDisciplinePass(Pass):
         rec_scope = not any(
             anchored == m or anchored.startswith(m) for m in REC_MACHINERY)
         emit_scope = any(anchored.startswith(p) for p in HOT_PACKAGES)
-        if not (rec_scope or emit_scope):
-            return []
-        scan = _PerfScan(src, rec_scope, emit_scope)
-        scan.visit(src.tree)
-        return scan.findings
+        native_scope = anchored not in NATIVE_MACHINERY
+        findings: list[Finding] = []
+        if rec_scope or emit_scope:
+            scan = _PerfScan(src, rec_scope, emit_scope)
+            scan.visit(src.tree)
+            findings.extend(scan.findings)
+        if native_scope:
+            nat = _NativeScan(src)
+            nat.scan(src.tree)
+            findings.extend(nat.findings)
+        return findings

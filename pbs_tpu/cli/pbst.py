@@ -371,9 +371,10 @@ def cmd_perfc(args) -> int:
 
 def cmd_perf(args) -> int:
     """Hot-path microbenchmark harness (pbs_tpu.perf; docs/PERF.md):
-    run the named benches (default: all), print stable JSON or a table,
-    optionally gate against the checked-in baseline (--check fails only
-    on >= --threshold ns/op regressions) or refresh it
+    run the named benches (default: all) in python or --native mode,
+    print stable JSON or a table, optionally gate against the
+    checked-in baseline (--check fails only on >= --threshold ns/op
+    regressions, compared like-with-like per mode) or refresh it
     (--update-baseline)."""
     from pbs_tpu.perf import (
         format_report,
@@ -382,21 +383,35 @@ def cmd_perf(args) -> int:
         save_baseline,
     )
     from pbs_tpu.perf.report import main_check
+    from pbs_tpu.runtime import native as native_mod
 
     if args.update_baseline and args.quick:
         print("pbst: refusing to write a --quick-only baseline "
-              "(--update-baseline measures both modes itself)",
+              "(--update-baseline measures both op counts itself)",
               file=sys.stderr)
         return 2
+    if not native_mod.available():
+        # Diagnosable, never silent (the satellite of the silent-build
+        # -failure fix): say WHY the fast paths are off, every run.
+        reason = native_mod.unavailable_reason()
+        if args.native:
+            print(f"pbst: --native requested but the native runtime "
+                  f"is unavailable: {reason}", file=sys.stderr)
+            return 2
+        print(f"pbst: note: native runtime unavailable ({reason}); "
+              "python mode is also the production path on this host",
+              file=sys.stderr)
     try:
-        results = run_benches(args.benches, quick=args.quick)
+        results = run_benches(args.benches, quick=args.quick,
+                              native=args.native)
     except KeyError as e:
         print(f"pbst: {e.args[0]}", file=sys.stderr)
         return 2
     if args.update_baseline:
-        # Both modes: --check compares like-with-like (quick op counts
-        # carry systematic per-call-overhead offsets).
-        quick_results = run_benches(args.benches, quick=True)
+        # Both op counts: --check compares like-with-like (quick
+        # counts carry systematic per-call-overhead offsets).
+        quick_results = run_benches(args.benches, quick=True,
+                                    native=args.native)
         path = save_baseline(results, args.baseline,
                              quick_results=quick_results)
         print(f"wrote baseline {path}")
@@ -1280,6 +1295,12 @@ def main(argv=None) -> int:
                     help="run only this bench (repeatable; default: all)")
     sp.add_argument("--quick", action="store_true",
                     help="small op counts (the <=5s tier-1 smoke)")
+    sp.add_argument("--native", action=argparse.BooleanOptionalAction,
+                    default=False,
+                    help="bench the native runtime paths instead of "
+                         "the pure-Python fallback (--no-native, the "
+                         "default, pins python mode); gated against "
+                         "the baseline's native_* maps")
     sp.add_argument("--check", action="store_true",
                     help="exit 1 on >= --threshold ns/op regressions "
                          "vs the baseline")

@@ -35,6 +35,8 @@ import os
 from collections import deque
 from typing import Any, Callable
 
+import numpy as np
+
 from pbs_tpu.faults import injector as _faults
 from pbs_tpu.gateway.admission import (
     INTERACTIVE,
@@ -45,9 +47,9 @@ from pbs_tpu.gateway.admission import (
 )
 from pbs_tpu.gateway.backends import Backend
 from pbs_tpu.gateway.fairqueue import DeficitRoundRobin, Request
-from pbs_tpu.obs.spans import LatencyHistograms, SpanRecorder
+from pbs_tpu.obs.spans import HistBatch, LatencyHistograms, SpanRecorder
 from pbs_tpu.obs.trace import EmitBatch, Ev, TraceBuffer
-from pbs_tpu.telemetry.counters import Counter
+from pbs_tpu.telemetry.counters import NUM_COUNTERS, Counter
 from pbs_tpu.utils.clock import MS, MonotonicClock
 
 #: Ledger counter reuse for the per-class gateway slots (the ledger
@@ -143,6 +145,17 @@ class Gateway:
         self.hist = LatencyHistograms(
             num_slots=hist_slots,
             path=(ledger_path + ".hist") if ledger_path else None)
+        # The batched pump (docs/PERF.md): a tick's histogram samples
+        # stage here and land as ONE record_many flush — flushed
+        # before _feedback reads the quantiles and before stats(), so
+        # readers see exactly what per-request scalar records showed.
+        self._hist_batch = HistBatch(self.hist)
+        # Per-tick ledger staging: one add_many per touched class per
+        # tick instead of a seqlock write per request event. Sheds
+        # (submit-time, outside the pump) keep the direct scalar add.
+        self._ld_acc = {cls: np.zeros(NUM_COUNTERS, dtype="<u8")
+                        for cls in SLO_CLASSES}
+        self._ld_dirty: set[str] = set()
         #: Request-span recorder (docs/TRACING.md): injected by a
         #: federation (shared across members so chains stitch), or
         #: derived from this gateway's own trace ring when tracing is
@@ -290,20 +303,31 @@ class Gateway:
     def tick(self) -> list[tuple[str, dict]]:
         """One gateway round: reap completions, repair backend loss,
         dispatch from the fair queue, export feedback. Returns this
-        tick's completions as (rid, info) pairs."""
+        tick's completions as (rid, info) pairs.
+
+        The batched pump: per-request span emits, histogram samples,
+        and ledger counter adds stage into per-tick slabs and land in
+        bulk — the observability slabs BEFORE ``_feedback`` (its
+        quantile reads and the stats surface must see this tick's
+        samples), the trace batch at tick end."""
         now = self.clock.now_ns()
         done = self._reap(now)
         self._repair(now)
         self._dispatch(now)
+        self._hist_batch.flush()
+        self._ledger_flush()
         self._feedback(now)
         self.flush_trace()
         return done
 
     def flush_trace(self) -> None:
-        """Land staged GW_* records in the ring (consumers reading
-        ``gw.trace`` between ticks call this first)."""
+        """Land staged GW_* records, histogram samples, and ledger
+        adds (consumers reading ``gw.trace``/``gw.hist``/the ledger
+        file between ticks call this first; ``stats()`` does)."""
         if self._trace_batch is not None:
             self._trace_batch.flush()
+        self._hist_batch.flush()
+        self._ledger_flush()
 
     def busy(self) -> bool:
         return bool(self.queue.depth() or self.inflight)
@@ -320,18 +344,19 @@ class Gateway:
                 cls = req.slo
                 lat = now - req.submit_ns + req.penalty_ns
                 service_ns = int(info.get("service_ns", 0))
-                self.hist.record(req.tenant, cls, "e2e", lat)
-                self.hist.record(req.tenant, cls, "service", service_ns)
-                self.hist.record(f"be:{b.name}", "*", "service",
-                                 service_ns)
+                hist_rec = self._hist_batch.record
+                hist_rec(req.tenant, cls, "e2e", lat)
+                hist_rec(req.tenant, cls, "service", service_ns)
+                hist_rec(f"be:{b.name}", "*", "service", service_ns)
                 info = {**info, "tenant": req.tenant, "slo": cls,
                         "latency_ns": lat,
                         "queue_delay_ns": req.queue_delay_ns}
                 out.append((req.rid, info))
                 self.completions.append((req.rid, info))
-                self._ledger_add(cls, Counter.STEPS_RETIRED, 1)
-                self._ledger_add(cls, Counter.TOKENS, req.cost)
-                self._ledger_add(cls, Counter.DEVICE_TIME_NS, service_ns)
+                self._ledger_stage(cls, Counter.STEPS_RETIRED, 1)
+                self._ledger_stage(cls, Counter.TOKENS, req.cost)
+                self._ledger_stage(cls, Counter.DEVICE_TIME_NS,
+                                   service_ns)
                 self._emit(now, Ev.GW_COMPLETE, self._slot_of(req.tenant),
                            self._cls_code(cls),
                            self._backend_slot(req.backend),
@@ -364,7 +389,7 @@ class Gateway:
                 req.requeues += 1
                 self.requeued += 1
                 self.queue.requeue_front(req)
-                self._ledger_add(req.slo, Counter.YIELDS, 1)
+                self._ledger_stage(req.slo, Counter.YIELDS, 1)
                 self._emit(now, Ev.GW_REQUEUE, self._slot_of(req.tenant),
                            self._cls_code(req.slo),
                            self._backend_slot(b.name))
@@ -430,8 +455,8 @@ class Gateway:
                 # Requeued casualties re-dispatch with a CUMULATIVE
                 # delay; one histogram sample per request keeps the
                 # quantiles a per-request distribution.
-                self.hist.record(req.tenant, req.slo, "queue",
-                                 req.queue_delay_ns)
+                self._hist_batch.record(req.tenant, req.slo, "queue",
+                                        req.queue_delay_ns)
             # Settle the feedback watermark: only the wait not already
             # exported by the stuck-queue sentinel (or a previous
             # dispatch, for requeued casualties) enters the channel, so
@@ -453,9 +478,9 @@ class Gateway:
                     int(max(0.0, self.queue.last_deficit) * 1000),
                     self.name)
             target.dispatch_request(req, now)
-            self._ledger_add(req.slo, Counter.SCHED_COUNT, 1)
-            self._ledger_add(req.slo, Counter.RUNQ_WAIT_NS,
-                             req.queue_delay_ns)
+            self._ledger_stage(req.slo, Counter.SCHED_COUNT, 1)
+            self._ledger_stage(req.slo, Counter.RUNQ_WAIT_NS,
+                               req.queue_delay_ns)
             self._emit(now, Ev.GW_DISPATCH, self._slot_of(req.tenant),
                        self._cls_code(req.slo),
                        self._backend_slot(target.name),
@@ -540,6 +565,25 @@ class Gateway:
     def _ledger_add(self, cls: str, counter: int, delta: int) -> None:
         if self._ledger is not None and delta:
             self._ledger.add(GW_LEDGER_SLOTS[cls], int(counter), int(delta))
+
+    def _ledger_stage(self, cls: str, counter: int, delta: int) -> None:
+        """Pump-side ledger accounting: accumulate into the per-tick
+        per-class delta vector; ``_ledger_flush`` lands each touched
+        class as ONE seqlock ``add_many``. External monitors see
+        counters advance at tick granularity instead of per event —
+        the same visibility watermark as the staged trace records."""
+        if self._ledger is not None and delta:
+            self._ld_acc[cls][int(counter)] += np.uint64(delta)
+            self._ld_dirty.add(cls)
+
+    def _ledger_flush(self) -> None:
+        if not self._ld_dirty:
+            return
+        for cls in sorted(self._ld_dirty):
+            acc = self._ld_acc[cls]
+            self._ledger.add_many(GW_LEDGER_SLOTS[cls], acc)
+            acc[:] = 0
+        self._ld_dirty.clear()
 
     def _write_ledger_meta(self) -> None:
         """Sidecar so ``pbst dump/top --ledger`` render the gateway

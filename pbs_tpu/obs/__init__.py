@@ -5,6 +5,7 @@ from pbs_tpu.obs.oprofile import ProfileSession, ProfilerBusy
 from pbs_tpu.obs.perfc import Perfc, perfc
 from pbs_tpu.obs.selftest import CanaryResult, run_selftest, selftest_ok
 from pbs_tpu.obs.spans import (
+    HistBatch,
     LatencyHistograms,
     SpanAssembler,
     SpanRecorder,
@@ -12,8 +13,9 @@ from pbs_tpu.obs.spans import (
 from pbs_tpu.obs.trace import Ev, TraceBuffer, format_records
 
 __all__ = [
-    "CanaryResult", "Console", "Ev", "LatencyHistograms", "Monitor",
-    "Perfc", "ProfileSession", "ProfilerBusy", "ProfiledLock",
-    "SchedHistory", "SpanAssembler", "SpanRecorder", "TraceBuffer",
-    "format_records", "perfc", "run_selftest", "selftest_ok",
+    "CanaryResult", "Console", "Ev", "HistBatch", "LatencyHistograms",
+    "Monitor", "Perfc", "ProfileSession", "ProfilerBusy",
+    "ProfiledLock", "SchedHistory", "SpanAssembler", "SpanRecorder",
+    "TraceBuffer", "format_records", "perfc", "run_selftest",
+    "selftest_ok",
 ]

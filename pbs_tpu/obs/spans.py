@@ -22,7 +22,10 @@ across members. Three pieces:
   words ARE the buckets), so monitors snapshot them lock-free like any
   other ledger and quantiles come from :func:`hist_quantile` — the
   nearest-rank estimator over bucket upper edges, never an
-  interpolated value.
+  interpolated value. ``record`` fuses bucket+seqlock into one native
+  call when the runtime is loaded (byte-identical to the Python
+  fallback), and :class:`HistBatch` stages a pump tick's samples into
+  one ``record_many`` flush (docs/PERF.md "Native fast path").
 - :class:`SpanAssembler` — the consumer. Reconstructs per-rid
   timelines from drained trace records, validates **gap-free chain**
   invariants (the ``pbst chaos`` federation harness gates on them),
@@ -42,7 +45,13 @@ import os
 
 import numpy as np
 
-from pbs_tpu.obs.trace import TRACE_REC_WORDS, EmitBatch, Ev, TraceBuffer
+from pbs_tpu.obs.trace import (
+    TRACE_REC_WORDS,
+    _U64_MASK,
+    EmitBatch,
+    Ev,
+    TraceBuffer,
+)
 from pbs_tpu.telemetry.counters import NUM_COUNTERS
 from pbs_tpu.telemetry.ledger import Ledger
 from pbs_tpu.utils.clock import MS
@@ -115,24 +124,40 @@ class LatencyHistograms:
     """Log2 latency histograms in ledger slots, keyed ``(who, cls,
     stage)`` (``who`` is a tenant name or a ``be:<backend>`` row).
 
-    ``record`` is the hot path: one dict hit + one ledger counter add —
-    no allocation beyond the interning of a key the first time it is
-    seen. Slots are allocated densely; when the ledger is full, new
-    keys fold into a per-``(cls, stage)`` overflow row (counts are
-    never dropped, attribution degrades to the class).
+    ``record`` is the hot path: one dict hit + one ledger counter add
+    (bucket + seqlock fused into a single native call when the runtime
+    is loaded) — no allocation beyond the interning of a key the first
+    time it is seen. Slots are allocated densely; when the ledger is
+    full, new keys fold into a per-``(cls, stage)`` overflow row
+    (counts are never dropped, attribution degrades to the class).
     """
 
-    def __init__(self, num_slots: int = 256, path: str | None = None):
+    __slots__ = ("path", "ledger", "num_slots", "_slots", "_next",
+                 "_overflow_slot", "_nat", "_natp", "_fc", "_addr",
+                 "_fc_record")
+
+    def __init__(self, num_slots: int = 256, path: str | None = None,
+                 native: bool | str | None = None):
         if num_slots < 2:
             raise ValueError("LatencyHistograms needs >= 2 slots "
                              "(one is the reserved overflow row)")
         self.path = path
         if path is not None:
-            self.ledger = Ledger.file_backed(path, num_slots=num_slots)
+            self.ledger = Ledger.file_backed(path, num_slots=num_slots,
+                                             native=native)
             for slot in range(num_slots):
                 self.ledger.reset(slot)  # never inherit a previous run
         else:
-            self.ledger = Ledger(num_slots)
+            self.ledger = Ledger(num_slots, native=native)
+        # The fused native paths (pbst_hist_record[_many]: log2 bucket
+        # + seqlock add in one call) ride the ledger's binding tiers;
+        # byte-identical slot state either way (docs/PERF.md).
+        self._nat = getattr(self.ledger, "_nat", None)
+        self._natp = getattr(self.ledger, "_ptr", None)
+        self._fc = getattr(self.ledger, "_fc", None)
+        self._addr = getattr(self.ledger, "_addr", 0)
+        self._fc_record = (self._fc.hist_record
+                           if self._fc is not None else None)
         self.num_slots = int(num_slots)
         self._slots: dict[tuple[str, str, str], int] = {}
         self._next = 0
@@ -143,7 +168,11 @@ class LatencyHistograms:
         #: also exhausted).
         self._overflow_slot = self.num_slots - 1
 
-    def _slot_of(self, who: str, cls: str, stage: str) -> int:
+    def slot_of(self, who: str, cls: str, stage: str) -> int:
+        """Interned ledger slot for a key (allocating on first sight).
+        Public so staged producers (:class:`HistBatch`) can intern at
+        record time — slot-allocation order, and therefore the meta
+        sidecar, must not depend on when a batch flushes."""
         key = (who, cls, stage)
         slot = self._slots.get(key)
         if slot is not None:
@@ -170,8 +199,63 @@ class LatencyHistograms:
 
     def record(self, who: str, cls: str, stage: str,
                value_ns: int) -> None:
-        self.ledger.add(self._slot_of(who, cls, stage),
-                        hist_bucket(value_ns), 1)
+        """One latency sample: bucket + seqlock add, fused into one
+        native call when the runtime is loaded. Values clamp to
+        [0, 2^64): a negative (clock-skew) sample lands in bucket 0 on
+        every tier."""
+        slot = self._slots.get((who, cls, stage))
+        if slot is None:
+            slot = self.slot_of(who, cls, stage)
+        fcr = self._fc_record
+        if fcr is not None:
+            # Negatives clamp to 0 (= bucket 0, the Python tier's
+            # result); values are ns-scale by contract, far below the
+            # u64 range where the C mask could matter.
+            fcr(self._addr, slot,
+                value_ns if value_ns >= 0 else 0, HIST_SHIFT)
+            return
+        if self._nat is not None:
+            v = int(value_ns)
+            if not 0 <= v <= _U64_MASK:
+                v = 0 if v < 0 else v & _U64_MASK
+            self._nat.pbst_hist_record(self._natp, slot, v, HIST_SHIFT)
+            return
+        self.ledger.add(slot, hist_bucket(value_ns), 1)
+
+    def record_many(self, slots: np.ndarray, values: np.ndarray) -> None:
+        """Batched :meth:`record` over parallel (slot, value) vectors
+        — slots from :meth:`slot_of`, interned at stage time. One C
+        call when native; the pure-Python fallback replays the scalar
+        per-record protocol, so every tier leaves byte-identical
+        ledger state (per-record seqlock version bumps included)."""
+        slots = np.ascontiguousarray(slots, dtype=np.int64)
+        values = np.ascontiguousarray(values, dtype="<u8")
+        n = slots.size
+        if values.size != n:
+            raise ValueError(
+                f"record_many wants parallel vectors, got {n} slots / "
+                f"{values.size} values")
+        if n == 0:
+            return
+        if self._fc is not None:
+            self._fc.hist_record_many(self._addr, self.num_slots,
+                                      slots, values, n, HIST_SHIFT)
+            return
+        if self._nat is not None:
+            from pbs_tpu.runtime import native as native_mod
+
+            rc = self._nat.pbst_hist_record_many(
+                self._natp, self.num_slots, native_mod.as_i64p(slots),
+                native_mod.as_u64p(values), n, HIST_SHIFT)
+            if rc == -2:
+                raise IndexError("hist_record_many: slot out of range")
+            return
+        if ((slots < 0) | (slots >= self.num_slots)).any():
+            # Prevalidated like the C path: a bad batch mutates nothing.
+            raise IndexError("hist_record_many: slot out of range")
+        add = self.ledger.add
+        for s, v in zip(slots.tolist(), values.tolist()):
+            add(s, hist_bucket(v), 1)
 
     # -- read side -------------------------------------------------------
 
@@ -227,10 +311,84 @@ class LatencyHistograms:
         self.path = None
         self.ledger = Ledger.file_backed(path, readonly=True)
         self.num_slots = self.ledger.num_slots
+        # Monitor attach never records; reads go through the ledger's
+        # own snapshot paths (which keep their native tiers).
+        self._nat = self._natp = self._fc = self._fc_record = None
+        self._addr = 0
         self._slots = {tuple(k): int(s)
                        for s, k in meta["slots"].items()}
         self._next = len(self._slots)
         return self
+
+
+class HistBatch:
+    """Per-tick staging for histogram samples — the
+    :class:`~pbs_tpu.obs.trace.EmitBatch` of the latency layer: a
+    pump's worth of ``record()`` calls land as ONE
+    :meth:`LatencyHistograms.record_many` flush (one C call on the
+    native tiers) instead of an interpreter round-trip per sample.
+
+    Staging changes WHEN a sample reaches its ledger slot, never the
+    bytes: keys intern at record() time (slot-allocation order — and
+    therefore the meta sidecar — identical to scalar calls), values
+    land in record order, and the flush keeps the per-record seqlock
+    protocol. NOT thread-safe: one batch per pump thread, flushed at
+    tick end and before any read of the histograms.
+
+    On the pure-Python tier the batch degrades to DIRECT scalar
+    records (flush is then a no-op): replaying staged scalars at flush
+    would cost strictly more than recording in place, and the
+    degraded mode keeps today's verified behavior exactly.
+    """
+
+    __slots__ = ("hist", "capacity", "_direct", "_s", "_v", "_sm",
+                 "_vm", "_n", "recorded", "flushes")
+
+    def __init__(self, hist: LatencyHistograms, capacity: int = 512):
+        if capacity <= 0:
+            raise ValueError("HistBatch capacity must be > 0")
+        self.hist = hist
+        self.capacity = int(capacity)
+        self._direct = hist._nat is None and hist._fc is None
+        self._s = np.zeros(self.capacity, dtype=np.int64)
+        self._v = np.zeros(self.capacity, dtype="<u8")
+        self._sm = memoryview(self._s)
+        self._vm = memoryview(self._v)
+        self._n = 0
+        self.recorded = 0
+        self.flushes = 0
+
+    def record(self, who: str, cls: str, stage: str,
+               value_ns: int) -> None:
+        self.recorded += 1
+        hist = self.hist
+        if self._direct:
+            hist.record(who, cls, stage, value_ns)
+            return
+        slot = hist._slots.get((who, cls, stage))
+        if slot is None:
+            slot = hist.slot_of(who, cls, stage)
+        v = int(value_ns)
+        if not 0 <= v <= _U64_MASK:  # the record() clamp contract
+            v = 0 if v < 0 else v & _U64_MASK
+        i = self._n
+        self._sm[i] = slot
+        self._vm[i] = v
+        self._n = i + 1
+        if self._n >= self.capacity:
+            self.flush()
+
+    def pending(self) -> int:
+        return self._n
+
+    def flush(self) -> int:
+        """Land staged samples in the ledger; returns samples flushed."""
+        n, self._n = self._n, 0
+        if not n:
+            return 0
+        self.flushes += 1
+        self.hist.record_many(self._s[:n], self._v[:n])
+        return n
 
 
 # -- the producer ------------------------------------------------------------

@@ -12,26 +12,34 @@ Here: one ring per executor over a flat u64 buffer (native SPSC ring in
 records of (timestamp, event, 6 args), a lost-record counter instead of
 blocking, and host-side formatting/digestion in ``pbs_tpu.cli``.
 
-**Hot-path contract** (``pbst perf`` pins it, docs/PERF.md): ``emit``
-allocates nothing per event (a preallocated scratch record and cached
-header views), ``emit_many``/``consume``/``peek`` move records in at
-most two contiguous slice copies each (wrap-aware), and producers with
-bursty event streams stage through :class:`EmitBatch` so N events cost
-one batched ring write instead of N scalar ones.
+**Hot-path contract** (``pbst perf`` pins it in both modes,
+docs/PERF.md): ``emit`` writes the whole record with ONE
+``struct.pack_into`` (no per-word store loop, nothing allocated per
+event) — or one sub-µs vectorcall when the native runtime is loaded;
+``emit_many``/``consume``/``peek`` move records in at most two
+contiguous slice copies each (wrap-aware; one
+``pbst_trace_emit_many``/``pbst_trace_consume`` C call when native);
+and producers with bursty event streams stage through
+:class:`EmitBatch` so N events cost one batched ring write instead of
+N scalar ones. Native and Python paths are byte-identical — same ring
+bytes, same drop counters (tests/test_native_fastpath.py).
 
 **Batched-writer concurrency contract** (mirrors the ledger's): the
-vectorized producer paths (``emit_many``, and any ``EmitBatch`` over
-this ring) are plain slice stores + a header store with no fences —
-in-process SPSC is always safe (stores are program-ordered under the
-GIL), and a cross-process consumer attached to a file-backed ring is
-safe on TSO hosts (x86: the head store cannot pass the record stores).
-A cross-process producer needing release semantics on weaker memory
-models must use the native scalar ``emit``.
+pure-Python vectorized producer paths (``emit_many``, and any
+``EmitBatch`` over a non-native ring) are plain slice stores + a
+header store with no fences — in-process SPSC is always safe (stores
+are program-ordered under the GIL), and a cross-process consumer
+attached to a file-backed ring is safe on TSO hosts (x86: the head
+store cannot pass the record stores). A cross-process producer
+needing release semantics on weaker memory models must use the native
+paths (scalar ``emit`` or ``emit_many``, whose head store is an
+atomic release).
 """
 
 from __future__ import annotations
 
 import enum
+import struct
 
 import numpy as np
 
@@ -41,6 +49,14 @@ TRACE_HEADER_WORDS = 4
 TRACE_REC_WORDS = 8
 
 _U64_MASK = 2**64 - 1
+
+#: Pack formats for a record prefix of 2 + k words (k = 0..6 args):
+#: one C-level struct.pack_into per staged/emitted record replaces the
+#: per-word memoryview store loop — the "sub-µs emit" path. The
+#: out-of-range fallback masks args exactly like the old store loop.
+_PACK_FMTS = tuple("<" + "Q" * (2 + k) for k in range(7))
+#: Zero padding for the unwritten tail words of a short record.
+_ZERO_TAIL = tuple(bytes((6 - k) * 8) for k in range(7))
 
 # ``tbuf_size=`` boot param analog (xen/common/trace.c): default ring
 # capacity in records for rings whose creator doesn't size them.
@@ -109,7 +125,11 @@ class TraceBuffer:
     """One SPSC ring. Producer: an executor. Consumer: a monitor."""
 
     def __init__(self, capacity: int | None = None, buf=None,
-                 native: bool | None = None, _attach: bool = False):
+                 native: bool | str | None = None, _attach: bool = False):
+        # ``native``: None auto-detects, True requires the C library,
+        # False pins the pure-Python paths, "ctypes" pins the ctypes
+        # binding tier (native minus the fastcall accelerator — the
+        # tier a host without Python.h runs; tests/benches use it).
         self.capacity = capacity = (
             capacity if capacity is not None else _tbuf_size.value)
         nwords = TRACE_HEADER_WORDS + capacity * TRACE_REC_WORDS
@@ -123,11 +143,13 @@ class TraceBuffer:
         words = memoryview(buf)[: nwords * 8].cast("B").cast("Q")
         self._hdr = words[:TRACE_HEADER_WORDS]
         self._words = words
-        # Reusable staging record for the pure-Python emit path: arg
-        # normalization must not allocate per event.
-        self._scratch = memoryview(bytearray(TRACE_REC_WORDS * 8)).cast("Q")
+        # Byte view for struct.pack_into: the pure-Python emit writes
+        # the whole record in one C call, no per-word store loop.
+        self._bytes = memoryview(buf)[: nwords * 8].cast("B")
         self._nat = None
         self._ptr = None
+        self._fc = None
+        self._addr = 0
         if native is not False:
             from pbs_tpu.runtime import native as native_mod
 
@@ -135,6 +157,13 @@ class TraceBuffer:
             if lib is not None:
                 self._nat = lib
                 self._ptr = native_mod.as_u64p(self._arr)
+                # Fastcall tier (native/pbst_fastcall.cc): same C entry
+                # points, ~7x lower call overhead than ctypes. The
+                # address is cached once — .ctypes.data costs µs per
+                # access. native="ctypes" pins the ctypes tier (tests).
+                if native != "ctypes":
+                    self._fc = native_mod.fastcall()
+                    self._addr = self._arr.ctypes.data
             elif native is True:
                 raise RuntimeError("native runtime requested but unavailable")
         if _attach:
@@ -149,7 +178,7 @@ class TraceBuffer:
 
     @classmethod
     def file_backed(cls, path: str, capacity: int | None = None,
-                    native: bool | None = None,
+                    native: bool | str | None = None,
                     attach: bool = False) -> "TraceBuffer":
         """Ring over a shared mmap — xenbaked's view of the hypervisor
         trace pages (``tools/xenmon/xenbaked.c`` maps the per-CPU rings
@@ -185,6 +214,12 @@ class TraceBuffer:
     # -- producer --------------------------------------------------------
 
     def emit(self, ts_ns: int, event: int, *args: int) -> bool:
+        fc = self._fc
+        if fc is not None:
+            # Sub-µs native emit: one vectorcall, args masked in C.
+            if len(args) > 6:
+                args = args[:6]
+            return fc.trace_emit(self._addr, ts_ns, event, *args)
         if self._nat is not None:
             a = [int(x) & _U64_MASK for x in args[:6]]
             a += [0] * (6 - len(a))
@@ -196,21 +231,27 @@ class TraceBuffer:
         if head - hdr[1] >= cap:
             hdr[3] += 1
             return False
-        rec = self._scratch
-        rec[0] = int(ts_ns)
-        rec[1] = int(event)
-        i = 2
-        for x in args[:6]:
-            x = int(x)
-            if not 0 <= x <= _U64_MASK:  # mask only when out of range
-                x &= _U64_MASK
-            rec[i] = x
-            i += 1
-        while i < TRACE_REC_WORDS:
-            rec[i] = 0
-            i += 1
-        off = TRACE_HEADER_WORDS + (head % cap) * TRACE_REC_WORDS
-        self._words[off:off + TRACE_REC_WORDS] = rec
+        off = (TRACE_HEADER_WORDS + (head % cap) * TRACE_REC_WORDS) * 8
+        n = len(args)
+        if n > 6:
+            args = args[:6]
+            n = 6
+        b = self._bytes
+        try:
+            # Fast path: every field already a 0..2^64-1 int — one C
+            # pack writes the whole record prefix.
+            struct.pack_into(_PACK_FMTS[n], b, off, ts_ns, event, *args)
+        except struct.error:
+            # Every field masks to two's complement — including
+            # ts_ns/event, matching the native tiers (a negative
+            # clock-skew timestamp must not raise on one tier and
+            # record on another).
+            struct.pack_into(
+                _PACK_FMTS[n], b, off, int(ts_ns) & _U64_MASK,
+                int(event) & _U64_MASK,
+                *[int(x) & _U64_MASK for x in args])
+        if n < 6:
+            b[off + (2 + n) * 8:off + TRACE_REC_WORDS * 8] = _ZERO_TAIL[n]
         hdr[0] = head + 1
         return True
 
@@ -229,6 +270,13 @@ class TraceBuffer:
         n = recs.shape[0]
         if n == 0:
             return 0
+        if self._fc is not None:
+            return self._fc.trace_emit_many(self._addr, recs, n)
+        if self._nat is not None:
+            from pbs_tpu.runtime import native as native_mod
+
+            return int(self._nat.pbst_trace_emit_many(
+                self._ptr, native_mod.as_u64p(recs.reshape(-1)), n))
         hdr = self._hdr
         head, tail, cap = hdr[0], hdr[1], self.capacity
         space = cap - (head - tail)
@@ -272,6 +320,10 @@ class TraceBuffer:
 
     def consume(self, max_records: int = 1024) -> np.ndarray:
         """(n, 8) u64 array of drained records."""
+        if self._fc is not None:
+            out = np.empty(max_records * TRACE_REC_WORDS, dtype="<u8")
+            n = self._fc.trace_consume(self._addr, out, max_records)
+            return out[: n * TRACE_REC_WORDS].reshape(n, TRACE_REC_WORDS)
         if self._nat is not None:
             from pbs_tpu.runtime import native as native_mod
 
@@ -325,8 +377,9 @@ class EmitBatch:
     into one ring would interleave at flush granularity, not emit order.
     """
 
-    __slots__ = ("ring", "capacity", "flush_ns", "_buf", "_w", "_n",
-                 "_t0", "emitted", "flushes")
+    __slots__ = ("ring", "capacity", "flush_ns", "_bytes", "_buf",
+                 "_bufp", "_fc_flush", "_n", "_t0", "emitted",
+                 "flushes")
 
     def __init__(self, ring: TraceBuffer, capacity: int = 256,
                  flush_ns: int = 1_000_000):
@@ -335,31 +388,46 @@ class EmitBatch:
         self.ring = ring
         self.capacity = int(capacity)
         self.flush_ns = int(flush_ns)
-        self._buf = np.zeros((self.capacity, TRACE_REC_WORDS), dtype="<u8")
-        self._w = memoryview(self._buf.reshape(-1))  # 1-D 'Q' item view
+        # Staging block: a bytearray written by struct.pack_into (one C
+        # call per staged record) with a (capacity, 8) u64 numpy view
+        # over the same bytes for the flush.
+        self._bytes = bytearray(self.capacity * TRACE_REC_WORDS * 8)
+        self._buf = np.frombuffer(self._bytes, dtype="<u8").reshape(
+            self.capacity, TRACE_REC_WORDS)
+        # Precomputed staging pointers: when the ring is native, flush
+        # is ONE C call with no per-flush pointer marshalling.
+        self._bufp = None
+        self._fc_flush = None
+        if ring._fc is not None:
+            self._fc_flush = (ring._fc.trace_emit_many, ring._addr,
+                              self._buf.ctypes.data)
+        elif ring._nat is not None:
+            from pbs_tpu.runtime import native as native_mod
+
+            self._bufp = native_mod.as_u64p(self._buf.reshape(-1))
         self._n = 0
         self._t0 = -1  # ts of the oldest staged record; -1 = empty
         self.emitted = 0
         self.flushes = 0
 
     def emit(self, ts_ns: int, event: int, *args: int) -> None:
-        w = self._w
-        base = self._n * TRACE_REC_WORDS
-        ts_ns = int(ts_ns)
-        w[base] = ts_ns
-        w[base + 1] = int(event)
-        i = base + 2
-        for x in args[:6]:
-            x = int(x)
-            if not 0 <= x <= _U64_MASK:
-                x &= _U64_MASK
-            w[i] = x
-            i += 1
-        end = base + TRACE_REC_WORDS
-        while i < end:
-            w[i] = 0
-            i += 1
+        off = self._n * (TRACE_REC_WORDS * 8)
+        n = len(args)
+        if n > 6:
+            args = args[:6]
+            n = 6
+        b = self._bytes
+        try:
+            struct.pack_into(_PACK_FMTS[n], b, off, ts_ns, event, *args)
+        except struct.error:
+            struct.pack_into(
+                _PACK_FMTS[n], b, off, int(ts_ns) & _U64_MASK,
+                int(event) & _U64_MASK,
+                *[int(x) & _U64_MASK for x in args])
+        if n < 6:
+            b[off + (2 + n) * 8:off + TRACE_REC_WORDS * 8] = _ZERO_TAIL[n]
         self._n += 1
+        ts_ns = int(ts_ns)
         if self._t0 < 0:
             self._t0 = ts_ns
         if self._n >= self.capacity or ts_ns - self._t0 >= self.flush_ns:
@@ -370,13 +438,22 @@ class EmitBatch:
 
     def flush(self) -> int:
         """Push staged records to the ring; returns records written
-        (staged minus any the full ring dropped)."""
+        (staged minus any the full ring dropped). One
+        ``pbst_trace_emit_many`` C call when the ring is native."""
         n, self._n = self._n, 0
         self._t0 = -1
         if not n:
             return 0
         self.flushes += 1
-        written = self.ring.emit_many(self._buf[:n])
+        if self._fc_flush is not None:
+            f, ring_addr, buf_addr = self._fc_flush
+            written = f(ring_addr, buf_addr, n)
+        elif self._bufp is not None:
+            ring = self.ring
+            written = int(ring._nat.pbst_trace_emit_many(
+                ring._ptr, self._bufp, n))
+        else:
+            written = self.ring.emit_many(self._buf[:n])
         self.emitted += written
         return written
 

@@ -13,19 +13,27 @@ order-of-magnitude canary philosophy of ``pbst selftest`` extended to
 a refreshable, per-path baseline.
 """
 
-from pbs_tpu.perf.bench import BENCHES, BenchResult, bench_names, run_bench
+from pbs_tpu.perf.bench import (
+    BENCHES,
+    NATIVE_BENCHES,
+    BenchResult,
+    bench_names,
+    run_bench,
+)
 from pbs_tpu.perf.report import (
     DEFAULT_THRESHOLD,
     baseline_path,
     compare_to_baseline,
     format_report,
     load_baseline,
+    native_info,
     run_benches,
     save_baseline,
 )
 
 __all__ = [
-    "BENCHES", "BenchResult", "DEFAULT_THRESHOLD", "baseline_path",
-    "bench_names", "compare_to_baseline", "format_report",
-    "load_baseline", "run_bench", "run_benches", "save_baseline",
+    "BENCHES", "BenchResult", "DEFAULT_THRESHOLD", "NATIVE_BENCHES",
+    "baseline_path", "bench_names", "compare_to_baseline",
+    "format_report", "load_baseline", "native_info", "run_bench",
+    "run_benches", "save_baseline",
 ]

@@ -2,19 +2,22 @@
 
 Report shape (``pbst perf --json``; "version" gates schema changes):
 
-    {"version": 1, "quick": false,
+    {"version": 1, "quick": false, "native": false,
+     "native_available": true, "native_mode": "python",
      "benches": {"trace.emit": {"ops": ..., "ns_per_op": ..., ...}}}
 
-``baseline.json`` (checked in next to this module) holds TWO bench
-maps — ``benches`` (full op counts) and ``quick_benches`` (the reduced
-op counts of ``--quick``) — because quick runs carry systematic
-per-call-overhead offsets; the gate always compares like-with-like.
-It compares ns/op ratios and fails only on LARGE regressions (default
-≥2×): microbench noise across CI hosts is real, a 2× cliff on a hot
-path is not noise — the same philosophy as ``pbst selftest``'s
-order-of-magnitude canaries, but against refreshable per-path numbers
-instead of fixed ceilings. The refresh procedure is documented in
-docs/PERF.md ("Substrate microbenchmarks").
+``baseline.json`` (checked in next to this module) holds FOUR bench
+maps — ``benches``/``quick_benches`` for the pure-Python mode and
+``native_benches``/``native_quick_benches`` for ``--native`` —
+because quick runs carry systematic per-call-overhead offsets and the
+two modes measure different implementations; the gate always compares
+like-with-like, so a native regression fails CI exactly like a Python
+one. It compares ns/op ratios and fails only on LARGE regressions
+(default ≥2×): microbench noise across CI hosts is real, a 2× cliff
+on a hot path is not noise — the same philosophy as ``pbst
+selftest``'s order-of-magnitude canaries, but against refreshable
+per-path numbers instead of fixed ceilings. The refresh procedure is
+documented in docs/PERF.md ("Substrate microbenchmarks").
 """
 
 from __future__ import annotations
@@ -37,19 +40,47 @@ def baseline_path() -> str:
     return _BASELINE
 
 
-def run_benches(names: list[str] | None = None,
-                quick: bool = False) -> dict:
-    picked = list(names) if names else bench_names()
-    unknown = set(picked) - set(bench_names())
+def native_info() -> dict:
+    """The mode/availability stamp every report (and the serving
+    fallback in bench.py) carries, so BENCH_r* rounds stay comparable
+    across machines with and without a toolchain. ``native_tier``
+    says WHICH binding executed (fastcall needs Python.h at build
+    time); ``native_error`` carries the cached build/load failure."""
+    from pbs_tpu.runtime import native
+
+    avail = native.available()
+    tier = None
+    if avail:
+        tier = "fastcall" if native.fastcall() is not None else "ctypes"
+    info = {"native_available": avail, "native_tier": tier}
+    # last_failure (not unavailable_reason): a fastcall-tier failure on
+    # a host whose base library loads fine must surface too — "why am
+    # I on the ctypes tier" deserves an answer in the report.
+    reason = (native.unavailable_reason() if not avail
+              else (native.last_failure() if tier == "ctypes" else None))
+    if reason is not None:
+        info["native_error"] = reason
+    return info
+
+
+def run_benches(names: list[str] | None = None, quick: bool = False,
+                native: bool = False) -> dict:
+    picked = list(names) if names else bench_names(native=native)
+    unknown = set(picked) - set(bench_names(native=native))
     if unknown:
         raise KeyError(
             f"unknown bench(es) {sorted(unknown)}; "
-            f"available: {bench_names()}")
-    return {
+            f"available: {bench_names(native=native)}")
+    doc = {
         "version": 1,
         "quick": bool(quick),
-        "benches": {n: run_bench(n, quick=quick).as_dict() for n in picked},
+        "native": bool(native),
+        "native_mode": "native" if native else "python",
+        **native_info(),
+        "benches": {n: run_bench(n, quick=quick, native=native).as_dict()
+                    for n in picked},
     }
+    return doc
 
 
 def load_baseline(path: str | None = None) -> dict:
@@ -60,38 +91,49 @@ def load_baseline(path: str | None = None) -> dict:
     return base
 
 
+def _baseline_key(quick: bool, native: bool) -> str:
+    key = "quick_benches" if quick else "benches"
+    return f"native_{key}" if native else key
+
+
 def save_baseline(results: dict, path: str | None = None,
                   quick_results: dict | None = None) -> str:
     path = path or _BASELINE
+    native = bool(results.get("native"))
     # Merge over any existing baseline: a partial refresh
-    # (`--bench X --update-baseline`) must update X's numbers, not
-    # silently delete every other bench's entry (compare_to_baseline
-    # skips missing benches, so a dropped entry stops being gated).
-    benches: dict = {}
-    quick_benches: dict = {}
+    # (`--bench X --update-baseline`, or a native-only refresh) must
+    # update those numbers, not silently delete every other entry
+    # (compare_to_baseline skips missing benches, so a dropped entry
+    # stops being gated).
+    maps: dict[str, dict] = {k: {} for k in (
+        "benches", "quick_benches", "native_benches",
+        "native_quick_benches")}
     try:
         old = load_baseline(path)
-        benches.update(old["benches"])
-        quick_benches.update(old.get("quick_benches", {}))
+        for k in maps:
+            maps[k].update(old.get(k, {}))
     except (OSError, ValueError):
         pass  # no (or unreadable) prior baseline: write fresh
-    benches.update(results["benches"])
+    maps[_baseline_key(False, native)].update(results["benches"])
     if quick_results is not None:
-        quick_benches.update(quick_results["benches"])
+        maps[_baseline_key(True, native)].update(
+            quick_results["benches"])
     doc = {
         "version": 1,
         "note": ("refreshed via `pbst perf --update-baseline` "
-                 "(docs/PERF.md); 'benches' are full-matrix numbers, "
-                 "'quick_benches' the --quick op counts — the gate "
-                 "compares like-with-like"),
+                 "(docs/PERF.md); 'benches'/'quick_benches' are the "
+                 "pure-Python full/--quick numbers, 'native_*' the "
+                 "--native mode — the gate compares like-with-like"),
         "host": {
             "python": platform.python_version(),
             "machine": platform.machine(),
         },
-        "benches": benches,
+        "benches": maps["benches"],
     }
-    if quick_benches:
-        doc["quick_benches"] = quick_benches
+    for k in ("quick_benches", "native_benches",
+              "native_quick_benches"):
+        if maps[k]:
+            doc[k] = maps[k]
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(doc, f, indent=1, sort_keys=True)
@@ -102,12 +144,22 @@ def save_baseline(results: dict, path: str | None = None,
 
 def baseline_benches_for(results: dict, baseline: dict) -> dict:
     """The like-with-like baseline map: quick results compare against
-    ``quick_benches`` when present (quick op counts carry systematic
-    per-call-overhead offsets a full-matrix number would misjudge)."""
-    if results.get("quick") and isinstance(
-            baseline.get("quick_benches"), dict):
-        return baseline["quick_benches"]
-    return baseline["benches"]
+    the ``*quick_benches`` map when present (quick op counts carry
+    systematic per-call-overhead offsets a full-matrix number would
+    misjudge), and ``--native`` results only ever compare against the
+    ``native_*`` maps."""
+    key = _baseline_key(bool(results.get("quick")),
+                        bool(results.get("native")))
+    m = baseline.get(key)
+    if isinstance(m, dict):
+        return m
+    if results.get("quick"):
+        # No quick map for the mode: fall back to its full-matrix map
+        # (the pre-dual-mode behavior; missing benches are skipped).
+        m = baseline.get(_baseline_key(False, bool(results.get("native"))))
+        if isinstance(m, dict):
+            return m
+    return {} if results.get("native") else baseline["benches"]
 
 
 def compare_to_baseline(results: dict, baseline: dict,
@@ -180,7 +232,9 @@ def main_check(results: dict, baseline_file: str | None,
     regressions = compare_to_baseline(results, baseline, threshold)
     if regressions:
         quick = bool(results.get("quick"))
-        retry = run_benches([r["bench"] for r in regressions], quick=quick)
+        retry = run_benches([r["bench"] for r in regressions],
+                            quick=quick,
+                            native=bool(results.get("native")))
         confirmed = compare_to_baseline(retry, baseline, threshold)
         recovered = ({r["bench"] for r in regressions}
                      - {r["bench"] for r in confirmed})

@@ -71,7 +71,7 @@ class Ledger:
 
     @classmethod
     def file_backed(cls, path: str, num_slots: int | None = None,
-                    native: bool | None = None,
+                    native: bool | str | None = None,
                     readonly: bool = False) -> "Ledger":
         import mmap
         import os
@@ -105,7 +105,8 @@ class Ledger:
         led._mmap = mm  # keep the mapping alive
         return led
 
-    def __init__(self, num_slots: int, buf=None, native: bool | None = None):
+    def __init__(self, num_slots: int, buf=None,
+                 native: bool | str | None = None):
         self.num_slots = num_slots
         nbytes = num_slots * SLOT_BYTES
         if buf is None:
@@ -117,8 +118,11 @@ class Ledger:
         self._arr = self._arr.reshape(num_slots, SLOT_WORDS)
         # Native fast path (native/pbst_runtime.cc): same byte layout,
         # real atomics. native=None auto-detects; False forces Python
-        # (used by tests to exercise both paths).
+        # (used by tests to exercise both paths); "ctypes" pins the
+        # ctypes tier without the fastcall accelerator.
         self._nat = None
+        self._fc = None
+        self._addr = 0
         if native is not False:
             from pbs_tpu.runtime import native as native_mod
 
@@ -126,7 +130,11 @@ class Ledger:
             if lib is not None:
                 self._nat = lib
                 self._as_u64p = native_mod.as_u64p
+                self._as_i64p = native_mod.as_i64p
                 self._ptr = native_mod.as_u64p(self._arr.reshape(-1))
+                if native != "ctypes":
+                    self._fc = native_mod.fastcall()
+                    self._addr = self._arr.ctypes.data
             elif native is True:
                 raise RuntimeError("native runtime requested but unavailable")
 
@@ -248,10 +256,38 @@ class Ledger:
         if idx.size == 0:
             return np.empty((0, NUM_COUNTERS), dtype="<u8")
         if self._nat is not None:
+            # One C call over the whole slot vector
+            # (pbst_ledger_snapshot_many; per-slot seqlock retries so
+            # one busy writer can't burn the vector's budget) — the
+            # per-slot ctypes loop this replaces paid call
+            # marshalling per slot.
+            idx64 = np.ascontiguousarray(idx, dtype=np.int64)
             out = np.empty((idx.size, NUM_COUNTERS), dtype="<u8")
-            for i, slot in enumerate(idx):
-                out[i] = self.snapshot(int(slot), max_retries)
+            if self._fc is not None:
+                rc = self._fc.ledger_snapshot_many(
+                    self._addr, self.num_slots, idx64, idx64.size, out,
+                    max_retries)
+            else:
+                rc = self._nat.pbst_ledger_snapshot_many(
+                    self._ptr, self.num_slots, self._as_i64p(idx64),
+                    idx64.size, self._as_u64p(out.reshape(-1)),
+                    max_retries)
+                if rc == -2:
+                    raise IndexError(
+                        f"ledger slots {list(map(int, idx))}: slot out "
+                        f"of range [0, {self.num_slots})")
+            if rc < 0:
+                raise RuntimeError(
+                    f"ledger slots {list(map(int, idx))}: snapshot_many "
+                    "retries exhausted")
             return out
+        if ((idx < 0) | (idx >= self.num_slots)).any():
+            # Tier equivalence: the C paths reject out-of-range slots;
+            # without this, numpy fancy indexing would silently WRAP a
+            # negative slot to another slot's counters.
+            raise IndexError(
+                f"ledger slots {list(map(int, idx))}: slot out of "
+                f"range [0, {self.num_slots})")
         for _ in range(max_retries):
             v0 = self._arr[idx, _V].copy()
             if (v0 & 1).any():

@@ -54,6 +54,47 @@ def _build_native() -> None:
         import warnings
 
         warnings.warn(f"native build skipped: {e}", stacklevel=1)
+    try:
+        # The optional fastcall tier (needs Python.h). Failure is
+        # expected on header-less hosts: tests then run the ctypes
+        # tier, and native.unavailable_reason() says so.
+        subprocess.run(
+            ["make", "-C", native, "fastcall"], capture_output=True,
+            text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        pass
 
 
 _build_native()
+
+
+# -- native runtime plumbing (session-scoped: ONE build + load per run,
+# never a per-test 120 s make timeout) --------------------------------
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def native_lib():
+    """The loaded native runtime (ctypes bindings), building the .so
+    at most once per session; SKIPS the requesting test with the
+    cached failure reason when no toolchain can produce one."""
+    from pbs_tpu.runtime import native
+
+    lib = native.load()
+    if lib is None:
+        pytest.skip(
+            f"native runtime unavailable: {native.unavailable_reason()}")
+    return lib
+
+
+def require_native() -> None:
+    """Imperative form of ``native_lib`` for native-parametrized tests
+    (``@pytest.mark.parametrize("use_native", ...)`` can't request a
+    fixture conditionally): skip with the cached WHY when the runtime
+    is unavailable."""
+    from pbs_tpu.runtime import native
+
+    if not native.available():
+        pytest.skip(
+            f"native runtime unavailable: {native.unavailable_reason()}")

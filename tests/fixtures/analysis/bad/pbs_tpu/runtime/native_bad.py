@@ -1,0 +1,26 @@
+"""Seeded perf-native-unchecked violations: loader results consumed
+with no None/unavailable branch."""
+
+from pbs_tpu.runtime import native as native_mod
+
+
+def emit_direct(ptr, ts, ev):
+    """Attribute ridden straight off the loader call."""
+    return native_mod.load().pbst_trace_emit(ptr, ts, ev, 0, 0, 0, 0, 0, 0)
+
+
+def drain_unguarded(ptr, out):
+    """Result bound to a local that is never None-checked."""
+    lib = native_mod.load()
+    return lib.pbst_trace_consume(ptr, out, 1024)
+
+
+class UnguardedRing:
+    """Result stashed on self with no None branch anywhere."""
+
+    def __init__(self, arr):
+        self._fc = native_mod.fastcall()
+        self._addr = arr.ctypes.data
+
+    def emit(self, ts, ev):
+        return self._fc.trace_emit(self._addr, ts, ev)

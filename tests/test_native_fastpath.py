@@ -1,0 +1,292 @@
+"""Native observability fast path: tier equivalence property tests.
+
+The PR-9 acceptance bar (docs/PERF.md "Native fast path"): the C entry
+points (``pbst_trace_emit_many``, ``pbst_hist_record[_many]``,
+``pbst_ledger_snapshot_many``) and both binding tiers (ctypes,
+fastcall) must be BIT-IDENTICAL to the pure-Python reference — same
+buffer bytes (seqlock version words included), same drop counters,
+same snapshot values — on heap- and file-backed buffers, so enabling
+the native runtime can never change a golden digest.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from conftest import require_native
+from pbs_tpu.obs.spans import (
+    HIST_BUCKETS,
+    HistBatch,
+    LatencyHistograms,
+    hist_bucket,
+    hist_quantile,
+)
+from pbs_tpu.obs.trace import TRACE_REC_WORDS, EmitBatch, Ev, TraceBuffer
+from pbs_tpu.runtime import native
+from pbs_tpu.telemetry import Ledger, NUM_COUNTERS
+
+TIERS = [False, "ctypes", True]  # python, ctypes, fastcall-or-ctypes
+
+
+def _tier(mode):
+    if mode:
+        require_native()
+    return mode
+
+
+# -- batched trace emit ------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", TIERS)
+def test_emit_many_random_batches_bit_identical(mode):
+    """Random batch sizes over a small ring: every tier leaves the
+    SAME ring bytes and drop counter as the Python reference after
+    each step (wraps and tail-drops included)."""
+    _tier(mode)
+    rng = np.random.default_rng(3)
+    cap = 8
+    buf_t, buf_r = bytearray(2048), bytearray(2048)
+    tb = TraceBuffer(capacity=cap, buf=buf_t, native=mode)
+    ref = TraceBuffer(capacity=cap, buf=buf_r, native=False)
+    for step in range(200):
+        k = int(rng.integers(0, 2 * cap + 1))
+        recs = rng.integers(0, 2**63, size=(k, TRACE_REC_WORDS),
+                            dtype=np.uint64).astype("<u8")
+        assert tb.emit_many(recs) == ref.emit_many(recs)
+        if rng.random() < 0.4:
+            n = int(rng.integers(1, cap))
+            got, want = tb.consume(n), ref.consume(n)
+            np.testing.assert_array_equal(got, want)
+        assert buf_t == buf_r, f"ring bytes diverged at step {step}"
+    assert tb.lost == ref.lost
+
+
+@pytest.mark.parametrize("mode", [m for m in TIERS if m])
+def test_emit_many_file_backed_attach(tmp_path, mode):
+    """Native producer over a file-backed ring; a PYTHON consumer
+    attached to the same file drains the identical stream (the
+    xenbaked-style cross-implementation contract)."""
+    _tier(mode)
+    path = str(tmp_path / "ring.trace")
+    prod = TraceBuffer.file_backed(path, capacity=8, native=mode)
+    cons = TraceBuffer.file_backed(path, attach=True, native=False)
+    recs = np.zeros((5, TRACE_REC_WORDS), dtype="<u8")
+    recs[:, 0] = np.arange(5)
+    recs[:, 1] = int(Ev.SCHED_PICK)
+    assert prod.emit_many(recs) == 5
+    got = cons.consume(16)
+    np.testing.assert_array_equal(got, recs)
+    # Drops charge the SHARED lost word: the attached consumer sees it.
+    big = np.zeros((12, TRACE_REC_WORDS), dtype="<u8")
+    assert prod.emit_many(big) == 8
+    assert cons.lost == 4
+
+
+@pytest.mark.parametrize("mode", TIERS)
+def test_emit_batch_flush_is_tier_equivalent(mode):
+    """EmitBatch (the producers' staging path) lands the same bytes on
+    every tier, including the precomputed-pointer fast flush."""
+    _tier(mode)
+    buf_t, buf_r = bytearray(4096), bytearray(4096)
+    tb = TraceBuffer(capacity=32, buf=buf_t, native=mode)
+    ref = TraceBuffer(capacity=32, buf=buf_r, native=False)
+    b, rb = EmitBatch(tb, capacity=4), EmitBatch(ref, capacity=4)
+    for i in range(11):
+        b.emit(i, Ev.SPAN_DISPATCH, i, 500, -1, 2**65 + 3)
+        rb.emit(i, Ev.SPAN_DISPATCH, i, 500, -1, 2**65 + 3)
+    assert b.flush() == rb.flush()
+    assert buf_t == buf_r
+    assert b.emitted == rb.emitted and b.flushes == rb.flushes
+
+
+# -- histograms --------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", TIERS)
+def test_hist_record_and_many_bit_identical(mode):
+    """Scalar record + batched record_many leave byte-identical
+    ledger state (version words included) across tiers, for random
+    values spanning every bucket plus the clamp edges."""
+    _tier(mode)
+    rng = np.random.default_rng(11)
+    h = LatencyHistograms(num_slots=8, native=mode)
+    r = LatencyHistograms(num_slots=8, native=False)
+    for i in range(300):
+        v = int(rng.integers(0, 1 << 62))
+        h.record("t%d" % (i % 3), "interactive", "queue", v)
+        r.record("t%d" % (i % 3), "interactive", "queue", v)
+    h.record("t0", "interactive", "queue", -7)  # clamp: bucket 0
+    r.record("t0", "interactive", "queue", -7)
+    slots = rng.integers(0, 7, size=257).astype(np.int64)
+    values = rng.integers(0, 1 << 62, size=257, dtype=np.uint64).astype("<u8")
+    h.record_many(slots, values)
+    r.record_many(slots, values)
+    np.testing.assert_array_equal(h.ledger.raw(), r.ledger.raw())
+    assert h.keys() == r.keys()
+
+
+@pytest.mark.parametrize("mode", [m for m in TIERS if m])
+def test_hist_record_many_bounds_prevalidated(mode):
+    """A batch containing one bad slot mutates NOTHING on any tier."""
+    _tier(mode)
+    h = LatencyHistograms(num_slots=4, native=mode)
+    before = h.ledger.raw().copy()
+    with pytest.raises(IndexError):
+        h.record_many(np.array([0, 9], dtype=np.int64),
+                      np.array([1, 1], dtype="<u8"))
+    np.testing.assert_array_equal(h.ledger.raw(), before)
+    with pytest.raises(IndexError):
+        h.record_many(np.array([-1], dtype=np.int64),
+                      np.array([1], dtype="<u8"))
+
+
+@pytest.mark.parametrize("mode", TIERS)
+def test_hist_batch_staging_matches_scalar(mode):
+    """HistBatch (the gateway's per-tick slab) is invisible in the
+    bytes: staged samples == the same scalar records, slot interning
+    order included; flush-before-read shows identical quantiles."""
+    _tier(mode)
+    rng = np.random.default_rng(5)
+    staged = LatencyHistograms(num_slots=16, native=mode)
+    scalar = LatencyHistograms(num_slots=16, native=False)
+    hb = HistBatch(staged, capacity=8)
+    keys = [("a", "interactive", "queue"), ("b", "batch", "e2e"),
+            ("be:x", "*", "service")]
+    for i in range(100):
+        who, cls, stage = keys[int(rng.integers(0, 3))]
+        v = int(rng.integers(0, 1 << 40))
+        hb.record(who, cls, stage, v)
+        scalar.record(who, cls, stage, v)
+    hb.flush()
+    np.testing.assert_array_equal(staged.ledger.raw(),
+                                  scalar.ledger.raw())
+    assert staged.keys() == scalar.keys()
+    for who, cls, stage in keys:
+        assert staged.quantile(who, cls, stage, 0.99) == \
+            scalar.quantile(who, cls, stage, 0.99)
+
+
+def test_hist_batch_python_tier_degrades_to_direct():
+    """On the pure-Python tier staging would only add cost: HistBatch
+    records in place and flush is a no-op."""
+    h = LatencyHistograms(num_slots=8, native=False)
+    hb = HistBatch(h, capacity=64)
+    hb.record("t", "interactive", "queue", 1 << 20)
+    assert hb.pending() == 0  # landed immediately
+    assert int(h.counts("t", "interactive", "queue").sum()) == 1
+    assert hb.flush() == 0
+
+
+# -- ledger snapshot_many ----------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", TIERS)
+def test_snapshot_many_random_slot_vectors(mode):
+    _tier(mode)
+    rng = np.random.default_rng(17)
+    led = Ledger(16, native=mode)
+    for s in range(16):
+        led.add_many(s, rng.integers(0, 1 << 30, size=NUM_COUNTERS,
+                                     dtype=np.uint64).astype("<u8"))
+    for _ in range(20):
+        k = int(rng.integers(1, 16))
+        idx = rng.integers(0, 16, size=k).tolist()  # dups legal
+        many = led.snapshot_many(idx)
+        assert many.shape == (k, NUM_COUNTERS)
+        for row, s in zip(many, idx):
+            np.testing.assert_array_equal(row, led.snapshot(int(s)))
+
+
+@pytest.mark.parametrize("mode", [m for m in TIERS if m])
+def test_snapshot_many_file_backed_and_bounds(tmp_path, mode):
+    _tier(mode)
+    path = str(tmp_path / "led.bin")
+    led = Ledger.file_backed(path, num_slots=4, native=mode)
+    led.add(2, 3, 41)
+    mon = Ledger.file_backed(path, readonly=True, native=mode)
+    np.testing.assert_array_equal(mon.snapshot_many([2])[0],
+                                  led.snapshot(2))
+    with pytest.raises(IndexError):
+        led.snapshot_many([0, 4])
+
+
+# -- fallback / degradation --------------------------------------------------
+
+
+def test_everything_degrades_without_native(monkeypatch):
+    """load() -> None: rings, ledgers, histograms, batches all run the
+    pure-Python paths — nothing upstack may crash (the
+    perf-native-unchecked contract)."""
+    monkeypatch.setattr(native, "load", lambda: None)
+    tb = TraceBuffer(capacity=8)
+    assert tb._nat is None and tb._fc is None
+    assert tb.emit(1, Ev.SCHED_PICK, 7)
+    led = Ledger(2)
+    led.add(0, 1, 5)
+    assert int(led.snapshot_many([0])[0][1]) == 5
+    h = LatencyHistograms(num_slots=4)
+    HistBatch(h).record("t", "interactive", "queue", 1 << 20)
+    assert h.quantile("t", "interactive", "queue", 0.5) > 0
+    with pytest.raises(RuntimeError):
+        TraceBuffer(capacity=8, native=True)
+
+
+def test_ctypes_tier_without_fastcall(monkeypatch):
+    """fastcall unavailable (no Python.h): everything rides ctypes."""
+    require_native()
+    monkeypatch.setattr(native, "fastcall", lambda: None)
+    tb = TraceBuffer(capacity=8)
+    assert tb._nat is not None and tb._fc is None
+    assert tb.emit(1, Ev.SCHED_PICK, 7)
+    assert tb.consume(8).shape == (1, TRACE_REC_WORDS)
+    h = LatencyHistograms(num_slots=4)
+    assert h._fc is None and h._nat is not None
+    h.record("t", "interactive", "queue", 1 << 20)
+    assert int(h.counts("t", "interactive", "queue").sum()) == 1
+
+
+def test_build_failure_reason_is_cached_and_logged(monkeypatch,
+                                                   tmp_path):
+    """The silent-build-failure fix: a failed make lands one console
+    ring record and caches the reason for pbst perf."""
+    import importlib
+
+    import pbs_tpu.runtime.native as nat_mod
+    from pbs_tpu.obs import console
+
+    monkeypatch.setattr(nat_mod, "_lib", None)
+    monkeypatch.setattr(nat_mod, "_tried", False)
+    monkeypatch.setattr(nat_mod, "_fail_reason", None)
+    monkeypatch.setattr(nat_mod, "_LIB_PATH",
+                        str(tmp_path / "nope" / "lib.so"))
+    monkeypatch.setattr(nat_mod, "_NATIVE_DIR", str(tmp_path / "nope"))
+    before = console.read_system()["next"]
+    assert nat_mod.load() is None
+    reason = nat_mod.unavailable_reason()
+    assert reason is not None and reason != "never attempted"
+    lines = console.read_system(since=before)["lines"]
+    assert any("native" in ln["line"] and "fallback" in ln["line"]
+               for ln in lines), lines
+    importlib.reload(nat_mod)  # restore the real module state
+
+
+def test_hist_bucket_edges_pure():
+    """The C bucketing mirrors hist_bucket exactly at the edges."""
+    require_native()
+    h = LatencyHistograms(num_slots=4, native=True)
+    r = LatencyHistograms(num_slots=4, native=False)
+    edges = [0, 1, (1 << 13) - 1, 1 << 13, (1 << 14) - 1, 1 << 14,
+             1 << 30, (1 << 31) - 1, 1 << 62, (1 << 63) - 1]
+    for v in edges:
+        h.record("t", "interactive", "queue", v)
+        r.record("t", "interactive", "queue", v)
+    np.testing.assert_array_equal(
+        h.counts("t", "interactive", "queue"),
+        r.counts("t", "interactive", "queue"))
+    assert hist_bucket(0) == 0 and hist_bucket((1 << 14) - 1) == 0
+    assert hist_bucket(1 << 14) == 1
+    assert hist_bucket(1 << 62) == HIST_BUCKETS - 1
+    c = np.zeros(HIST_BUCKETS, dtype=np.int64)
+    c[1] = 1
+    assert hist_quantile(c, 0.99) == (1 << 15) - 1

@@ -13,8 +13,10 @@ import os
 
 import pytest
 
+from conftest import require_native
 from pbs_tpu.cli.pbst import main
 from pbs_tpu.perf import (
+    NATIVE_BENCHES,
     bench_names,
     compare_to_baseline,
     load_baseline,
@@ -24,14 +26,19 @@ from pbs_tpu.perf import (
 
 #: The cheap, allocation-sensitive benches used for unit-level checks
 #: (no sockets, no sim run).
-CHEAP = ["trace.emit", "trace.emit_many", "trace.consume", "ledger.sample"]
+CHEAP = ["trace.emit", "trace.emit_many", "trace.consume",
+         "ledger.snapshot_many"]
 
 
 def test_bench_registry_names():
     assert {"trace.emit", "trace.emit_many", "trace.consume",
-            "span.emit", "hist.record",
-            "ledger.sample", "fairqueue.cycle", "sim.smoke",
+            "span.emit", "hist.record", "hist.record_many",
+            "ledger.snapshot_many", "fairqueue.cycle", "sim.smoke",
             "rpc.roundtrip"} == set(bench_names())
+    # The native matrix is the substrate subset: every native bench
+    # exists in the python registry too (dual-mode, same measurement).
+    assert set(bench_names(native=True)) == set(NATIVE_BENCHES)
+    assert set(NATIVE_BENCHES) <= set(bench_names())
 
 
 def test_run_bench_shape_and_sanity():
@@ -65,11 +72,14 @@ def test_compare_flags_only_large_regressions():
 
 def test_checked_in_baseline_is_loadable_and_complete():
     base = load_baseline()
-    # Both comparison modes ship: full-matrix numbers AND the quick op
-    # counts the tier-1 smoke compares against (like-with-like).
+    # All four comparison maps ship: python full/quick AND the
+    # --native mode's substrate maps (like-with-like per mode).
     assert set(base["benches"]) == set(bench_names())
     assert set(base["quick_benches"]) == set(bench_names())
-    for mode in ("benches", "quick_benches"):
+    assert set(base["native_benches"]) == set(NATIVE_BENCHES)
+    assert set(base["native_quick_benches"]) == set(NATIVE_BENCHES)
+    for mode in ("benches", "quick_benches", "native_benches",
+                 "native_quick_benches"):
         for name, rec in base[mode].items():
             assert rec["ns_per_op"] > 0, (mode, name)
 
@@ -82,6 +92,21 @@ def test_quick_results_compare_against_quick_baseline():
     results["quick"] = False
     regs = compare_to_baseline(results, baseline, threshold=2.0)
     assert [r["bench"] for r in regs] == ["a"]
+
+
+def test_native_results_only_compare_against_native_maps():
+    # A native run must NEVER be judged against python-mode numbers:
+    # its whole point is being several x faster, which would mask a
+    # real native regression until it crossed the python line.
+    results = {"native": True, "benches": {"a": {"ns_per_op": 100.0}}}
+    baseline = {"benches": {"a": {"ns_per_op": 1000.0}},  # python: fine
+                "native_benches": {"a": {"ns_per_op": 10.0}}}  # 10x reg
+    regs = compare_to_baseline(results, baseline, threshold=2.0)
+    assert [r["bench"] for r in regs] == ["a"]
+    # No native maps at all: nothing is gated (a new mode must be able
+    # to land before its baseline numbers do) — python map untouched.
+    assert compare_to_baseline(
+        results, {"benches": {"a": {"ns_per_op": 10.0}}}, 2.0) == []
 
 
 def test_wall_clock_benches_get_wider_armor():
@@ -173,3 +198,70 @@ def test_baseline_checked_into_package():
     import pbs_tpu.perf.report as report
 
     assert os.path.exists(report.baseline_path())
+
+
+# -- dual mode (--native) ----------------------------------------------------
+
+
+def test_report_carries_native_stamp(capsys):
+    """Satellite: every report says which mode ran and whether/why the
+    native runtime is (un)available, so BENCH_r* rounds compare across
+    machines with and without a toolchain."""
+    assert main(["perf", "--bench", "trace.emit_many", "--quick",
+                 "--json"]) == 0
+    d = json.loads(capsys.readouterr().out)
+    assert d["native"] is False and d["native_mode"] == "python"
+    assert isinstance(d["native_available"], bool)
+    if d["native_available"]:
+        assert d["native_tier"] in ("fastcall", "ctypes")
+    else:
+        assert d["native_error"]
+
+
+def test_cli_perf_native_quick_check_smoke(capsys):
+    """The native twin of THE tier-1 gate: quick substrate matrix in
+    native mode vs the baseline's native maps."""
+    require_native()
+    assert main(["perf", "--check", "--quick", "--native",
+                 "--json"]) == 0
+    d = json.loads(capsys.readouterr().out)
+    assert d["native"] is True and d["native_mode"] == "native"
+    assert set(d["benches"]) == set(NATIVE_BENCHES)
+
+
+def test_native_bench_without_native_path_is_usage_error(capsys):
+    require_native()
+    assert main(["perf", "--native", "--bench", "rpc.roundtrip",
+                 "--quick"]) == 2
+    err = capsys.readouterr().err
+    assert "rpc.roundtrip" in err and "unknown bench" in err
+
+
+def test_cli_perf_native_unavailable_is_explicit(monkeypatch, capsys):
+    """--native on a host with no toolchain must FAIL with the cached
+    reason, never silently bench the python paths as 'native'."""
+    from pbs_tpu.runtime import native as native_mod
+
+    monkeypatch.setattr(native_mod, "available", lambda: False)
+    monkeypatch.setattr(native_mod, "unavailable_reason",
+                        lambda: "make exited 2: g++: not found")
+    assert main(["perf", "--native", "--bench", "trace.emit",
+                 "--quick"]) == 2
+    err = capsys.readouterr().err
+    assert "g++: not found" in err
+
+
+def test_update_baseline_native_writes_native_maps(tmp_path):
+    require_native()
+    out = tmp_path / "b.json"
+    assert main(["perf", "--bench", "trace.consume", "--baseline",
+                 str(out), "--update-baseline"]) == 0
+    assert main(["perf", "--native", "--bench", "trace.consume",
+                 "--baseline", str(out), "--update-baseline"]) == 0
+    doc = json.loads(out.read_text())
+    # A native refresh merges alongside the python maps, never over.
+    assert set(doc["benches"]) == {"trace.consume"}
+    assert set(doc["native_benches"]) == {"trace.consume"}
+    assert set(doc["native_quick_benches"]) == {"trace.consume"}
+    assert main(["perf", "--native", "--bench", "trace.consume",
+                 "--baseline", str(out), "--check"]) == 0

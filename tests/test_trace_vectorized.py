@@ -10,6 +10,7 @@ from __future__ import annotations
 import numpy as np
 import pytest
 
+from conftest import require_native
 from pbs_tpu.obs.trace import (
     TRACE_REC_WORDS,
     EmitBatch,
@@ -75,13 +76,14 @@ def _interleaved_equivalence(tb: TraceBuffer, consumer: TraceBuffer,
     assert tb.lost == ref.lost
 
 
-@pytest.mark.parametrize("use_native", [False, True])
+@pytest.mark.parametrize("use_native", [False, "ctypes", True])
 def test_batched_paths_match_scalar_reference(use_native):
     """Interleaved single/batched emits drained in chunks reproduce the
     exact scalar-path record sequence, drop counter included, across
-    many wraps (capacity 16, ~thousands of records)."""
-    if use_native and not native.available():
-        pytest.skip("no native runtime")
+    many wraps (capacity 16, ~thousands of records) — on the Python,
+    ctypes, and (when buildable) fastcall tiers."""
+    if use_native:
+        require_native()
     tb = TraceBuffer(capacity=16, native=use_native)
     _interleaved_equivalence(tb, tb, seed=7)
 
@@ -198,12 +200,12 @@ def test_sampler_overflow_lands_in_trace_in_both_modes(batched):
 # -- ledger fast path -------------------------------------------------------
 
 
-@pytest.mark.parametrize("use_native", [False, True])
+@pytest.mark.parametrize("use_native", [False, "ctypes", True])
 def test_snapshot_many_matches_scalar_snapshots(use_native):
     from pbs_tpu.telemetry import NUM_COUNTERS, Ledger
 
-    if use_native and not native.available():
-        pytest.skip("no native runtime")
+    if use_native:
+        require_native()
     led = Ledger(8, native=use_native)
     for s in range(8):
         led.add_many(s, np.arange(NUM_COUNTERS, dtype="<u8") * (s + 1))
