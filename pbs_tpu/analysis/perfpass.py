@@ -21,6 +21,15 @@ paying a scalar ring emit per event. Two rules:
   trailing identifier contains ``batch`` (``self._trace_batch.emit``,
   ``ring_batch.emit``) is an ``EmitBatch``, which exists precisely to
   be called per event.
+- ``perf-dispatch-alloc``: per-dispatch Python-object allocation in a
+  simulator dispatch edge — a ``.append(...)`` call or a
+  dict/list/set display (or comprehension) inside a ``do_schedule`` /
+  ``wake`` / ``sleep`` / ``descheduled`` body under ``sim/``. The
+  probe rewrite (``sim/engine.py``) moved accumulation onto
+  preallocated grow-by-doubling numpy arrays precisely because a list
+  append per dispatched quantum was the sweep bottleneck; this rule
+  keeps it out. The list-based reference probe carries justified
+  line suppressions — it exists to witness equivalence, not to sweep.
 - ``perf-native-unchecked``: a call site consuming a
   ``native_mod.load()`` / ``native_mod.fastcall()`` result without
   handling the None branch. The native runtime is OPTIONAL by
@@ -54,6 +63,13 @@ EMITTERS = ("emit", "trace_emit")
 #: The optional-runtime loaders whose results can be None.
 NATIVE_LOADERS = ("load", "fastcall")
 
+#: Scheduler-probe dispatch edges: the per-quantum hot methods the
+#: numpy-accumulator rewrite de-allocated (sim/engine.py).
+DISPATCH_EDGES = ("do_schedule", "wake", "sleep", "descheduled")
+
+#: Packages whose dispatch edges the allocation rule covers.
+DISPATCH_PACKAGES = ("sim/",)
+
 #: The loader implementation itself (its internal load() calls are the
 #: machinery the rule protects callers of).
 NATIVE_MACHINERY = ("runtime/native.py",)
@@ -85,6 +101,60 @@ def _receiver_ident(func: ast.Attribute) -> str:
 def _mentions_rec_words(node: ast.AST) -> bool:
     return any(isinstance(sub, ast.Name) and sub.id == "TRACE_REC_WORDS"
                for sub in ast.walk(node))
+
+
+class _DispatchAllocScan(ast.NodeVisitor):
+    """perf-dispatch-alloc: allocation idioms inside a sim dispatch
+    edge's body (nested defs get their own scan, so a helper defined
+    inside an edge is not double-counted)."""
+
+    def __init__(self, src: SourceFile):
+        self.src = src
+        self.findings: list[Finding] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if node.name in DISPATCH_EDGES:
+            for stmt in node.body:
+                self._scan_body(stmt)
+        # Recurse either way: a nested def (edge or not) gets its own
+        # visit — _scan_body below excludes nested-def subtrees from
+        # the ENCLOSING edge's scan.
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _flag(self, node: ast.AST, what: str) -> None:
+        self.findings.append(Finding(
+            "perf-dispatch-alloc", self.src.rel_path, node.lineno,
+            node.col_offset,
+            f"{what} inside a scheduler dispatch edge — one Python "
+            "object allocation per dispatched quantum is the "
+            "accumulation pattern the numpy probe rewrite removed",
+            hint="accumulate on preallocated grow-by-doubling arrays "
+                 "(index store + count bump; see sim/engine.py "
+                 "SchedulerProbe/_TenantAcc) and defer container "
+                 "building to the metrics accessors"))
+
+    def _scan_body(self, stmt: ast.stmt) -> None:
+        # Manual stack walk: ast.walk would descend INTO nested defs,
+        # attributing a helper's one-time allocations to the edge —
+        # here a nested def's whole subtree is pruned (it gets its own
+        # visit_FunctionDef pass instead).
+        stack = [stmt]
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr == "append":
+                self._flag(sub, "list .append() per dispatch")
+            elif isinstance(sub, (ast.Dict, ast.DictComp)):
+                self._flag(sub, "dict literal/comprehension")
+            elif isinstance(sub, (ast.List, ast.ListComp,
+                                  ast.Set, ast.SetComp)):
+                self._flag(sub, "list/set literal/comprehension")
+            stack.extend(ast.iter_child_nodes(sub))
 
 
 class _PerfScan(ast.NodeVisitor):
@@ -238,13 +308,16 @@ class _NativeScan:
 class PerfDisciplinePass(Pass):
     id = "perf-discipline"
     rules = ("perf-rec-loop", "perf-emit-in-loop",
-             "perf-native-unchecked")
+             "perf-dispatch-alloc", "perf-native-unchecked")
     description = ("trace/telemetry hot paths stay vectorized and "
                    "native-optional: no per-record TRACE_REC_WORDS "
                    "loops, no scalar ring emits inside loops in "
                    "sim/gateway/telemetry (EmitBatch/emit_many are "
-                   "the sanctioned forms), and every native loader "
-                   "result handles the None/unavailable branch")
+                   "the sanctioned forms), no per-dispatch container "
+                   "allocation in sim dispatch edges (numpy "
+                   "accumulators are the sanctioned form), and every "
+                   "native loader result handles the None/unavailable "
+                   "branch")
 
     def run(self, src: SourceFile, ctx: CheckContext) -> list[Finding]:
         if src.tree is None or _is_test(src.rel_path):
@@ -259,6 +332,10 @@ class PerfDisciplinePass(Pass):
             scan = _PerfScan(src, rec_scope, emit_scope)
             scan.visit(src.tree)
             findings.extend(scan.findings)
+        if any(anchored.startswith(p) for p in DISPATCH_PACKAGES):
+            dscan = _DispatchAllocScan(src)
+            dscan.visit(src.tree)
+            findings.extend(dscan.findings)
         if native_scope:
             nat = _NativeScan(src)
             nat.scan(src.tree)

@@ -1080,6 +1080,122 @@ def cmd_gateway(args) -> int:
     return 0 if report["ok"] else 1
 
 
+def cmd_tune(args) -> int:
+    """Simulation-driven policy autotuning (pbs_tpu.sched.tune;
+    docs/TUNE.md). Default: run the successive-halving search for the
+    selected workload(s) and print the frontier. ``--write`` emits the
+    tuned profiles (checked in under pbs_tpu/sched/tuned/).
+    ``--check`` replays every checked-in profile's deterministic score
+    grid and exits 1 if any digest stopped reproducing — the CI gate
+    that makes the tuned frontier a regression surface like
+    perf/baseline.json."""
+    from pbs_tpu.sched import tune
+    from pbs_tpu.sim.workload import workload_names
+
+    if args.check and args.write:
+        print("pbst: --check and --write are mutually exclusive: "
+              "--check replays the RECORDED grids; after a drift, "
+              "refresh with a separate `pbst tune --write` run",
+              file=sys.stderr)
+        return 2
+    if args.write and args.quick and args.tuned_dir is None:
+        # Mirrors `pbst perf` refusing a --quick baseline: a reduced
+        # search must not silently downgrade the checked-in profiles
+        # (the check gate verifies reproducibility, not search depth).
+        print("pbst: refusing to overwrite the checked-in tuned "
+              "profiles from a --quick search (reduced space/rungs); "
+              "drop --quick, or write elsewhere with --tuned-dir",
+              file=sys.stderr)
+        return 2
+    if args.check:
+        if args.quick or args.seed or args.policy != "feedback":
+            # The check grid, its base seed and each profile's policy
+            # are RECORDED in the profiles — say so instead of
+            # silently accepting flags that change nothing.
+            print("pbst: note: --check replays each profile's recorded "
+                  "grid/policy; --quick/--seed/--policy have no "
+                  "effect on it", file=sys.stderr)
+        names = (tune.tuned_workloads(args.tuned_dir)
+                 if args.workload == "all" else [args.workload])
+        if not names:
+            print("pbst: no tuned profiles found "
+                  f"(dir: {args.tuned_dir or tune.TUNED_DIR})",
+                  file=sys.stderr)
+            return 2
+        verdicts = []
+        for wl in names:
+            try:
+                verdicts.append(tune.check_profile(
+                    wl, args.tuned_dir, workers=args.workers))
+            except (OSError, ValueError, KeyError) as e:
+                print(f"pbst: bad tuned profile {wl!r}: {e}",
+                      file=sys.stderr)
+                return 2
+        ok = all(v["ok"] for v in verdicts)
+        if args.json:
+            print(json.dumps({"version": 1, "ok": ok,
+                              "profiles": verdicts},
+                             indent=1, sort_keys=True))
+        else:
+            for v in verdicts:
+                status = "ok" if v["ok"] else "DIGEST MISMATCH"
+                line = (f"{v['workload']:<10} {v['policy']:<9} "
+                        f"score={v['got_score_x1e6'] / 1e6:+.6f} "
+                        f"{status}")
+                if not v["ok"]:
+                    d = v["score_delta_x1e6"]
+                    line += (f" (tuned score "
+                             f"{'regressed' if d < 0 else 'moved'} "
+                             f"{d / 1e6:+.6f}; refresh with "
+                             f"`pbst tune --write`)")
+                print(line)
+            print("ok" if ok else "FAILED")
+        return 0 if ok else 1
+
+    if args.workload == "all":
+        names = list(tune.TUNED_WORKLOADS)
+    elif args.workload in workload_names():
+        names = [args.workload]
+    else:
+        print(f"pbst: unknown workload {args.workload!r}; "
+              f"available: {workload_names()} or 'all'", file=sys.stderr)
+        return 2
+    if args.policy not in tune.SEARCH_SPACE:
+        print(f"pbst: no search space for policy {args.policy!r}; "
+              f"tunable: {sorted(tune.SEARCH_SPACE)}", file=sys.stderr)
+        return 2
+    space = (tune.QUICK_SPACE if args.quick
+             else tune.SEARCH_SPACE)[args.policy]
+    rungs = tune.QUICK_RUNGS if args.quick else tune.RUNGS
+    out = {}
+    for wl in names:
+        frontier = tune.successive_halving(
+            wl, args.policy, configs=space, rungs=rungs,
+            base_seed=args.seed, workers=args.workers)
+        out[wl] = frontier
+        if args.write:
+            path = tune.write_profile(wl, frontier, base_seed=args.seed,
+                                      tuned_dir=args.tuned_dir)
+            print(f"wrote {path}", file=sys.stderr)
+    if args.json:
+        print(json.dumps({"version": 1, "workloads": out},
+                         indent=1, sort_keys=True))
+    else:
+        print(f"{'workload':<10} {'policy':<9} {'score':>10} params")
+        for wl, f in out.items():
+            w = f["winner"]
+            print(f"{wl:<10} {args.policy:<9} "
+                  f"{w['score_x1e6'] / 1e6:>+10.6f} "
+                  f"{json.dumps(w['params'], sort_keys=True)}")
+    return 0
+
+
+def tune_entry() -> None:
+    """Console entry ``pbst-tune`` (CI convenience: exactly
+    ``pbst tune ...`` without the subcommand word)."""
+    sys.exit(main(["tune", *sys.argv[1:]]))
+
+
 def gateway_entry() -> None:
     """Console entry ``pbst-gateway`` (CI convenience: exactly
     ``pbst gateway ...`` without the subcommand word)."""
@@ -1490,6 +1606,30 @@ def main(argv=None) -> int:
                          "spans / pbst slo report (docs/TRACING.md)")
     sp.add_argument("--json", action="store_true")
     sp.set_defaults(fn=cmd_gateway)
+
+    sp = sub.add_parser(
+        "tune", help="simulation-driven policy autotuning (docs/TUNE.md)")
+    sp.add_argument("--workload", default="all",
+                    help="workload class or 'all' (see docs/SIM.md)")
+    sp.add_argument("--policy", default="feedback",
+                    help="policy whose constants to search "
+                         "(feedback | atc)")
+    sp.add_argument("--seed", type=int, default=0,
+                    help="base seed for sha256 per-cell seed derivation")
+    sp.add_argument("--workers", type=int, default=1,
+                    help="sweep worker processes (1 = inline)")
+    sp.add_argument("--quick", action="store_true",
+                    help="reduced space/rungs (the <=5 s smoke tier)")
+    sp.add_argument("--check", action="store_true",
+                    help="replay every tuned profile's score grid; "
+                         "exit 1 on any digest mismatch (the CI gate)")
+    sp.add_argument("--write", action="store_true",
+                    help="emit tuned profiles to the tuned dir")
+    sp.add_argument("--tuned-dir", default=None, dest="tuned_dir",
+                    help="profile directory (default: the checked-in "
+                         "pbs_tpu/sched/tuned/)")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_tune)
 
     sp = sub.add_parser("demo", help="run the two-tenant sim demo")
     sp.add_argument("--scheduler", default="credit")

@@ -56,7 +56,11 @@ class BenchResult:
         return {
             "ops": self.ops,
             "rounds": self.rounds,
-            "ns_per_op": round(self.ns_per_op, 1),
+            # Sub-ns/op benches (sim.sustained counts simulated ns as
+            # ops) need more than one decimal or the regression ratio
+            # quantizes to coarse steps.
+            "ns_per_op": round(self.ns_per_op,
+                               1 if self.ns_per_op >= 10 else 4),
             "ops_per_s": round(self.ops_per_s, 1),
             "alloc_blocks_per_op": round(self.alloc_blocks_per_op, 4),
             "alloc_peak_kib": round(self.alloc_peak_kib, 1),
@@ -260,6 +264,41 @@ def _sim_smoke(n: int) -> BenchFns:
     return run, lambda: None, None
 
 
+def _sim_sustained(n: int) -> BenchFns:
+    """The sweep-throughput headline (docs/SIM.md "Sweep + sustained
+    throughput"): simulated-ns per wall-ns of one sweep-mode engine run
+    (mixed workload, feedback armed — the exact configuration a `pbst
+    tune` cell executes). ``n`` scales the horizon in virtual
+    milliseconds; ops = simulated ns, so ns/op is wall-ns PER
+    SIMULATED-ns (0.125 = the sim runs 8x faster than real time)."""
+    from pbs_tpu.sim.engine import SimEngine
+
+    def run() -> int:
+        eng = SimEngine(workload="mixed", policy="feedback", seed=0,
+                        n_tenants=4, horizon_ns=n * MS_NS, record=False)
+        rep = eng.run()
+        return max(1, int(rep["elapsed_ns"]))
+
+    return run, lambda: None, None
+
+
+def _sweep_cell(n: int) -> BenchFns:
+    """Per-cell cost of the parallel-sweep substrate (sim/sweep.py,
+    inline worker path): seed derivation + sweep-mode engine + report
+    reduction, over ``n`` 20 ms cells."""
+    from pbs_tpu.sim.sweep import build_grid, run_cell
+
+    cells = build_grid(["mixed"], ["feedback"], n_reps=n,
+                       horizon_ns=20 * MS_NS)
+
+    def run() -> int:
+        for cell in cells:
+            run_cell(cell, base_seed=0)
+        return len(cells)
+
+    return run, lambda: None, None
+
+
 def _rpc_roundtrip(n: int) -> BenchFns:
     from pbs_tpu.dist.rpc import RpcClient, RpcServer
 
@@ -296,6 +335,10 @@ BENCHES: dict[str, tuple[Callable[..., BenchFns], int, int]] = {
     "ledger.snapshot_many": (_ledger_snapshot_many, 12_800, 6_400),
     "fairqueue.cycle": (_fairqueue_cycle, 10_000, 2_000),
     "sim.smoke": (_sim_smoke, 100, 25),
+    # n is the horizon in virtual ms / the cell count; ns/op for
+    # sim.sustained is wall-ns per simulated-ns (lower = faster sim).
+    "sim.sustained": (_sim_sustained, 2_000, 250),
+    "sweep.cell": (_sweep_cell, 24, 6),
     "rpc.roundtrip": (_rpc_roundtrip, 300, 50),
 }
 
@@ -322,6 +365,8 @@ NATIVE_BENCHES = (
 CHECK_THRESHOLDS: dict[str, float] = {
     "rpc.roundtrip": 4.0,
     "sim.smoke": 3.0,
+    "sim.sustained": 3.0,
+    "sweep.cell": 3.0,
     "trace.consume": 3.0,
     "trace.emit_many": 3.0,
     "hist.record_many": 3.0,

@@ -31,6 +31,12 @@ if TYPE_CHECKING:
 #: starve the partition (no analog needed in Xen — timers preempt).
 MAX_STEPS_PER_QUANTUM = 1024
 
+# Plain-int counter indices for the dispatch hot path (an IntEnum
+# index pays an __index__ round trip per numpy access).
+_I_DEVICE_TIME = int(Counter.DEVICE_TIME_NS)
+_I_SCHED_COUNT = int(Counter.SCHED_COUNT)
+_I_COMPILE_TIME = int(Counter.COMPILE_TIME_NS)
+
 
 def quantum_to_steps(quantum_ns: int, avg_step_ns: float) -> int:
     if avg_step_ns <= 0:
@@ -51,6 +57,9 @@ class Executor:
         # Quanta actually dispatched (sched_invocations counts no-work
         # trips too — the watchdog must see real dispatches only).
         self.dispatch_count = 0
+        # Micro-dispatch capability of the partition's source, resolved
+        # once (a hasattr per quantum is measurable on the sim path).
+        self._micro_ok = hasattr(partition.source, "execute_micro")
 
     # ------------------------------------------------------------------
 
@@ -69,12 +78,13 @@ class Executor:
 
     def _run(self, ctx: ExecutionContext, quantum_ns: int) -> None:
         part = self.partition
+        job = ctx.job
         now = part.clock.now_ns()
 
-        if ctx.job.finished():
+        if job.finished():
             # Admitted with max_steps already reached (e.g. 0): retire
             # without executing anything.
-            for c in ctx.job.contexts:
+            for c in job.contexts:
                 if c.state is not ContextState.DONE:
                     c.state = ContextState.DONE
                     part.scheduler.sleep(c)
@@ -87,26 +97,38 @@ class Executor:
         self.dispatch_count += 1
         if ctx.ledger_slot >= 0:
             part.ledger.resume(ctx.ledger_slot, now)
-        part.trace_emit(self.index, Ev.SCHED_PICK, ctx.ledger_slot, quantum_ns)
+        if part.trace_enabled:
+            part.trace_emit(self.index, Ev.SCHED_PICK, ctx.ledger_slot,
+                            quantum_ns)
 
         # Sub-step latency bounding: a job with micro_per_step > 1 is
         # dispatched in micro units (its step decomposed into compiled
         # chunks with host-checked exits between them), so a long step
         # no longer floors the quantum — the 100 µs slice analog
         # (sched_credit.c:52; SURVEY.md §7 "hard parts").
-        K = ctx.job.micro_per_step
-        micro = K > 1 and hasattr(part.source, "execute_micro")
+        K = job.micro_per_step
+        micro = K > 1 and self._micro_ok
         if micro:
             n_units = quantum_to_steps(quantum_ns, ctx.avg_step_ns / K)
-            if ctx.job.max_steps is not None:
-                rem = ((ctx.job.max_steps - ctx.job.steps_retired()) * K
+            if job.max_steps is not None:
+                rem = ((job.max_steps - job.steps_retired()) * K
                        - ctx.micro_progress)
                 n_units = max(1, min(n_units, rem))
             n_steps_equiv = n_units / K
         else:
-            n_units = quantum_to_steps(quantum_ns, ctx.avg_step_ns)
-            if ctx.job.max_steps is not None:
-                remaining = ctx.job.max_steps - ctx.job.steps_retired()
+            # quantum_to_steps, inlined (one call per dispatched
+            # quantum is measurable on the sim fast path).
+            avg = ctx.avg_step_ns
+            if avg <= 0:
+                n_units = 1
+            else:
+                n_units = round(quantum_ns / avg)
+                if n_units < 1:
+                    n_units = 1
+                elif n_units > MAX_STEPS_PER_QUANTUM:
+                    n_units = MAX_STEPS_PER_QUANTUM
+            if job.max_steps is not None:
+                remaining = job.max_steps - job.steps_retired()
                 n_units = max(1, min(n_units, remaining))
             n_steps_equiv = n_units
 
@@ -128,23 +150,25 @@ class Executor:
 
         # -- context switch out: pmu_save_regs (perfctr_cpu_vsuspend
         # publishes sums into vcpu->pmc[], perfctr.c:1547-1573) ----------
-        ran_ns = int(deltas[Counter.DEVICE_TIME_NS])
-        deltas[Counter.SCHED_COUNT] = 1
-        ctx.counters += deltas
+        ran_ns = int(deltas[_I_DEVICE_TIME])
+        deltas[_I_SCHED_COUNT] = 1
+        np.add(ctx.counters, deltas, out=ctx.counters)
         ctx.observe_step_time(ran_ns, n_steps_equiv)
         if part.compile_admission is not None:
             # Measured compile spend tightens the admission projections
             # (runtime.compile_gate) — the accounting leg of the claim.
-            c_ns = int(deltas[Counter.COMPILE_TIME_NS])
+            c_ns = int(deltas[_I_COMPILE_TIME])
             if c_ns:
-                part.compile_admission.charge(ctx.job.name, c_ns)
+                part.compile_admission.charge(job.name, c_ns)
         if ctx.ledger_slot >= 0:
             part.ledger.suspend(ctx.ledger_slot, deltas)
         self.current = None
         part.progress_epoch += 1
 
         end = part.clock.now_ns()
-        part.trace_emit(self.index, Ev.SCHED_DESCHED, ctx.ledger_slot, ran_ns)
+        if part.trace_enabled:
+            part.trace_emit(self.index, Ev.SCHED_DESCHED, ctx.ledger_slot,
+                            ran_ns)
         if part.recorder is not None:
             part.recorder.on_quantum(
                 self.index, ctx, quantum_ns, n_units, deltas, now, end)
@@ -155,8 +179,8 @@ class Executor:
         # can cross; the virq is delivered by the run loop between quanta.
         part.sampler.check(ctx)
 
-        if ctx.job.finished():
-            for c in ctx.job.contexts:
+        if job.finished():
+            for c in job.contexts:
                 if c.state is not ContextState.DONE:
                     c.state = ContextState.DONE
                     part.scheduler.sleep(c)

@@ -69,6 +69,10 @@ class Partition:
             self.ledger = Ledger(ledger_slots)
         # Per-executor lockless trace rings (per-CPU rings, trace.c).
         self.traces: list[TraceBuffer] = []
+        # Master trace switch (the tb_init_done analog): single-owner
+        # drivers that consume no ring (sim sweep cells) turn it off so
+        # dispatch events skip the ring entirely.
+        self.trace_enabled = True
         # Optional per-ring staging batches (enable_trace_batching):
         # single-threaded drivers (the sim engine) trade immediate ring
         # visibility for one vectorized write per batch.
@@ -376,16 +380,21 @@ class Partition:
         """
         rounds = 0
         quanta = 0
+        # Hot-loop hoists: bound methods + the executor list are loop
+        # invariants, and a round is ~one dispatched quantum.
+        now_ns = self.clock.now_ns
+        deliver_pending = self.events.deliver_pending
+        executors = self.executors
         while True:
-            if until_ns is not None and self.clock.now_ns() >= until_ns:
+            if until_ns is not None and now_ns() >= until_ns:
                 break
             if max_rounds is not None and rounds >= max_rounds:
                 break
             rounds += 1
-            self.events.deliver_pending()
+            deliver_pending()
             ran_any = False
-            for ex in self.executors:
-                if until_ns is not None and self.clock.now_ns() >= until_ns:
+            for ex in executors:
+                if until_ns is not None and now_ns() >= until_ns:
                     break
                 if ex.schedule_once():
                     ran_any = True
@@ -465,7 +474,7 @@ class Partition:
                 b.flush()
 
     def trace_emit(self, exi: int, event: int, *args: int) -> None:
-        if 0 <= exi < len(self.traces):
+        if self.trace_enabled and 0 <= exi < len(self.traces):
             if self._trace_batches is not None:
                 self._trace_batches[exi].emit(
                     self.clock.now_ns(), event, *args)

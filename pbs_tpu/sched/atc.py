@@ -61,10 +61,13 @@ class AtcJobState:
 class AtcFeedbackPolicy(FeedbackPolicy):
     """Drop-in alternative to FeedbackPolicy with the atc quantum law."""
 
-    def __init__(self, partition, tick_ns: int = 1 * MS):
-        super().__init__(
-            partition, tick_ns=tick_ns, min_us=ATC_MIN_US, max_us=ATC_MAX_US
-        )
+    def __init__(self, partition, tick_ns: int = 1 * MS, **kw):
+        # Tunable passthrough (`pbst tune --policy atc`): the atc band
+        # defaults stand in for the base policy's, everything else
+        # (window, queue-delay knobs) rides the FeedbackPolicy surface.
+        kw.setdefault("min_us", ATC_MIN_US)
+        kw.setdefault("max_us", ATC_MAX_US)
+        super().__init__(partition, tick_ns=tick_ns, **kw)
         self.atc: dict[str, AtcJobState] = {}
 
     def _atc_state(self, job: "Job") -> AtcJobState:

@@ -41,7 +41,7 @@ def clamp_tslice_us(us: int) -> int:
     return max(TSLICE_MIN_US, min(TSLICE_MAX_US, int(us)))
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(slots=True)
 class Decision:
     """What ``do_schedule`` returns: run this context for this long.
 

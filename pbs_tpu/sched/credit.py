@@ -104,23 +104,34 @@ class CreditScheduler(Scheduler):
 
     @staticmethod
     def _cc(ctx) -> CreditCtx:
-        if ctx.sched_priv is None or not isinstance(ctx.sched_priv, CreditCtx):
-            ctx.sched_priv = CreditCtx()
-        return ctx.sched_priv
+        # type-exact fast path: do_schedule touches this for every
+        # queued peer, so the common materialized case must be two
+        # loads and a pointer compare, not an isinstance dispatch.
+        cc = ctx.sched_priv
+        if type(cc) is CreditCtx:
+            return cc
+        cc = ctx.sched_priv = CreditCtx()
+        return cc
 
     @staticmethod
     def _cj(job) -> CreditJob:
-        if job.sched_priv is None or not isinstance(job.sched_priv, CreditJob):
-            job.sched_priv = CreditJob()
-        return job.sched_priv
+        cj = job.sched_priv
+        if type(cj) is CreditJob:
+            return cj
+        cj = job.sched_priv = CreditJob()
+        return cj
 
     def _runq_insert(self, exi: int, ctx) -> None:
         """Insert FIFO within priority class (``__runq_insert``)."""
         cc = self._cc(ctx)
         cc.executor = exi
         q = self.runqs[exi]
+        pri = cc.pri
         i = 0
-        while i < len(q) and self._cc(q[i]).pri >= cc.pri:
+        n = len(q)
+        # Queue members were inserted through this function, so their
+        # sched_priv is always a materialized CreditCtx: read it direct.
+        while i < n and q[i].sched_priv.pri >= pri:
             i += 1
         q.insert(i, ctx)
 
@@ -190,8 +201,12 @@ class CreditScheduler(Scheduler):
 
     def do_schedule(self, ex: "Executor", now_ns: int) -> Decision:
         q = self.runqs[ex.index]
-        ctx = self._pick_local(q)  # peek only: ctx stays queued until picked
-        if ctx is None or self._cc(ctx).pri <= PRI_OVER:
+        ctx = q[0] if q else None  # peek: ctx stays queued until picked
+        # Theft is only possible with a peer runq to steal from — the
+        # single-executor case (every sim sweep cell) must not pay a
+        # scan-and-return-None per OVER-priority dispatch.
+        if (ctx is None or self._cc(ctx).pri <= PRI_OVER) \
+                and len(self.runqs) > 1:
             stolen = self._steal(ex.index, better_than=(
                 self._cc(ctx).pri if ctx is not None else PRI_OVER - 1))
             if stolen is not None:
@@ -208,9 +223,6 @@ class CreditScheduler(Scheduler):
         # at the Decision site: tslice_us may have been written
         # out-of-band (operator store write, restored save record).
         return Decision(ctx, clamp_tslice_us(ctx.job.params.tslice_us) * US)
-
-    def _pick_local(self, q):
-        return q[0] if q else None
 
     def _steal(self, exi: int, better_than: int):
         """csched_runq_steal: take UNDER/BOOST work from a peer runq."""

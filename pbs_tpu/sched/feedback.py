@@ -42,7 +42,7 @@ from typing import TYPE_CHECKING
 
 import numpy as np
 
-from pbs_tpu.telemetry.counters import Counter
+from pbs_tpu.telemetry.counters import NUM_COUNTERS, Counter
 from pbs_tpu.utils.clock import MS, US
 
 if TYPE_CHECKING:
@@ -122,12 +122,29 @@ class FeedbackPolicy:
         window: int = WINDOW,
         stale_after: int = WINDOW,
         fallback_us: int | None = None,
+        grow_step_us: int = GROW_STEP_US,
+        shrink_sub_us: int = SHRINK_SUB_US,
+        qdelay_threshold_ns: int = GW_QDELAY_THRESHOLD_NS,
+        gw_hot_after: int = GW_HOT_AFTER,
     ):
         self.partition = partition
         self.min_us = min_us
         self.max_us = max_us
         self.stall_threshold = stall_threshold
         self.window_len = window
+        # The hand-picked reference constants, now instance knobs so
+        # `pbst tune` (sched/tune.py) can search them and a tuned
+        # profile can install them (docs/TUNE.md). Defaults are the
+        # reference values — an unconfigured policy is bit-identical to
+        # the pre-knob one.
+        self.grow_step_us = int(grow_step_us)
+        self.shrink_sub_us = int(shrink_sub_us)
+        self.qdelay_threshold_ns = int(qdelay_threshold_ns)
+        self.gw_hot_after = int(gw_hot_after)
+        # Metric-tick scratch (one subtraction + one accumulate per
+        # context, zero allocation per tick).
+        self._delta = np.zeros(NUM_COUNTERS, dtype=np.uint64)
+        self._tot = np.zeros(NUM_COUNTERS, dtype=np.uint64)
         #: Degraded mode (docs/FAULTS.md): after ``stale_after``
         #: consecutive dead-counter ticks the policy stops steering and
         #: parks the job's slice at ``fallback_us`` — the boot-param
@@ -147,6 +164,29 @@ class FeedbackPolicy:
             name="csched_metric_tick",
         )
 
+    #: Profile keys `from_profile` accepts — exactly the constructor
+    #: knobs `pbst tune` searches (sched/tune.py SEARCH_SPACE).
+    TUNABLE_PARAMS = (
+        "min_us", "max_us", "window", "stall_threshold",
+        "grow_step_us", "shrink_sub_us", "qdelay_threshold_ns",
+        "gw_hot_after",
+    )
+
+    @classmethod
+    def from_profile(cls, partition: "Partition",
+                     profile: dict) -> "FeedbackPolicy":
+        """Build a policy from a tuned profile document (the
+        ``pbs_tpu/sched/tuned/*.json`` format, docs/TUNE.md): unknown
+        keys are rejected so a stale profile fails loudly instead of
+        silently running reference constants."""
+        params = dict(profile.get("params", profile))
+        unknown = set(params) - set(cls.TUNABLE_PARAMS)
+        if unknown:
+            raise KeyError(
+                f"profile carries unknown policy params "
+                f"{sorted(unknown)}; tunable: {list(cls.TUNABLE_PARAMS)}")
+        return cls(partition, **params)
+
     def state_of(self, job: "Job") -> JobMetricState:
         st = self.states.get(job.name)
         if st is None:
@@ -163,19 +203,31 @@ class FeedbackPolicy:
         st = self.state_of(job)
         st.ticks += 1
         # One ndarray subtraction + in-place baseline refresh per
-        # context (no per-tick .copy() allocation, no per-counter numpy
-        # scalar arithmetic), then a single int() per consumed counter.
-        tot = None
-        for ctx in job.contexts:
-            delta = ctx.counters - ctx.prev_counters
-            ctx.prev_counters[:] = ctx.counters
-            tot = delta if tot is None else tot + delta
-        if tot is None:
+        # context into preallocated scratch (no per-tick allocation at
+        # all), then a single int() per consumed counter.
+        ctxs = job.contexts
+        if not ctxs:
             return
-        steps = int(tot[Counter.STEPS_RETIRED])
-        dev_ns = int(tot[Counter.DEVICE_TIME_NS])
-        stall_ns = int(tot[Counter.HBM_STALL_NS])
-        coll_ns = int(tot[Counter.COLLECTIVE_WAIT_NS])
+        if len(ctxs) == 1:
+            ctx = ctxs[0]
+            tot = np.subtract(ctx.counters, ctx.prev_counters,
+                              out=self._tot)
+            ctx.prev_counters[:] = ctx.counters
+        else:
+            tot = self._tot
+            tot[:] = 0
+            delta = self._delta
+            for ctx in ctxs:
+                np.subtract(ctx.counters, ctx.prev_counters, out=delta)
+                ctx.prev_counters[:] = ctx.counters
+                np.add(tot, delta, out=tot)
+        # One bulk tolist beats four numpy scalar extractions (the
+        # IntEnum __index__ round trip per read adds up at tick rate).
+        tl = tot.tolist()
+        steps = tl[Counter.STEPS_RETIRED]
+        dev_ns = tl[Counter.DEVICE_TIME_NS]
+        stall_ns = tl[Counter.HBM_STALL_NS]
+        coll_ns = tl[Counter.COLLECTIVE_WAIT_NS]
         if steps == 0 and dev_ns == 0:
             return  # job idle this tick — nothing to learn
         if steps > 0 and dev_ns == 0:
@@ -200,7 +252,7 @@ class FeedbackPolicy:
         self._submilli_update(job, st, float(coll_ns), steps)
         # Tick record for the sim trace (pbs_tpu.sim.trace): captures the
         # adaptation decision stream so live runs replay offline.
-        rec = getattr(self.partition, "recorder", None)
+        rec = self.partition.recorder
         if rec is not None:
             rec.on_feedback(self.partition.clock.now_ns(), job, st)
 
@@ -208,8 +260,8 @@ class FeedbackPolicy:
 
     def note_queue_delay(self, job: "Job", wait_ns: int,
                          events: int = 1,
-                         threshold_ns: int = GW_QDELAY_THRESHOLD_NS,
-                         hot_after: int = GW_HOT_AFTER) -> None:
+                         threshold_ns: int | None = None,
+                         hot_after: int | None = None) -> None:
         """Serving-tier contention report from the gateway front door:
         ``wait_ns`` of interactive queue delay over ``events`` requests
         since the last report.
@@ -223,6 +275,10 @@ class FeedbackPolicy:
         latency now, not a window later) and arm wake-boost — the
         BOOST/tslice-shrink signal the gateway's SLO classes lean on.
         """
+        if threshold_ns is None:
+            threshold_ns = self.qdelay_threshold_ns
+        if hot_after is None:
+            hot_after = self.gw_hot_after
         job.report_contention(int(wait_ns), int(events))
         st = self.state_of(job)
         st.gw_reports += 1
@@ -270,8 +326,17 @@ class FeedbackPolicy:
 
         mean = float(w.sum()) / self.window_len
         if mean > 0:
-            stable = bool(np.all((w >= STABLE_LO * mean)
-                                 & (w <= STABLE_HI * mean)))
+            # Tiny fixed-size window: a short Python loop over exact
+            # float64 values beats three numpy broadcast kernels at
+            # this size (same comparisons, same result, metric tick
+            # runs every virtual millisecond for every job).
+            lo = STABLE_LO * mean
+            hi = STABLE_HI * mean
+            stable = True
+            for x in w.tolist():
+                if x < lo or x > hi:
+                    stable = False
+                    break
         else:
             stable = True  # no contention at all is maximally stable
 
@@ -299,7 +364,7 @@ class FeedbackPolicy:
         return max(self.min_us, min(self.max_us, us))
 
     def _grow(self, job: "Job", st: JobMetricState) -> None:
-        new = self._clamp(job.params.tslice_us + GROW_STEP_US)
+        new = self._clamp(job.params.tslice_us + self.grow_step_us)
         if new != job.params.tslice_us:
             st.grows += 1
         job.params.tslice_us = new
@@ -307,7 +372,7 @@ class FeedbackPolicy:
     def _shrink(self, job: "Job", st: JobMetricState) -> None:
         cur = job.params.tslice_us
         third = cur // 3
-        new = third if third >= self.min_us else cur - SHRINK_SUB_US
+        new = third if third >= self.min_us else cur - self.shrink_sub_us
         # Both arms need the full clamp: a slice pushed above the cap
         # out-of-band (operator sched-credit -t, restore from an old
         # save) has cur//3 possibly still above max_us, so the old

@@ -3,17 +3,28 @@
 Runs the *real* ``pbs_tpu.sched`` policies against synthetic or recorded
 workloads on a virtual clock: ``engine`` (event core + policy probes),
 ``workload`` (tenant generator catalog), ``trace`` (JSONL record/replay),
-``harness`` (policy regression comparisons). See docs/SIM.md.
+``harness`` (policy regression comparisons), ``sweep`` (shared-nothing
+parallel grid fan-out — the `pbst tune` substrate). See docs/SIM.md and
+docs/TUNE.md.
 """
 
 from pbs_tpu.sim.engine import (
     POLICIES,
+    ListSchedulerProbe,
     SchedulerProbe,
     SimEngine,
     jain_index,
     policy_names,
 )
 from pbs_tpu.sim.harness import DEFAULT_POLICIES, compare, format_report, run_policy
+from pbs_tpu.sim.sweep import (
+    SweepCell,
+    build_grid,
+    cell_seed,
+    run_cell,
+    sweep,
+    sweep_digest,
+)
 from pbs_tpu.sim.trace import (
     ReplayBackend,
     ReplayError,
@@ -33,7 +44,14 @@ from pbs_tpu.sim.workload import (
 
 __all__ = [
     "POLICIES",
+    "ListSchedulerProbe",
     "SchedulerProbe",
+    "SweepCell",
+    "build_grid",
+    "cell_seed",
+    "run_cell",
+    "sweep",
+    "sweep_digest",
     "SimEngine",
     "jain_index",
     "policy_names",

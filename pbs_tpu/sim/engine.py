@@ -18,6 +18,23 @@ substrate into an offline policy-evaluation instrument:
 - **Recording** — a ``sim.trace.TraceRecorder`` hooked into the
   partition so every run yields a canonical JSONL trace and a stable
   digest: two runs with equal (workload, policy, seed) are byte-equal.
+
+Two probe implementations share one accessor contract (docs/SIM.md
+"Sweep + sustained throughput"):
+
+- :class:`SchedulerProbe` — the production accumulator: preallocated
+  grow-by-doubling numpy arrays, zero per-dispatch Python object
+  allocation (the sweep fast path; ``pbst perf`` gates it via
+  ``sim.sustained``).
+- :class:`ListSchedulerProbe` — the original list-append reference
+  implementation, kept as the equivalence witness: the property test in
+  ``tests/test_probe_equivalence.py`` pins bit-identical metrics
+  reports and trace digests across the workload catalog.
+
+``record=False`` (the sweep mode) skips the trace recorder, the obs
+trace ring, the telemetry-ledger mirror, and the probe's
+quantum-timeline accounting — a sweep cell pays for scheduling, not for
+observability nobody reads.
 """
 
 from __future__ import annotations
@@ -27,7 +44,7 @@ from typing import Any
 
 import numpy as np
 
-from pbs_tpu.runtime.job import Job
+from pbs_tpu.runtime.job import ContextState, Job
 from pbs_tpu.runtime.partition import Partition
 from pbs_tpu.sched.atc import AtcFeedbackPolicy
 from pbs_tpu.sched.base import Decision, scheduler_names
@@ -37,6 +54,7 @@ from pbs_tpu.sim.workload import TenantSpec, build_workload
 from pbs_tpu.telemetry.counters import Counter
 from pbs_tpu.telemetry.source import SimBackend
 from pbs_tpu.utils.clock import SEC, VirtualClock
+from pbs_tpu.utils.stats import nearest_rank_sorted
 
 #: policy name -> (scheduler registry name, adaptive-quantum policy class)
 POLICIES: dict[str, tuple[str, type | None]] = {
@@ -63,15 +81,41 @@ def resolve_policy(policy: str) -> tuple[str, type | None]:
         f"unknown policy {policy!r}; available: {policy_names()}")
 
 
-@dataclasses.dataclass
-class TenantStats:
-    """Per-tenant observations accumulated by the probe."""
+class _NullSampler:
+    """Overflow-sampler stand-in for sweep cells: the sim arms no
+    i-mode thresholds, so the per-quantum ``check`` is pure overhead.
+    Every other sampler call degrades to the real one (arming through
+    it un-nulls nothing — sweeps must not arm samplers)."""
 
-    waits: list[tuple[int, int]] = dataclasses.field(default_factory=list)
-    dispatches: int = 0
-    # (t_ns, quantum_us) appended only on change — the adaptation timeline.
-    quantum_timeline: list[tuple[int, int]] = dataclasses.field(
-        default_factory=list)
+    __slots__ = ("_inner",)
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def check(self, ctx) -> None:
+        pass
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class _TenantAcc:
+    """Per-tenant numpy accumulator: wait samples, dispatch count and
+    the quantum-change timeline on preallocated grow-by-doubling
+    arrays. Growth happens outside the dispatch edge (amortized O(1));
+    the dispatch edge itself is two scalar stores and an index bump."""
+
+    __slots__ = ("t", "w", "n", "dispatches", "qt", "qq", "qn", "last_q")
+
+    def __init__(self, cap: int = 256):
+        self.t = np.empty(cap, dtype=np.int64)  # dispatch timestamps
+        self.w = np.empty(cap, dtype=np.int64)  # wait sample per dispatch
+        self.n = 0
+        self.dispatches = 0
+        self.qt = np.empty(16, dtype=np.int64)  # quantum change-points
+        self.qq = np.empty(16, dtype=np.int64)
+        self.qn = 0
+        self.last_q = -1
 
 
 class SchedulerProbe:
@@ -82,15 +126,154 @@ class SchedulerProbe:
     run-state edges the metrics need: wake/requeue (enqueue timestamp),
     pick (wait sample + dispatch count + quantum timeline), deschedule
     (requeue timestamp). The wait each context experienced also lands in
-    its ``RUNQ_WAIT_NS`` counter, so waits show up in ledgers, dumps and
-    recorded traces like any other telemetry.
+    its ``RUNQ_WAIT_NS`` counter (accumulated as a plain int per
+    dispatch, published by :meth:`flush_counters` before metrics are
+    read), so waits show up in reports and recorded traces like any
+    other telemetry.
+
+    ``timeline=False`` (sweep mode) skips the quantum-timeline
+    accounting entirely — the adaptation change-points are a debugging
+    surface, not a sweep score input.
     """
 
-    def __init__(self, inner, clock):
-        # Bypass __setattr__-free plain attrs; keep names private enough
-        # not to shadow anything on the inner scheduler.
+    def __init__(self, inner, clock, timeline: bool = True):
         self.inner = inner
         self.clock = clock
+        self.switches = 0
+        self.timeline = timeline
+        self._acc: dict[str, _TenantAcc] = {}
+        self._enqueued: dict[Any, int] = {}
+        self._last_pick: dict[int, Any] = {}
+        self._wait: dict[Any, int] = {}  # ctx -> pending RUNQ_WAIT_NS
+
+    def _acc_of(self, job_name: str) -> _TenantAcc:
+        a = self._acc.get(job_name)
+        if a is None:
+            a = self._acc[job_name] = _TenantAcc()
+        return a
+
+    @staticmethod
+    def _grow(a: _TenantAcc) -> None:
+        cap = a.t.shape[0] * 2
+        for name in ("t", "w"):
+            arr = np.empty(cap, dtype=np.int64)
+            arr[:a.n] = getattr(a, name)[:a.n]
+            setattr(a, name, arr)
+
+    @staticmethod
+    def _grow_qt(a: _TenantAcc) -> None:
+        cap = a.qt.shape[0] * 2
+        for name in ("qt", "qq"):
+            arr = np.empty(cap, dtype=np.int64)
+            arr[:a.qn] = getattr(a, name)[:a.qn]
+            setattr(a, name, arr)
+
+    # -- instrumented edges ---------------------------------------------
+
+    def wake(self, ctx) -> None:
+        self._enqueued.setdefault(ctx, self.clock.now_ns())
+        self.inner.wake(ctx)
+
+    def sleep(self, ctx) -> None:
+        self._enqueued.pop(ctx, None)
+        self.inner.sleep(ctx)
+
+    def do_schedule(self, ex, now_ns: int) -> Decision:
+        d = self.inner.do_schedule(ex, now_ns)
+        ctx = d.ctx
+        if ctx is not None:
+            wait = now_ns - self._enqueued.pop(ctx, now_ns)
+            if wait < 0:
+                wait = 0
+            if wait:  # zero adds nothing to the counter: skip the dict
+                wa = self._wait
+                wa[ctx] = wa.get(ctx, 0) + wait
+            a = self._acc.get(ctx.job.name)
+            if a is None:
+                a = self._acc_of(ctx.job.name)
+            n = a.n
+            if n == a.t.shape[0]:
+                self._grow(a)
+            a.t[n] = now_ns
+            a.w[n] = wait
+            a.n = n + 1
+            a.dispatches += 1
+            if self.timeline:
+                q_us = int(d.quantum_ns) // 1000
+                if q_us != a.last_q:
+                    m = a.qn
+                    if m == a.qt.shape[0]:
+                        self._grow_qt(a)
+                    a.qt[m] = now_ns
+                    a.qq[m] = q_us
+                    a.qn = m + 1
+                    a.last_q = q_us
+            lp = self._last_pick
+            if lp.get(ex.index) is not ctx:
+                self.switches += 1
+                lp[ex.index] = ctx
+        return d
+
+    def descheduled(self, ex, ctx, ran_ns: int, now_ns: int) -> None:
+        self.inner.descheduled(ex, ctx, ran_ns, now_ns)
+        if ctx.state is ContextState.RUNNABLE or \
+                ctx.state is ContextState.RUNNING:
+            self._enqueued[ctx] = now_ns
+
+    # -- metrics accessors (shared with ListSchedulerProbe) --------------
+
+    def flush_counters(self) -> None:
+        """Publish the deferred per-context wait sums into the
+        ``RUNQ_WAIT_NS`` counters (one numpy add per context instead of
+        one per dispatch). Call before reading context counters."""
+        for ctx, w in self._wait.items():
+            ctx.counters[Counter.RUNQ_WAIT_NS] += np.uint64(w)
+        self._wait.clear()
+
+    def wait_arrays(self, job_name: str) -> tuple[np.ndarray, np.ndarray]:
+        a = self._acc.get(job_name)
+        if a is None:
+            z = np.empty(0, dtype=np.int64)
+            return z, z
+        return a.t[:a.n], a.w[:a.n]
+
+    def dispatches_of(self, job_name: str) -> int:
+        a = self._acc.get(job_name)
+        return a.dispatches if a is not None else 0
+
+    def timeline_of(self, job_name: str) -> list[tuple[int, int]]:
+        a = self._acc.get(job_name)
+        if a is None:
+            return []
+        return list(zip(a.qt[:a.qn].tolist(), a.qq[:a.qn].tolist()))
+
+    # -- everything else is the real scheduler --------------------------
+
+    def __getattr__(self, name: str):
+        return getattr(self.inner, name)
+
+
+@dataclasses.dataclass
+class TenantStats:
+    """Per-tenant observations accumulated by the reference probe."""
+
+    waits: list[tuple[int, int]] = dataclasses.field(default_factory=list)
+    dispatches: int = 0
+    # (t_ns, quantum_us) appended only on change — the adaptation timeline.
+    quantum_timeline: list[tuple[int, int]] = dataclasses.field(
+        default_factory=list)
+
+
+class ListSchedulerProbe:
+    """The original list-append probe, kept as the equivalence witness
+    for :class:`SchedulerProbe` (tests/test_probe_equivalence.py): same
+    instrumented edges, per-dispatch Python-object accumulation. Do not
+    use for sweeps — this is the slow path the numpy probe replaced."""
+
+    def __init__(self, inner, clock, timeline: bool = True):
+        self.inner = inner
+        self.clock = clock
+        self.timeline = timeline
         self.stats: dict[str, TenantStats] = {}
         self.switches = 0
         self._enqueued: dict[Any, int] = {}
@@ -101,8 +284,6 @@ class SchedulerProbe:
         if st is None:
             st = self.stats[job_name] = TenantStats()
         return st
-
-    # -- instrumented edges ---------------------------------------------
 
     def wake(self, ctx) -> None:
         self._enqueued.setdefault(ctx, self.clock.now_ns())
@@ -119,11 +300,13 @@ class SchedulerProbe:
             wait = max(0, now_ns - self._enqueued.pop(ctx, now_ns))
             ctx.counters[Counter.RUNQ_WAIT_NS] += np.uint64(wait)
             st = self._stats(ctx.job.name)
-            st.waits.append((now_ns, wait))
+            st.waits.append((now_ns, wait))  # pbst: ignore[perf-dispatch-alloc] -- reference equivalence witness, deliberately list-based
             st.dispatches += 1
-            q_us = int(d.quantum_ns) // 1000
-            if not st.quantum_timeline or st.quantum_timeline[-1][1] != q_us:
-                st.quantum_timeline.append((now_ns, q_us))
+            if self.timeline:
+                q_us = int(d.quantum_ns) // 1000
+                if not st.quantum_timeline or \
+                        st.quantum_timeline[-1][1] != q_us:
+                    st.quantum_timeline.append((now_ns, q_us))  # pbst: ignore[perf-dispatch-alloc] -- reference equivalence witness, deliberately list-based
             if self._last_pick.get(ex.index) is not ctx:
                 self.switches += 1
             self._last_pick[ex.index] = ctx
@@ -134,7 +317,26 @@ class SchedulerProbe:
         if ctx.runnable():
             self._enqueued[ctx] = now_ns
 
-    # -- everything else is the real scheduler --------------------------
+    # -- metrics accessors (the SchedulerProbe contract) -----------------
+
+    def flush_counters(self) -> None:
+        pass  # counters were updated per dispatch
+
+    def wait_arrays(self, job_name: str) -> tuple[np.ndarray, np.ndarray]:
+        st = self.stats.get(job_name)
+        if st is None or not st.waits:
+            z = np.empty(0, dtype=np.int64)
+            return z, z
+        arr = np.asarray(st.waits, dtype=np.int64)
+        return arr[:, 0], arr[:, 1]
+
+    def dispatches_of(self, job_name: str) -> int:
+        st = self.stats.get(job_name)
+        return st.dispatches if st is not None else 0
+
+    def timeline_of(self, job_name: str) -> list[tuple[int, int]]:
+        st = self.stats.get(job_name)
+        return list(st.quantum_timeline) if st is not None else []
 
     def __getattr__(self, name: str):
         return getattr(self.inner, name)
@@ -155,6 +357,8 @@ class SimEngine:
         record: bool = True,
         keep_lines: bool = True,
         warmup_frac: float = 0.1,
+        policy_params: dict | None = None,
+        probe_cls: type | None = None,
     ):
         self.workload = workload
         self.policy = policy
@@ -162,21 +366,36 @@ class SimEngine:
         self.horizon_ns = int(horizon_ns)
         self.warmup_frac = float(warmup_frac)
         sched_name, policy_cls = resolve_policy(policy)
+        if policy_params and policy_cls is None:
+            raise KeyError(
+                f"policy {policy!r} takes no policy_params (only the "
+                f"adaptive composites do: "
+                f"{sorted(n for n, (_, c) in POLICIES.items() if c)})")
 
+        recording = bool(record or trace_path)
         self.clock = VirtualClock()
         self.backend = SimBackend(self.clock, seed=self.seed)
         self.partition = Partition(
             f"sim-{workload}", source=self.backend, scheduler=sched_name,
             n_executors=n_executors)
-        # The engine owns every producer on one thread under virtual
-        # time, so dispatch events stage through EmitBatch: one
-        # vectorized ring write per watermark instead of two scalar
-        # emits per quantum (watermarks key on record timestamps, so
-        # batching is as deterministic as the run itself).
-        self.partition.enable_trace_batching()
-        self.probe = SchedulerProbe(self.partition.scheduler, self.clock)
+        if recording:
+            # The engine owns every producer on one thread under virtual
+            # time, so dispatch events stage through EmitBatch: one
+            # vectorized ring write per watermark instead of two scalar
+            # emits per quantum (watermarks key on record timestamps, so
+            # batching is as deterministic as the run itself).
+            self.partition.enable_trace_batching()
+        else:
+            # Sweep mode: nothing consumes the obs ring, so dispatch
+            # events skip it entirely, and the overflow sampler (which
+            # the sim never arms) drops out of the quantum boundary
+            # (docs/SIM.md "Sweep + sustained throughput").
+            self.partition.trace_enabled = False
+            self.partition.sampler = _NullSampler(self.partition.sampler)
+        self.probe = (probe_cls or SchedulerProbe)(
+            self.partition.scheduler, self.clock, timeline=recording)
         self.partition.scheduler = self.probe
-        self.feedback = (policy_cls(self.partition)
+        self.feedback = (policy_cls(self.partition, **(policy_params or {}))
                          if policy_cls is not None else None)
 
         self.specs: list[TenantSpec] = build_workload(
@@ -194,9 +413,18 @@ class SimEngine:
             self.jobs.append(job)
             if spec.arrival:
                 self._arm_arrivals(job, spec.arrival)
+        if not recording:
+            # Sweep mode: detach the telemetry-ledger mirror too — the
+            # report reads context counters directly, no monitor ever
+            # attaches to a sweep cell's throwaway heap ledger, and the
+            # per-quantum resume/suspend seqlock writes are the single
+            # largest observability cost left on the dispatch path.
+            for job in self.jobs:
+                for ctx in job.contexts:
+                    ctx.ledger_slot = -1
 
         self.recorder: TraceRecorder | None = None
-        if record or trace_path:
+        if recording:
             self.recorder = TraceRecorder(trace_path, keep_lines=keep_lines)
             self.recorder.meta(
                 workload=workload, policy=policy, seed=self.seed,
@@ -245,15 +473,21 @@ class SimEngine:
 
     def _gather(self) -> dict:
         warmup_at = self._start_ns + int(self.warmup_frac * self.horizon_ns)
+        self.probe.flush_counters()
         tenants: dict[str, dict] = {}
         device_ns: list[int] = []
-        all_waits: list[int] = []
+        per_tenant_waits: list[np.ndarray] = []
         for job in self.jobs:
             dev = sum(int(c.counters[Counter.DEVICE_TIME_NS])
                       for c in job.contexts)
-            st = self.probe.stats.get(job.name, TenantStats())
-            waits = [w for (t, w) in st.waits if t >= warmup_at]
-            all_waits.extend(waits)
+            # One masked slice + one sort per tenant: every quantile
+            # below reads the same sorted array (nearest-rank, the
+            # estimator every latency surface in the tree reports —
+            # utils/stats.py).
+            t_arr, w_arr = self.probe.wait_arrays(job.name)
+            waits = np.sort(w_arr[t_arr >= warmup_at]) if t_arr.size \
+                else w_arr
+            per_tenant_waits.append(waits)
             device_ns.append(dev)
             tenants[job.name] = {
                 "device_ns": dev,
@@ -266,13 +500,15 @@ class SimEngine:
                 "runq_wait_ns": sum(int(c.counters[Counter.RUNQ_WAIT_NS])
                                     for c in job.contexts),
                 "sched_count": sum(c.sched_count for c in job.contexts),
-                "dispatches": st.dispatches,
-                "wait_p99_us": _pct_us(waits, 99),
+                "dispatches": self.probe.dispatches_of(job.name),
+                "wait_p99_us": _pct_us_sorted(waits, 0.99),
                 "tslice_us": job.params.tslice_us,
                 "quantum_timeline_us": [
-                    [int(t - self._start_ns), q]
-                    for t, q in st.quantum_timeline],
+                    [int(t - self._start_ns), int(q)]
+                    for t, q in self.probe.timeline_of(job.name)],
             }
+        all_waits = np.sort(np.concatenate(per_tenant_waits)) \
+            if per_tenant_waits else np.empty(0, dtype=np.int64)
         busy = sum(device_ns)
         elapsed = self.elapsed_ns()
         n_ex = len(self.partition.executors)
@@ -288,8 +524,8 @@ class SimEngine:
                           for ex in self.partition.executors),
             "switches": self.probe.switches,
             "jain_fairness": round(jain_index(device_ns), 4),
-            "wait_p50_us": _pct_us(all_waits, 50),
-            "wait_p99_us": _pct_us(all_waits, 99),
+            "wait_p50_us": _pct_us_sorted(all_waits, 0.50),
+            "wait_p99_us": _pct_us_sorted(all_waits, 0.99),
             "tenants": tenants,
         }
         if self.feedback is not None:
@@ -313,7 +549,14 @@ def jain_index(xs: list[int]) -> float:
     return (s * s) / (len(xs) * sq)
 
 
-def _pct_us(waits_ns: list[int], pct: float) -> float:
-    if not waits_ns:
+def _pct_us_sorted(sorted_waits_ns, q: float) -> float:
+    """Nearest-rank percentile of a SORTED wait array, in µs.
+
+    Nearest-rank (not ``np.percentile``'s linear interpolation) so the
+    sim's quantiles are the same estimator the gateway/histogram SLO
+    surfaces report (``utils/stats.nearest_rank``): a sim-tuned
+    threshold and a gateway SLO report now speak the same quantile.
+    """
+    if len(sorted_waits_ns) == 0:
         return 0.0
-    return round(float(np.percentile(np.asarray(waits_ns), pct)) / 1000.0, 1)
+    return round(nearest_rank_sorted(sorted_waits_ns, q) / 1000.0, 1)

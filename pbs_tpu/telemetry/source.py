@@ -53,6 +53,16 @@ _STALLABLE = (Counter.DEVICE_TIME_NS, Counter.HBM_BYTES,
 #: noise" premise) — rate inputs only, never progress.
 _SPIKABLE = (Counter.HBM_STALL_NS, Counter.COLLECTIVE_WAIT_NS)
 
+# Plain-int counter indices for the quantum hot loop: indexing numpy
+# with an IntEnum pays an __index__ round trip per store.
+_I_DEV = int(Counter.DEVICE_TIME_NS)
+_I_HBM = int(Counter.HBM_BYTES)
+_I_STALL = int(Counter.HBM_STALL_NS)
+_I_COLL = int(Counter.COLLECTIVE_WAIT_NS)
+_I_FLOPS = int(Counter.DEVICE_FLOPS)
+_I_STEPS = int(Counter.STEPS_RETIRED)
+_I_TOKENS = int(Counter.TOKENS)
+
 
 def apply_counter_faults(job_name: str, deltas: np.ndarray) -> np.ndarray:
     """``telemetry.counters`` injection seam (stream key = job name),
@@ -155,6 +165,10 @@ class SimBackend:
         self._rngs: dict[str, np.random.Generator] = {}
         self._profiles: dict[str, SimProfile] = {}
         self._steps_done: dict[str, int] = {}
+        # Single-infinite-phase profiles (most of the sim catalog)
+        # resolved once at register time: the quantum hot loop then
+        # skips the per-step phase_at() schedule walk.
+        self._steady: dict[str, SimPhase | None] = {}
 
     def _rng_for(self, job_name: str) -> np.random.Generator:
         rng = self._rngs.get(job_name)
@@ -176,6 +190,9 @@ class SimBackend:
     def register(self, job_name: str, profile: SimProfile) -> None:
         self._profiles[job_name] = profile
         self._steps_done[job_name] = 0  # fresh phase schedule per register
+        phases = profile.phases
+        self._steady[job_name] = (
+            phases[0] if len(phases) == 1 and phases[0].steps < 0 else None)
 
     def seek(self, job_name: str, steps_done: int) -> None:
         """Reposition the phase schedule — migration restore lands a job
@@ -202,18 +219,119 @@ class SimBackend:
         return t
 
     def execute(self, ctx: Any, n_steps: int) -> np.ndarray:
+        # The quantum hot loop (pbst perf: sim.smoke / sim.sustained):
+        # accumulate in plain Python ints and store each counter ONCE
+        # per quantum instead of paying a numpy scalar read-modify-write
+        # per counter per step. RNG draw order (step-time draw, then
+        # collective draw iff wait>0 — exactly _jittered's skip rule)
+        # and all integer rounding match _charge_phase bit-for-bit, so
+        # trace digests and golden chaos digests are unchanged.
         name = ctx.job.name
-        prof = self._profiles[name]
-        rng = self._rng_for(name)
+        rng = self._rngs.get(name)
+        if rng is None:
+            rng = self._rng_for(name)
+        random = rng.random
+        step = self._steps_done[name]
+        steady = self._steady[name]
+        t_tot = hbm = stall = coll = flops = tokens = 0
+        if steady is not None:
+            # Steady single-phase tenant (most of the catalog): phase
+            # fields resolve to locals once per quantum, and the
+            # per-step loop specializes on (jitter, collective) so it
+            # draws exactly the randoms _jittered would — stream and
+            # rounding identical to the general path below.
+            base = steady.step_time_ns
+            if base < 1:
+                base = 1
+            jit = steady.jitter
+            frac = steady.stall_frac
+            cw = steady.collective_wait_ns
+            hbm = steady.hbm_bytes * n_steps
+            flops = steady.flops * n_steps
+            tokens = steady.tokens * n_steps
+            if jit > 0.0:
+                if n_steps >= 8:
+                    # Long quantum: one batched draw + vectorized
+                    # jitter. Generator.random(n) consumes the exact
+                    # bit stream of n scalar random() calls (pinned by
+                    # tests/test_sim_trace.py digests), and every
+                    # float64 op below mirrors the scalar expression
+                    # tree, so totals are bit-identical.
+                    if cw > 0:
+                        r = random(2 * n_steps)
+                        rt, rc = r[0::2], r[1::2]
+                    else:
+                        rt, rc = random(n_steps), None
+                    t = (base * (1.0 + jit * (2.0 * rt - 1.0))) \
+                        .astype(np.int64)
+                    np.maximum(t, 1, out=t)
+                    t_tot = int(t.sum())
+                    stall = int((t * frac).astype(np.int64).sum())
+                    if rc is not None:
+                        c = (cw * (1.0 + jit * (2.0 * rc - 1.0))) \
+                            .astype(np.int64)
+                        np.maximum(c, 1, out=c)
+                        coll = int(c.sum())
+                elif cw > 0:
+                    for _ in range(n_steps):
+                        t = int(base * (1.0 + jit * (2.0 * random() - 1.0)))
+                        if t < 1:
+                            t = 1
+                        c = int(cw * (1.0 + jit * (2.0 * random() - 1.0)))
+                        if c < 1:
+                            c = 1
+                        t_tot += t
+                        stall += int(t * frac)
+                        coll += c
+                else:
+                    for _ in range(n_steps):
+                        t = int(base * (1.0 + jit * (2.0 * random() - 1.0)))
+                        if t < 1:
+                            t = 1
+                        t_tot += t
+                        stall += int(t * frac)
+            else:
+                t_tot = base * n_steps
+                stall = int(base * frac) * n_steps
+                coll = cw * n_steps
+            step += n_steps
+        else:
+            prof = self._profiles[name]
+            for _ in range(n_steps):
+                ph = prof.phase_at(step)
+                jit = ph.jitter
+                t = ph.step_time_ns
+                if t < 1:
+                    t = 1
+                if jit > 0.0:
+                    t = int(t * (1.0 + jit * (2.0 * random() - 1.0)))
+                    if t < 1:
+                        t = 1
+                c = ph.collective_wait_ns
+                if c > 0 and jit > 0.0:
+                    c = int(c * (1.0 + jit * (2.0 * random() - 1.0)))
+                    if c < 1:
+                        c = 1
+                t_tot += t
+                hbm += ph.hbm_bytes
+                stall += int(t * ph.stall_frac)
+                coll += c
+                flops += ph.flops
+                tokens += ph.tokens
+                step += 1
+        self._steps_done[name] = step
+        self.clock.advance(t_tot)
         deltas = np.zeros(NUM_COUNTERS, dtype=np.uint64)
-        for _ in range(n_steps):
-            step = self._steps_done[name]
-            ph = prof.phase_at(step)
-            self._charge_phase(deltas, ph, 1, rng)
-            deltas[Counter.STEPS_RETIRED] += 1
-            deltas[Counter.TOKENS] += ph.tokens
-            self._steps_done[name] = step + 1
-        return apply_counter_faults(name, deltas)
+        deltas[_I_DEV] = t_tot
+        deltas[_I_HBM] = hbm
+        deltas[_I_STALL] = stall
+        deltas[_I_COLL] = coll
+        deltas[_I_FLOPS] = flops
+        deltas[_I_STEPS] = n_steps
+        deltas[_I_TOKENS] = tokens
+        if _faults._active is not None:
+            return apply_counter_faults(name, deltas)
+        return deltas
 
     def execute_micro(self, ctx: Any, n_micro: int) -> np.ndarray:
         """Micro-step execution: each unit burns 1/K of the phase's step

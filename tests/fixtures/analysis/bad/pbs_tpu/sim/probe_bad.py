@@ -1,0 +1,32 @@
+"""Seeded sim-dispatch allocation violations (perf-dispatch-alloc)."""
+
+
+class ProbeStats:
+    def __init__(self):
+        self.waits = []
+        self.dispatches = 0
+
+
+class ListProbe:
+    """The pre-rewrite accumulation idiom: Python containers grown
+    once per dispatched quantum."""
+
+    def __init__(self, inner, clock):
+        self.inner = inner
+        self.clock = clock
+        self.stats = {}
+        self.last = None
+        self.pending = None
+
+    def do_schedule(self, ex, now_ns):
+        d = self.inner.do_schedule(ex, now_ns)
+        if d.ctx is not None:
+            st = self.stats.setdefault(d.ctx.job.name, ProbeStats())
+            st.waits.append((now_ns, now_ns))
+            st.dispatches += 1
+            self.last = {"ctx": d.ctx, "t": now_ns}
+        return d
+
+    def wake(self, ctx):
+        self.pending = [ctx]
+        self.inner.wake(ctx)

@@ -49,7 +49,8 @@ def test_all_rules_fire_on_bad_tree():
         "counter-raw-cache", "counter-raw-threshold",
         "net-raw-socket", "net-raw-transport",
         "gw-direct-submit", "gw-direct-dispatch", "gw-lease-bypass",
-        "perf-rec-loop", "perf-emit-in-loop", "perf-native-unchecked",
+        "perf-rec-loop", "perf-emit-in-loop", "perf-dispatch-alloc",
+        "perf-native-unchecked",
         "obs-unclosed-span", "obs-span-emit-in-loop", "obs-hist-scan",
     }
 

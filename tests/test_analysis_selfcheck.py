@@ -34,11 +34,21 @@ def test_repo_tree_is_clean(tree_result):
     assert r.findings == [], "\n" + format_human(r)
     # Suppressions on the live tree must all carry justifications (the
     # parser enforces it) — surface them here so review sees the list
-    # grow. Currently none are needed.
-    assert r.suppressed == []
+    # grow. The only two: the list-based reference probe kept as the
+    # numpy probe's equivalence witness (sim/engine.py).
+    assert [(fi.check, j) for fi, j in r.suppressed] == [
+        ("perf-dispatch-alloc",
+         "reference equivalence witness, deliberately list-based"),
+        ("perf-dispatch-alloc",
+         "reference equivalence witness, deliberately list-based"),
+    ]
 
 
 def test_cli_selfcheck_json_exit_zero(capsys):
     assert main(["check", PKG, "--format", "json"]) == 0
     d = json.loads(capsys.readouterr().out)
-    assert d["findings"] == [] and d["suppressed"] == []
+    assert d["findings"] == []
+    # The reference-probe suppressions (see test_repo_tree_is_clean).
+    assert [(s["check"], s["justification"]) for s in d["suppressed"]] \
+        == [("perf-dispatch-alloc",
+             "reference equivalence witness, deliberately list-based")] * 2

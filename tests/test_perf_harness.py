@@ -34,6 +34,7 @@ def test_bench_registry_names():
     assert {"trace.emit", "trace.emit_many", "trace.consume",
             "span.emit", "hist.record", "hist.record_many",
             "ledger.snapshot_many", "fairqueue.cycle", "sim.smoke",
+            "sim.sustained", "sweep.cell",
             "rpc.roundtrip"} == set(bench_names())
     # The native matrix is the substrate subset: every native bench
     # exists in the python registry too (dual-mode, same measurement).

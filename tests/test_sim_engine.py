@@ -43,8 +43,10 @@ def test_digest_deterministic_across_runs():
 
 
 def test_wait_metrics_and_switch_counts():
+    # record=True: the full-observability mode keeps the adaptation
+    # timeline (sweep mode deliberately skips it — see below).
     r = SimEngine(workload="contended", policy="credit", seed=1,
-                  n_tenants=3, horizon_ns=100 * MS, record=False).run()
+                  n_tenants=3, horizon_ns=100 * MS).run()
     assert r["switches"] > 0
     assert r["quanta"] >= r["switches"]
     assert r["wait_p99_us"] >= r["wait_p50_us"] > 0
@@ -54,6 +56,31 @@ def test_wait_metrics_and_switch_counts():
         assert t["runq_wait_ns"] > 0
         assert t["dispatches"] > 0
         assert t["quantum_timeline_us"]
+
+
+def test_record_false_skips_observability_but_not_metrics():
+    """The sweep fast path (docs/SIM.md): record=False must skip the
+    recorder, the obs trace ring, the ledger mirror AND the probe's
+    quantum timeline — while every score metric stays populated and
+    identical to the recording run's."""
+    fast = SimEngine(workload="contended", policy="feedback", seed=1,
+                     n_tenants=3, horizon_ns=100 * MS, record=False)
+    r = fast.run()
+    assert "trace_digest" not in r
+    assert not fast.partition.trace_enabled
+    assert fast.partition.drain_traces().shape[0] == 0  # ring never fed
+    for t in r["tenants"].values():
+        assert t["quantum_timeline_us"] == []
+        assert t["runq_wait_ns"] > 0
+    slow = SimEngine(workload="contended", policy="feedback", seed=1,
+                     n_tenants=3, horizon_ns=100 * MS, record=True).run()
+    # Same decisions, same metrics: strip the observability-only fields
+    # and the reports must be equal.
+    slow.pop("trace_digest"), slow.pop("trace_records")
+    for rep in (r, slow):
+        for t in rep["tenants"].values():
+            t.pop("quantum_timeline_us")
+    assert r == slow
 
 
 def test_serving_arrivals_sleep_and_wake():

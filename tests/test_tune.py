@@ -1,0 +1,175 @@
+"""``pbst tune`` (pbs_tpu.sched.tune): successive halving, tuned
+profiles, and the CI gate — the checked-in profiles' score digests
+must reproduce deterministically, and loading an emitted profile must
+reproduce its tuned score exactly."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from pbs_tpu.cli.pbst import main
+from pbs_tpu.sched import tune
+from pbs_tpu.sched.feedback import FeedbackPolicy
+
+
+def test_score_orders_sanely():
+    base = {"jain_fairness": 0.9, "wait_p99_us": 1000.0,
+            "switches_per_s": 3000.0}
+    assert tune.score_cell({**base, "jain_fairness": 0.95}) > \
+        tune.score_cell(base)
+    assert tune.score_cell({**base, "wait_p99_us": 4000.0}) < \
+        tune.score_cell(base)
+    assert tune.score_cell({**base, "switches_per_s": 30000.0}) < \
+        tune.score_cell(base)
+
+
+def test_search_space_leads_with_reference_constants():
+    # Position tie-breaking parks inert axes on the reference values —
+    # which only works if the first config IS the reference config.
+    first = tune.SEARCH_SPACE["feedback"][0]
+    assert first == {"min_us": 100, "max_us": 1_100, "window": 5,
+                     "grow_step_us": 100,
+                     "qdelay_threshold_ns": 2_000_000, "gw_hot_after": 3}
+
+
+def test_quick_halving_is_deterministic():
+    kw = dict(configs=tune.QUICK_SPACE["feedback"],
+              rungs=tune.QUICK_RUNGS)
+    a = tune.successive_halving("contended", "feedback", **kw)
+    b = tune.successive_halving("contended", "feedback", **kw)
+    assert a == b
+    assert a["winner"]["params"] in tune.QUICK_SPACE["feedback"]
+    assert len(a["rungs"]) == len(tune.QUICK_RUNGS)
+
+
+def test_profile_roundtrip_reproduces_tuned_score(tmp_path):
+    """Satellite property: loading the emitted profile reproduces the
+    tuned check score exactly (load path == emit path)."""
+    frontier = tune.successive_halving(
+        "contended", "feedback", configs=tune.QUICK_SPACE["feedback"],
+        rungs=tune.QUICK_RUNGS)
+    path = tune.write_profile("contended", frontier,
+                              tuned_dir=str(tmp_path))
+    prof = tune.load_profile("contended", str(tmp_path))
+    assert prof["params"] == frontier["winner"]["params"]
+    # Re-scoring THROUGH the loaded profile reproduces digest + score.
+    verdict = tune.check_profile("contended", str(tmp_path))
+    assert verdict["ok"], verdict
+    assert verdict["got_score_x1e6"] == prof["check"]["score_x1e6"]
+    with open(path) as f:
+        assert json.load(f) == prof
+
+
+def test_profile_loads_into_policy(tmp_path):
+    from pbs_tpu.runtime.partition import Partition
+    from pbs_tpu.telemetry.source import SimBackend
+
+    frontier = tune.successive_halving(
+        "contended", "feedback", configs=tune.QUICK_SPACE["feedback"],
+        rungs=tune.QUICK_RUNGS)
+    tune.write_profile("contended", frontier, tuned_dir=str(tmp_path))
+    part = Partition("t", source=SimBackend(), scheduler="credit")
+    pol = tune.policy_from_profile(part, "contended", str(tmp_path))
+    params = frontier["winner"]["params"]
+    assert isinstance(pol, FeedbackPolicy)
+    assert (pol.min_us, pol.max_us) == (params["min_us"],
+                                        params["max_us"])
+    assert pol.window_len == params["window"]
+    assert pol.grow_step_us == params["grow_step_us"]
+    assert pol.qdelay_threshold_ns == params["qdelay_threshold_ns"]
+    assert pol.gw_hot_after == params["gw_hot_after"]
+
+
+def test_from_profile_rejects_unknown_params():
+    from pbs_tpu.runtime.partition import Partition
+    from pbs_tpu.telemetry.source import SimBackend
+
+    part = Partition("t", source=SimBackend(), scheduler="credit")
+    with pytest.raises(KeyError):
+        FeedbackPolicy.from_profile(part, {"params": {"nonesuch": 1}})
+
+
+def test_checked_in_profiles_cover_catalog():
+    assert tune.tuned_workloads() == sorted(tune.TUNED_WORKLOADS)
+    for wl in tune.TUNED_WORKLOADS:
+        prof = tune.load_profile(wl)
+        assert prof["policy"] in tune.SEARCH_SPACE
+        assert set(prof["params"]) == set(FeedbackPolicy.TUNABLE_PARAMS) \
+            - {"stall_threshold", "shrink_sub_us"}
+        assert prof["check"]["digest"]
+
+
+def test_cli_tune_check_quick_smoke(capsys):
+    """THE tier-1 gate: every checked-in profile's score grid replays
+    to its golden digest — twice, byte-identically."""
+    assert main(["tune", "--check", "--quick", "--json"]) == 0
+    out1 = capsys.readouterr().out
+    assert main(["tune", "--check", "--quick", "--json"]) == 0
+    out2 = capsys.readouterr().out
+    assert out1 == out2
+    d = json.loads(out1)
+    assert d["ok"] is True
+    assert {p["workload"] for p in d["profiles"]} == \
+        set(tune.TUNED_WORKLOADS)
+
+
+def test_check_profile_worker_parity():
+    """The check digest is worker-count-invariant: fanning the score
+    grid over processes replays the same cells to the same bytes."""
+    inline = tune.check_profile("contended", workers=1)
+    fanned = tune.check_profile("contended", workers=2)
+    assert inline == fanned
+    assert inline["ok"]
+
+
+def test_cli_tune_check_fails_on_drift(tmp_path, capsys):
+    frontier = tune.successive_halving(
+        "contended", "feedback", configs=tune.QUICK_SPACE["feedback"],
+        rungs=tune.QUICK_RUNGS)
+    path = tune.write_profile("contended", frontier,
+                              tuned_dir=str(tmp_path))
+    prof = json.loads(open(path).read())
+    # A param change without a digest refresh = the frontier moved
+    # without `pbst tune --write` — exactly what --check must catch.
+    prof["params"]["window"] = 2
+    with open(path, "w") as f:
+        json.dump(prof, f)
+    rc = main(["tune", "--check", "--workload", "contended",
+               "--tuned-dir", str(tmp_path)])
+    assert rc == 1
+    assert "DIGEST MISMATCH" in capsys.readouterr().out
+
+
+def test_cli_tune_usage_errors(capsys):
+    assert main(["tune", "--workload", "nope"]) == 2
+    assert "unknown workload" in capsys.readouterr().err
+    assert main(["tune", "--policy", "nope"]) == 2
+    assert "no search space" in capsys.readouterr().err
+    # --check replays recorded grids; --write would not run at all.
+    assert main(["tune", "--check", "--write"]) == 2
+    assert "mutually exclusive" in capsys.readouterr().err
+    # A reduced search must never overwrite the checked-in profiles.
+    assert main(["tune", "--workload", "contended", "--quick",
+                 "--write"]) == 2
+    assert "refusing" in capsys.readouterr().err
+
+
+def test_cli_tune_quick_write_allowed_to_explicit_dir(tmp_path):
+    assert main(["tune", "--workload", "contended", "--quick",
+                 "--write", "--tuned-dir", str(tmp_path)]) == 0
+    assert (tmp_path / "contended.json").exists()
+
+
+def test_cli_tune_quick_search_table(capsys):
+    assert main(["tune", "--workload", "contended", "--quick"]) == 0
+    out = capsys.readouterr().out
+    assert "contended" in out and "score" in out
+
+
+@pytest.mark.slow
+def test_full_space_halving_deterministic_across_workers():
+    a = tune.successive_halving("contended", "feedback", workers=1)
+    b = tune.successive_halving("contended", "feedback", workers=4)
+    assert a == b
