@@ -217,6 +217,52 @@ PyObject* fc_ledger_snapshot_many(PyObject*, PyObject* const* args,
   return PyLong_FromLong(rc);
 }
 
+PyObject* fc_sim_run(PyObject*, PyObject* const* args,
+                     Py_ssize_t nargs) {
+  // (gs, gf, js, jf, counters, prev, ph_i, ph_f, heap, runq, window,
+  //  hist, rng_tab, wt_tab, ww_tab, qt_tab, qq_tab, ev) — the
+  // pbst_sim_run state block (numpy buffers or raw addresses). One
+  // call per engine run: the ~600 ns binding overhead is noise against
+  // a whole simulated horizon, but the tier exists so the sim core
+  // rides the same fastcall->ctypes->python order as every other
+  // native path (and so stale-ABI detection covers it).
+  if (nargs != 18) {
+    PyErr_SetString(PyExc_TypeError,
+                    "sim_run(gs, gf, js, jf, counters, prev, ph_i, "
+                    "ph_f, heap, runq, window, hist, rng_tab, wt_tab, "
+                    "ww_tab, qt_tab, qq_tab, ev) wants 18 buffers");
+    return nullptr;
+  }
+  ArgBuf b[18];
+  // gs is writable and must at least hold the scalar block; the rest
+  // are sized by the Python marshaller (sim/native_core.py) against
+  // the same ABI word counts this .so exports.
+  for (int i = 0; i < 18; i++) {
+    bool writable = !(i == 6 || i == 7 || i == 12 || i == 13 ||
+                      i == 14 || i == 15 || i == 16);
+    if (!b[i].take(args[i], writable)) return nullptr;
+  }
+  if (!b[0].check(pbst_sim_gs_words(), "gs")) return nullptr;
+  int64_t rc = pbst_sim_run(
+      reinterpret_cast<int64_t*>(b[0].ptr),
+      reinterpret_cast<double*>(b[1].ptr),
+      reinterpret_cast<int64_t*>(b[2].ptr),
+      reinterpret_cast<double*>(b[3].ptr), b[4].ptr, b[5].ptr,
+      reinterpret_cast<const int64_t*>(b[6].ptr),
+      reinterpret_cast<const double*>(b[7].ptr),
+      reinterpret_cast<int64_t*>(b[8].ptr),
+      reinterpret_cast<int64_t*>(b[9].ptr),
+      reinterpret_cast<double*>(b[10].ptr),
+      reinterpret_cast<int64_t*>(b[11].ptr), b[12].ptr, b[13].ptr,
+      b[14].ptr, b[15].ptr, b[16].ptr,
+      reinterpret_cast<int64_t*>(b[17].ptr));
+  return PyLong_FromLongLong(rc);
+}
+
+PyObject* fc_sim_abi(PyObject*, PyObject* const*, Py_ssize_t) {
+  return PyLong_FromLongLong(pbst_sim_abi());
+}
+
 PyMethodDef kMethods[] = {
     {"trace_emit", (PyCFunction)(void (*)())fc_trace_emit,
      METH_FASTCALL, "scalar ring emit: (ring, ts, ev, a0..a5) -> bool"},
@@ -234,6 +280,10 @@ PyMethodDef kMethods[] = {
      (PyCFunction)(void (*)())fc_ledger_snapshot_many, METH_FASTCALL,
      "vector snapshot: (ledger, total_slots, slots, n_slots, out, "
      "max_retries) -> retries (IndexError on bad slot, -1 exhausted)"},
+    {"sim_run", (PyCFunction)(void (*)())fc_sim_run, METH_FASTCALL,
+     "sweep-mode sim dispatch core over a caller state block -> status"},
+    {"sim_abi", (PyCFunction)(void (*)())fc_sim_abi, METH_FASTCALL,
+     "native sim core ABI version"},
     {nullptr, nullptr, 0, nullptr},
 };
 

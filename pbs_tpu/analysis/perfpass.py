@@ -42,6 +42,20 @@ paying a scalar ring emit per event. Two rules:
   enclosing function for locals, anywhere in the class for
   attributes. Scoped to the whole tree minus ``runtime/native.py``
   (the loader itself).
+- ``perf-native-sim-unguarded``: sim/sweep code invoking the native
+  sim dispatch core (``run_native``/``sim_run``) without a
+  degradation branch. The C core is optional exactly like the rest of
+  the native runtime, AND configuration-gated (policies, executors,
+  probes it doesn't model): every consumer must route through
+  ``native_core.unsupported_reason`` / ``available_tier`` and keep
+  the pure-Python witness engine as the fallback, or a toolchain-less
+  host (or an unsupported sweep cell) crashes instead of degrading.
+  Recognized guards: a name assigned from one of the guard calls
+  appearing in a conditional/None compare, or a guard call directly
+  inside an ``if``/``while``/ternary/``assert`` test — in the
+  enclosing function (module scope for top-level code). Scoped to
+  ``sim/`` minus ``sim/native_core.py`` (the marshaller owns its own
+  availability checks).
 """
 
 from __future__ import annotations
@@ -73,6 +87,18 @@ DISPATCH_PACKAGES = ("sim/",)
 #: The loader implementation itself (its internal load() calls are the
 #: machinery the rule protects callers of).
 NATIVE_MACHINERY = ("runtime/native.py",)
+
+#: Native sim-core consumers that must sit behind a degradation branch.
+NATIVE_SIM_CONSUMERS = ("run_native", "sim_run")
+
+#: The calls whose (None-checked) result constitutes that branch.
+NATIVE_SIM_GUARDS = ("unsupported_reason", "supported", "available_tier")
+
+#: Packages the sim-core rule covers...
+NATIVE_SIM_PACKAGES = ("sim/",)
+
+#: ...minus the marshaller that implements the core's entry points.
+NATIVE_SIM_MACHINERY = ("sim/native_core.py",)
 
 
 def _anchored(rel_path: str) -> str:
@@ -305,10 +331,94 @@ class _NativeScan:
                                    "branch anywhere in this class")
 
 
+def _call_name(func: ast.AST) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+class _NativeSimScan:
+    """perf-native-sim-unguarded: native sim-core invocations whose
+    enclosing scope has no degradation branch (see module docstring)."""
+
+    def __init__(self, src: SourceFile):
+        self.src = src
+        self.findings: list[Finding] = []
+
+    def _flag(self, node: ast.AST, what: str) -> None:
+        self.findings.append(Finding(
+            "perf-native-sim-unguarded", self.src.rel_path, node.lineno,
+            node.col_offset,
+            f"{what} with no degradation branch in scope — the native "
+            "sim core is optional (toolchain) AND configuration-gated "
+            "(policies/executors/probes it doesn't model); this site "
+            "crashes exactly where the Python witness engine should "
+            "take over",
+            hint="gate on native_core.unsupported_reason(...) (None = "
+                 "supported) or available_tier() and fall back to the "
+                 "pure-Python engine path (sim/engine.py _run_native, "
+                 "docs/SIM.md 'Native dispatch core')"))
+
+    @staticmethod
+    def _scope_nodes(scope: ast.AST):
+        """The scope's own statements, nested def subtrees pruned
+        (each def is judged against its own body)."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield sub
+            stack.extend(ast.iter_child_nodes(sub))
+
+    def _scope_guarded(self, scope: ast.AST) -> bool:
+        guard_names: set[str] = set()
+        for sub in self._scope_nodes(scope):
+            if isinstance(sub, ast.Assign) and \
+                    isinstance(sub.value, ast.Call) and \
+                    _call_name(sub.value.func) in NATIVE_SIM_GUARDS and \
+                    len(sub.targets) == 1 and \
+                    isinstance(sub.targets[0], ast.Name):
+                guard_names.add(sub.targets[0].id)
+            elif isinstance(sub, (ast.If, ast.While, ast.IfExp,
+                                  ast.Assert)):
+                # A guard call used directly in the test counts too.
+                for c in ast.walk(sub.test):
+                    if isinstance(c, ast.Call) and \
+                            _call_name(c.func) in NATIVE_SIM_GUARDS:
+                        return True
+        if not guard_names:
+            return False
+        return bool(guard_names & _none_guard_idents(scope))
+
+    def _scan_scope(self, scope: ast.AST) -> None:
+        guarded = None  # lazy: most scopes consume nothing
+        for sub in self._scope_nodes(scope):
+            if isinstance(sub, ast.Call) and \
+                    _call_name(sub.func) in NATIVE_SIM_CONSUMERS:
+                if guarded is None:
+                    guarded = self._scope_guarded(scope)
+                if not guarded:
+                    self._flag(sub, f"native sim-core call "
+                                    f".{_call_name(sub.func)}(...)")
+
+    def scan(self, tree: ast.AST) -> None:
+        # Module top-level is a scope of its own; each def is scanned
+        # against its own body (a guard in the caller doesn't sanction
+        # an unguarded helper).
+        self._scan_scope(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_scope(node)
+
+
 class PerfDisciplinePass(Pass):
     id = "perf-discipline"
     rules = ("perf-rec-loop", "perf-emit-in-loop",
-             "perf-dispatch-alloc", "perf-native-unchecked")
+             "perf-dispatch-alloc", "perf-native-unchecked",
+             "perf-native-sim-unguarded")
     description = ("trace/telemetry hot paths stay vectorized and "
                    "native-optional: no per-record TRACE_REC_WORDS "
                    "loops, no scalar ring emits inside loops in "
@@ -340,4 +450,9 @@ class PerfDisciplinePass(Pass):
             nat = _NativeScan(src)
             nat.scan(src.tree)
             findings.extend(nat.findings)
+        if any(anchored.startswith(p) for p in NATIVE_SIM_PACKAGES) \
+                and anchored not in NATIVE_SIM_MACHINERY:
+            sim = _NativeSimScan(src)
+            sim.scan(src.tree)
+            findings.extend(sim.findings)
         return findings

@@ -751,6 +751,7 @@ def cmd_sim(args) -> int:
     is jax-free, host-side virtual time only."""
     from pbs_tpu.sim import compare, format_report, run_policy
     from pbs_tpu.sim.engine import policy_names
+    from pbs_tpu.sim.sweep import native_stamp
     from pbs_tpu.sim.workload import workload_names
 
     horizon_ns = int(args.seconds * 1e9)
@@ -758,11 +759,38 @@ def cmd_sim(args) -> int:
         print(f"pbst: unknown workload {args.workload!r}; "
               f"available: {workload_names()}", file=sys.stderr)
         return 2
+    if args.native is False:
+        # Explicitly pinned to the witness engine: don't probe (or
+        # try to build) the native library for a run that will never
+        # touch it, and don't second-guess the user on stderr.
+        stamp = {"native_tier": None, "native_requested": False}
+    else:
+        stamp = native_stamp()
+        if not stamp["native_available"]:
+            # Same discipline as `pbst perf`: say WHY the native sim
+            # core is off — a silent slowdown is a debugging session.
+            reason = stamp.get("native_error", "unknown")
+            if args.native:
+                print(f"pbst: --native requested but the native sim "
+                      f"core is unavailable: {reason}", file=sys.stderr)
+                return 2
+            print(f"pbst: note: native sim core unavailable ({reason});"
+                  " pure-Python witness engine in use", file=sys.stderr)
     if args.policy == "all":
         # --trace becomes a per-policy prefix: <trace>.<policy>.jsonl.
-        cmp = compare(args.workload, seed=args.seed,
-                      n_tenants=args.tenants, n_executors=args.executors,
-                      horizon_ns=horizon_ns, trace_prefix=args.trace)
+        # --native stays a REQUIREMENT for the policies the C core
+        # implements; compare() runs the rest (credit2/sedf/arinc653)
+        # on the witness engine instead of refusing the whole table.
+        try:
+            cmp = compare(args.workload, seed=args.seed,
+                          n_tenants=args.tenants,
+                          n_executors=args.executors,
+                          horizon_ns=horizon_ns, trace_prefix=args.trace,
+                          native=args.native)
+        except RuntimeError as e:
+            print(f"pbst: {e}", file=sys.stderr)
+            return 2
+        cmp["native"] = stamp
         if args.json:
             print(json.dumps(cmp, indent=1))
         else:
@@ -772,9 +800,17 @@ def cmd_sim(args) -> int:
         print(f"pbst: unknown policy {args.policy!r}; "
               f"available: {policy_names()} or 'all'", file=sys.stderr)
         return 2
-    report = run_policy(args.workload, args.policy, seed=args.seed,
-                        n_tenants=args.tenants, n_executors=args.executors,
-                        horizon_ns=horizon_ns, trace_path=args.trace)
+    try:
+        report = run_policy(args.workload, args.policy, seed=args.seed,
+                            n_tenants=args.tenants,
+                            n_executors=args.executors,
+                            horizon_ns=horizon_ns, trace_path=args.trace,
+                            native=args.native)
+    except RuntimeError as e:
+        # Unsupported configuration under --native (non-hot policy,
+        # multi-executor, ...): a usage error, not a stack trace.
+        print(f"pbst: {e}", file=sys.stderr)
+        return 2
     if not args.json:
         # Default output is itself deterministic: the digest line is the
         # byte-identical witness two runs are compared on.
@@ -789,7 +825,8 @@ def cmd_sim(args) -> int:
                   f"dev_ms={t['device_ns'] / 1e6:>9.1f} "
                   f"tslice_us={t['tslice_us']:>5} "
                   f"p99_wait_us={t['wait_p99_us']:>8}")
-        print(f"trace_digest={report['trace_digest']}")
+        print(f"trace_digest={report['trace_digest']} "
+              f"native_tier={report['native_tier']}")
     else:
         print(json.dumps(report, indent=1))
     return 0
@@ -1090,6 +1127,7 @@ def cmd_tune(args) -> int:
     that makes the tuned frontier a regression surface like
     perf/baseline.json."""
     from pbs_tpu.sched import tune
+    from pbs_tpu.sim.sweep import native_stamp
     from pbs_tpu.sim.workload import workload_names
 
     if args.check and args.write:
@@ -1132,8 +1170,10 @@ def cmd_tune(args) -> int:
                       file=sys.stderr)
                 return 2
         ok = all(v["ok"] for v in verdicts)
+        stamp = native_stamp()
         if args.json:
             print(json.dumps({"version": 1, "ok": ok,
+                              "native": stamp,
                               "profiles": verdicts},
                              indent=1, sort_keys=True))
         else:
@@ -1142,6 +1182,13 @@ def cmd_tune(args) -> int:
                 line = (f"{v['workload']:<10} {v['policy']:<9} "
                         f"score={v['got_score_x1e6'] / 1e6:+.6f} "
                         f"{status}")
+                if v.get("recorded_tier") and \
+                        v["recorded_tier"] != v["verified_tier"]:
+                    # Tier-invariant digests: verifying a native-made
+                    # block on the python witness (or vice versa) is
+                    # the degradation contract working, not a skip.
+                    line += (f" [recorded on {v['recorded_tier']}, "
+                             f"verified on {v['verified_tier']}]")
                 if not v["ok"]:
                     d = v["score_delta_x1e6"]
                     line += (f" (tuned score "
@@ -1149,7 +1196,8 @@ def cmd_tune(args) -> int:
                              f"{d / 1e6:+.6f}; refresh with "
                              f"`pbst tune --write`)")
                 print(line)
-            print("ok" if ok else "FAILED")
+            tier = stamp.get("native_tier") or "python"
+            print(f"{'ok' if ok else 'FAILED'} (sim tier: {tier})")
         return 0 if ok else 1
 
     if args.workload == "all":
@@ -1177,8 +1225,10 @@ def cmd_tune(args) -> int:
             path = tune.write_profile(wl, frontier, base_seed=args.seed,
                                       tuned_dir=args.tuned_dir)
             print(f"wrote {path}", file=sys.stderr)
+    stamp = native_stamp()
     if args.json:
-        print(json.dumps({"version": 1, "workloads": out},
+        print(json.dumps({"version": 1, "native": stamp,
+                          "workloads": out},
                          indent=1, sort_keys=True))
     else:
         print(f"{'workload':<10} {'policy':<9} {'score':>10} params")
@@ -1187,6 +1237,8 @@ def cmd_tune(args) -> int:
             print(f"{wl:<10} {args.policy:<9} "
                   f"{w['score_x1e6'] / 1e6:>+10.6f} "
                   f"{json.dumps(w['params'], sort_keys=True)}")
+        tier = stamp.get("native_tier") or "python"
+        print(f"# sim tier: {tier}", file=sys.stderr)
     return 0
 
 
@@ -1556,6 +1608,13 @@ def main(argv=None) -> int:
                          "per-policy prefix, <trace>.<policy>.jsonl)")
     sp.add_argument("--json", action="store_true",
                     help="full JSON report instead of the summary")
+    sp.add_argument("--native", action=argparse.BooleanOptionalAction,
+                    default=None,
+                    help="require the native sim dispatch core "
+                         "(--no-native pins the pure-Python witness "
+                         "engine; default auto rides the C core for "
+                         "sweep-mode runs — recorded runs stay on the "
+                         "witness unless --native is given)")
     sp.set_defaults(fn=cmd_sim)
 
     sp = sub.add_parser(

@@ -251,41 +251,47 @@ def _fairqueue_cycle(n: int) -> BenchFns:
 
 def _sim_smoke(n: int) -> BenchFns:
     """End-to-end sanity point: virtual-time dispatch loop cost per
-    quantum (engine + partition + credit/feedback stack). ``n`` scales
-    the horizon in virtual milliseconds."""
+    quantum (engine + partition + credit/feedback stack, pinned to the
+    pure-Python witness path). ``n`` scales the horizon in virtual
+    milliseconds."""
     from pbs_tpu.sim.engine import SimEngine
 
     def run() -> int:
         eng = SimEngine(workload="stable", policy="feedback", seed=0,
-                        n_tenants=2, horizon_ns=n * MS_NS, record=False)
+                        n_tenants=2, horizon_ns=n * MS_NS, record=False,
+                        native=False)
         rep = eng.run()
         return max(1, int(rep["quanta"]))
 
     return run, lambda: None, None
 
 
-def _sim_sustained(n: int) -> BenchFns:
+def _sim_sustained(n: int, native: bool = False) -> BenchFns:
     """The sweep-throughput headline (docs/SIM.md "Sweep + sustained
     throughput"): simulated-ns per wall-ns of one sweep-mode engine run
     (mixed workload, feedback armed — the exact configuration a `pbst
     tune` cell executes). ``n`` scales the horizon in virtual
     milliseconds; ops = simulated ns, so ns/op is wall-ns PER
-    SIMULATED-ns (0.125 = the sim runs 8x faster than real time)."""
+    SIMULATED-ns (0.125 = the sim runs 8x faster than real time).
+    Dual-mode: python mode pins the witness engine (``native=False``),
+    native mode requires the C dispatch core — a regression in either
+    fails ``pbst perf --check`` like-with-like."""
     from pbs_tpu.sim.engine import SimEngine
 
     def run() -> int:
         eng = SimEngine(workload="mixed", policy="feedback", seed=0,
-                        n_tenants=4, horizon_ns=n * MS_NS, record=False)
+                        n_tenants=4, horizon_ns=n * MS_NS, record=False,
+                        native=native)  # bool: required OR pinned-off
         rep = eng.run()
         return max(1, int(rep["elapsed_ns"]))
 
     return run, lambda: None, None
 
 
-def _sweep_cell(n: int) -> BenchFns:
+def _sweep_cell(n: int, native: bool = False) -> BenchFns:
     """Per-cell cost of the parallel-sweep substrate (sim/sweep.py,
     inline worker path): seed derivation + sweep-mode engine + report
-    reduction, over ``n`` 20 ms cells."""
+    reduction, over ``n`` 20 ms cells. Dual-mode like sim.sustained."""
     from pbs_tpu.sim.sweep import build_grid, run_cell
 
     cells = build_grid(["mixed"], ["feedback"], n_reps=n,
@@ -293,7 +299,7 @@ def _sweep_cell(n: int) -> BenchFns:
 
     def run() -> int:
         for cell in cells:
-            run_cell(cell, base_seed=0)
+            run_cell(cell, base_seed=0, native=native)
         return len(cells)
 
     return run, lambda: None, None
@@ -343,11 +349,16 @@ BENCHES: dict[str, tuple[Callable[..., BenchFns], int, int]] = {
 }
 
 #: Benches with a native fast path — the ``--native`` matrix. The
-#: rest (pure-Python data structures, the sim engine, sockets) have
-#: exactly one implementation, so a second mode would gate nothing.
+#: rest (pure-Python data structures, sockets) have exactly one
+#: implementation, so a second mode would gate nothing. sim.sustained
+#: and sweep.cell ride the native sim dispatch core in native mode
+#: (required, not best-effort) and pin the pure-Python witness engine
+#: in python mode, so a regression on either tier fails
+#: ``pbst perf --check`` like-with-like.
 NATIVE_BENCHES = (
     "trace.emit", "trace.emit_many", "trace.consume", "span.emit",
     "hist.record", "hist.record_many", "ledger.snapshot_many",
+    "sim.sustained", "sweep.cell",
 )
 
 

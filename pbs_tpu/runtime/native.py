@@ -112,6 +112,23 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pbst_db_seq.restype = ctypes.c_uint64
     lib.pbst_db_wait.argtypes = [_U64P, ctypes.c_uint64, ctypes.c_uint64]
     lib.pbst_db_wait.restype = ctypes.c_uint64
+    # Sweep-mode sim dispatch core (pbst_sim_run family). The ABI/word
+    # getters let the marshaller (sim/native_core.py) assert that the
+    # layout it builds is the layout this .so was compiled with — a
+    # stale binary degrades to the Python engine instead of reading a
+    # shifted state block.
+    _F64P = ctypes.POINTER(ctypes.c_double)
+    for fn in ("pbst_sim_abi", "pbst_sim_gs_words", "pbst_sim_js_words",
+               "pbst_sim_jf_words", "pbst_sim_ev_words"):
+        getattr(lib, fn).restype = ctypes.c_int64
+    lib.pbst_sim_run.restype = ctypes.c_int64
+    lib.pbst_sim_run.argtypes = [
+        _I64P, _F64P, _I64P, _F64P, _U64P, _U64P,  # gs gf js jf ctr prev
+        _I64P, _F64P,                               # ph_i ph_f
+        _I64P, _I64P, _F64P, _I64P,                 # heap runq window hist
+        _U64P, _U64P, _U64P, _U64P, _U64P,          # rng/wt/ww/qt/qq tabs
+        _I64P,                                      # ev
+    ]
 
 
 def load() -> ctypes.CDLL | None:
@@ -211,7 +228,8 @@ def fastcall():
             spec.loader.exec_module(mod)
             for sym in ("trace_emit", "trace_emit_many",
                         "trace_consume", "hist_record",
-                        "hist_record_many", "ledger_snapshot_many"):
+                        "hist_record_many", "ledger_snapshot_many",
+                        "sim_run"):
                 if not hasattr(mod, sym):
                     raise AttributeError(f"stale fastcall .so: {sym}")
             _fc = mod
@@ -253,3 +271,10 @@ def as_i64p(arr: np.ndarray):
     index vectors for the *_many entry points)."""
     assert arr.dtype == np.int64 and arr.flags["C_CONTIGUOUS"]
     return arr.ctypes.data_as(_I64P)
+
+
+def as_f64p(arr: np.ndarray):
+    """float64 pointer into a (C-contiguous) numpy array's buffer (the
+    sim core's float state blocks and pre-drawn jitter streams)."""
+    assert arr.dtype == np.float64 and arr.flags["C_CONTIGUOUS"]
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double))

@@ -228,6 +228,14 @@ def check_block(workload: str, policy: str, params: dict,
         "n_tenants": n_tenants,
         "score_x1e6": int(round(score * 1e6)),
         "digest": h.hexdigest(),
+        # Provenance only — which sim tier produced this block. The
+        # digest deliberately excludes it (sweep.META_KEYS): a
+        # toolchain-less CI host re-verifies the SAME digest on the
+        # python witness tier instead of skipping, so real drift fails
+        # there too (cross-tier equivalence is pinned by
+        # tests/test_sim_native.py).
+        "tier": (reports[0].get("native_tier", "python") if reports
+                 else "python"),
     }
 
 
@@ -306,6 +314,11 @@ def check_profile(workload: str, tuned_dir: str | None = None,
         "expected_score_x1e6": chk["score_x1e6"],
         "got_score_x1e6": got["score_x1e6"],
         "score_delta_x1e6": got["score_x1e6"] - chk["score_x1e6"],
+        # Tier provenance: a mismatch here is informational (digests
+        # are tier-invariant); "recorded_tier" is absent from profiles
+        # written before the native core existed.
+        "recorded_tier": chk.get("tier"),
+        "verified_tier": got["tier"],
     }
 
 

@@ -359,12 +359,23 @@ class SimEngine:
         warmup_frac: float = 0.1,
         policy_params: dict | None = None,
         probe_cls: type | None = None,
+        native: bool | str | None = None,
     ):
         self.workload = workload
         self.policy = policy
         self.seed = int(seed)
         self.horizon_ns = int(horizon_ns)
         self.warmup_frac = float(warmup_frac)
+        #: Native dispatch-core request (docs/SIM.md "Native dispatch
+        #: core"): None = auto (ride the C core for sweep-mode runs
+        #: when available, degrade silently otherwise), False = force
+        #: the pure-Python loop (the witness tier), True = require the
+        #: native core (raise when unavailable/unsupported), or a tier
+        #: name ("fastcall"/"ctypes") to pin the binding.
+        self.native = native
+        #: Which binding tier actually executed the run (None = the
+        #: pure-Python engine) — stamped into sweep metadata.
+        self.native_tier_used: str | None = None
         sched_name, policy_cls = resolve_policy(policy)
         if policy_params and policy_cls is None:
             raise KeyError(
@@ -373,6 +384,7 @@ class SimEngine:
                 f"{sorted(n for n, (_, c) in POLICIES.items() if c)})")
 
         recording = bool(record or trace_path)
+        self._recording = recording
         self.clock = VirtualClock()
         self.backend = SimBackend(self.clock, seed=self.seed)
         self.partition = Partition(
@@ -459,7 +471,9 @@ class SimEngine:
 
     def run(self) -> dict:
         try:
-            self.partition.run(until_ns=self._start_ns + self.horizon_ns)
+            if not self._run_native():
+                self.partition.run(
+                    until_ns=self._start_ns + self.horizon_ns)
         finally:
             # Close on failure too: a policy raising mid-run must still
             # flush the on-disk JSONL for the post-mortem.
@@ -467,6 +481,30 @@ class SimEngine:
                 self.recorder.close()
         self._report = self._gather()
         return self._report
+
+    def _run_native(self) -> bool:
+        """Ride the C dispatch core when the request/configuration
+        allows it; False = run the pure-Python witness loop. Auto mode
+        (``native=None``) engages only for sweep-mode (``record=False``)
+        runs — the record path stays on the witness engine unless a
+        caller opts in — and degrades silently when the toolchain or
+        the configuration doesn't support the core; an explicit
+        request (True or a tier name) raises instead."""
+        if self.native is False:
+            return False
+        if self.native is None and self._recording:
+            return False
+        from pbs_tpu.sim import native_core
+
+        tier = self.native if isinstance(self.native, str) else None
+        reason = native_core.unsupported_reason(self, tier=tier)
+        if reason is not None:
+            if self.native is None:
+                return False
+            raise RuntimeError(
+                f"native sim core requested but unusable: {reason}")
+        self.native_tier_used = native_core.run_native(self, tier=tier)
+        return True
 
     def elapsed_ns(self) -> int:
         return self.clock.now_ns() - self._start_ns

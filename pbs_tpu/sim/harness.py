@@ -17,6 +17,13 @@ from pbs_tpu.utils.clock import SEC
 # automatically inside the regression gate.
 DEFAULT_POLICIES = tuple(POLICIES)
 
+#: Policies the native dispatch core implements (docs/SIM.md "Native
+#: dispatch core") — the sweep-hot subset. compare() resolves a
+#: table-wide ``native`` request per policy against this list so
+#: `pbst sim --policy all --native` accelerates the hot rows instead
+#: of refusing the whole table over credit2/sedf/arinc653.
+NATIVE_POLICIES = ("credit", "feedback", "atc")
+
 
 def run_policy(
     workload: str,
@@ -27,15 +34,22 @@ def run_policy(
     horizon_ns: int = 2 * SEC,
     trace_path: str | None = None,
     keep_lines: bool = True,
+    native: bool | str | None = None,
 ) -> dict:
     """One simulated run; returns the engine's metrics report.
     ``keep_lines=False`` streams the trace (digest + optional file only)
-    to bound memory on long horizons."""
+    to bound memory on long horizons. ``native`` follows the SimEngine
+    contract (docs/SIM.md "Native dispatch core"); the tier that ran is
+    stamped into the report as ``native_tier`` — provenance the trace
+    digest deliberately does not cover (it is bit-identical across
+    tiers by the equivalence gate)."""
     eng = SimEngine(
         workload=workload, policy=policy, seed=seed, n_tenants=n_tenants,
         n_executors=n_executors, horizon_ns=horizon_ns,
-        trace_path=trace_path, keep_lines=keep_lines)
-    return eng.run()
+        trace_path=trace_path, keep_lines=keep_lines, native=native)
+    report = eng.run()
+    report["native_tier"] = eng.native_tier_used or "python"
+    return report
 
 
 def compare(
@@ -46,11 +60,15 @@ def compare(
     n_executors: int = 1,
     horizon_ns: int = 2 * SEC,
     trace_prefix: str | None = None,
+    native: bool | str | None = None,
 ) -> dict:
     """Run every policy against the identical workload build.
 
     ``trace_prefix`` writes one JSONL per policy to
-    ``<prefix>.<policy>.jsonl``.
+    ``<prefix>.<policy>.jsonl``. A truthy ``native`` applies to the
+    policies the C core implements (``NATIVE_POLICIES``); the rest run
+    the witness engine — their reports are what they always were, and
+    the hot rows' digests are tier-invariant by the equivalence gate.
     """
     return {
         "workload": workload,
@@ -63,7 +81,9 @@ def compare(
                 workload, p, seed=seed, n_tenants=n_tenants,
                 n_executors=n_executors, horizon_ns=horizon_ns,
                 trace_path=(f"{trace_prefix}.{p}.jsonl"
-                            if trace_prefix else None))
+                            if trace_prefix else None),
+                native=(native if native is None or not native
+                        or p in NATIVE_POLICIES else False))
             for p in policies
         },
     }

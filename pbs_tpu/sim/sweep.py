@@ -116,18 +116,42 @@ def build_grid(
     return cells
 
 
-def run_cell(cell: SweepCell, base_seed: int = 0) -> dict:
+#: Result-metadata keys stamped by run_cell but kept OUTSIDE the
+#: sweep_digest payload: which binding tier executed a cell is host
+#: provenance, not behavior — the cross-tier equivalence gate
+#: (tests/test_sim_native.py) is exactly what makes stripping it sound,
+#: and existing golden digests (tuned-profile check blocks) stay
+#: byte-stable across hosts with and without a toolchain.
+META_KEYS = ("native_tier", "native_available")
+
+
+def native_stamp() -> dict:
+    """The sweep-level native provenance block (`pbst tune` reports,
+    `pbst sim` output): availability + binding tier of the native sim
+    core, with the cached failure reason when it's off."""
+    from pbs_tpu.sim import native_core
+
+    return native_core.stamp()
+
+
+def run_cell(cell: SweepCell, base_seed: int = 0,
+             native: bool | str | None = None) -> dict:
     """One sweep cell: a sweep-mode (``record=False``) engine run
     reduced to the score-relevant metrics. Every float is pre-rounded,
-    so the report is byte-stable under ``json.dumps``."""
+    so the report is byte-stable under ``json.dumps``. ``native``
+    follows the SimEngine contract (None = auto: ride the C dispatch
+    core when available, Python witness otherwise); the tier that
+    actually ran is stamped into the report's ``META_KEYS``."""
     from pbs_tpu.sim.engine import SimEngine
 
     seed = cell_seed(cell, base_seed)
-    r = SimEngine(
+    eng = SimEngine(
         workload=cell.workload, policy=cell.policy, seed=seed,
         n_tenants=cell.n_tenants, horizon_ns=cell.horizon_ns,
         record=False, policy_params=dict(cell.params) or None,
-    ).run()
+        native=native,
+    )
+    r = eng.run()
     switches_per_s = r["switches"] * 1e9 / max(1, r["elapsed_ns"])
     return {
         "cell": cell.canonical(),
@@ -140,22 +164,24 @@ def run_cell(cell: SweepCell, base_seed: int = 0) -> dict:
         "quanta": r["quanta"],
         "utilization": r["utilization"],
         "elapsed_ns": r["elapsed_ns"],
+        "native_tier": eng.native_tier_used or "python",
+        "native_available": eng.native_tier_used is not None,
     }
 
 
-def _run_cell_star(args: tuple[SweepCell, int]) -> dict:
-    return run_cell(args[0], args[1])
+def _run_cell_star(args: tuple[SweepCell, int, "bool | str | None"]) -> dict:
+    return run_cell(args[0], args[1], native=args[2])
 
 
 def sweep(cells: Sequence[SweepCell], base_seed: int = 0,
-          workers: int = 1) -> list[dict]:
+          workers: int = 1, native: bool | str | None = None) -> list[dict]:
     """Run every cell; results in grid order regardless of worker
     count. ``workers <= 1`` runs inline (no pool, no spawn cost — the
     tier-1/tune-check path); larger fans out over a spawn-context
     ``multiprocessing.Pool``."""
     cells = list(cells)
     if workers <= 1 or len(cells) <= 1:
-        return [run_cell(c, base_seed) for c in cells]
+        return [run_cell(c, base_seed, native=native) for c in cells]
     import multiprocessing as mp
 
     ctx = mp.get_context("spawn")
@@ -163,16 +189,18 @@ def sweep(cells: Sequence[SweepCell], base_seed: int = 0,
         # pool.map preserves input order — completion order is free to
         # race, the result list is not.
         return pool.map(_run_cell_star,
-                        [(c, base_seed) for c in cells])
+                        [(c, base_seed, native) for c in cells])
 
 
 def sweep_digest(reports: Sequence[dict]) -> str:
     """sha256 over the canonical report stream — the determinism
     witness a sweep prints next to its results (same grid + same base
-    seed ⇒ same digest, on any worker count)."""
+    seed ⇒ same digest, on any worker count AND any native tier:
+    ``META_KEYS`` provenance is excluded from the hashed payload)."""
     h = hashlib.sha256()
     for rep in reports:
-        h.update(json.dumps(rep, sort_keys=True,
+        payload = {k: v for k, v in rep.items() if k not in META_KEYS}
+        h.update(json.dumps(payload, sort_keys=True,
                             separators=(",", ":")).encode())
         h.update(b"\n")
     return h.hexdigest()

@@ -1,0 +1,15 @@
+"""Seeded perf-native-sim-unguarded violations: native sim-core
+invocations with no degradation branch in scope."""
+
+from pbs_tpu.sim import native_core
+
+
+def run_cell_fast(engine):
+    # BAD: run_native with no unsupported_reason/available_tier gate —
+    # crashes on toolchain-less hosts and unsupported configurations.
+    return native_core.run_native(engine)
+
+
+def sweep_row(fc, bufs):
+    # BAD: raw sim_run entry point, same missing branch.
+    return fc.sim_run(*bufs)

@@ -50,7 +50,7 @@ def test_all_rules_fire_on_bad_tree():
         "net-raw-socket", "net-raw-transport",
         "gw-direct-submit", "gw-direct-dispatch", "gw-lease-bypass",
         "perf-rec-loop", "perf-emit-in-loop", "perf-dispatch-alloc",
-        "perf-native-unchecked",
+        "perf-native-unchecked", "perf-native-sim-unguarded",
         "obs-unclosed-span", "obs-span-emit-in-loop", "obs-hist-scan",
     }
 

@@ -1,0 +1,227 @@
+"""Native sim dispatch core: cross-tier equivalence + degradation.
+
+The merge bar of the C quantum loop (native/pbst_runtime.cc
+``pbst_sim_run``), exactly like ``ListSchedulerProbe`` for the numpy
+probe: the pure-Python engine is the witness, and the native core must
+produce **bit-identical** metrics reports, trace digests, and
+tuned-profile score digests across the python → ctypes → fastcall
+tiers — a decision divergence anywhere fails a digest, not a
+tolerance. Native-gated tests skip (with the cached reason) on
+toolchain-less hosts; the degradation tests run everywhere, which is
+itself the point: forcing ``native=False`` must reproduce everything
+and keep tier-1 green with no toolchain at all.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from conftest import require_native
+from pbs_tpu.sim.engine import ListSchedulerProbe, SimEngine
+from pbs_tpu.sim.sweep import (
+    META_KEYS,
+    build_grid,
+    native_stamp,
+    sweep,
+    sweep_digest,
+)
+from pbs_tpu.utils.clock import MS
+
+
+def _tiers() -> list[str]:
+    """Binding tiers present on this host (ctypes always when the
+    library loads; fastcall only with Python.h at build time)."""
+    from pbs_tpu.sim import native_core
+
+    require_native()
+    tiers = ["ctypes"]
+    if native_core.available_tier("fastcall") is not None:
+        tiers.append("fastcall")
+    return tiers
+
+
+def _run(policy: str, native, workload: str = "mixed", seed: int = 17,
+         record: bool = True, **kw) -> dict:
+    return SimEngine(workload=workload, policy=policy, seed=seed,
+                     n_tenants=4, horizon_ns=60 * MS, record=record,
+                     native=native, **kw).run()
+
+
+# -- tier-1 smoke: one (workload, policy) cell per mode ----------------------
+
+
+def test_record_mode_cross_tier_digest(native_lib):
+    """Same seed ⇒ bit-identical trace digest AND full metrics report
+    across every available tier (the witness contract)."""
+    py = _run("feedback", native=False)
+    for tier in _tiers():
+        nat = _run("feedback", native=tier)
+        assert nat["trace_digest"] == py["trace_digest"], tier
+        assert json.dumps(nat, sort_keys=True) == \
+            json.dumps(py, sort_keys=True), tier
+
+
+def test_sweep_mode_cross_tier_report(native_lib):
+    py = _run("credit", native=False, record=False)
+    for tier in _tiers():
+        assert _run("credit", native=tier, record=False) == py, tier
+
+
+def test_native_against_list_probe_witness(native_lib):
+    """Transitivity check the probe-equivalence suite relies on: the
+    native core also matches the ORIGINAL list-based reference probe."""
+    lst = _run("feedback", native=False, record=False,
+               probe_cls=ListSchedulerProbe)
+    nat = _run("feedback", native=True, record=False)
+    assert nat == lst
+
+
+# -- degradation (runs on every host, toolchain or not) ----------------------
+
+
+def test_forcing_native_off_reproduces_auto():
+    """``native=False`` (the witness tier) and auto mode agree byte-
+    for-byte — on a native host because equivalence holds, on a
+    toolchain-less host trivially. Either way tier-1 stays green."""
+    auto = SimEngine(workload="stable", policy="feedback", seed=5,
+                     horizon_ns=50 * MS, record=False).run()
+    off = SimEngine(workload="stable", policy="feedback", seed=5,
+                    horizon_ns=50 * MS, record=False, native=False).run()
+    assert auto == off
+
+
+def test_unsupported_configs_degrade_to_python():
+    # Custom probe: the witness itself must never ride the C core.
+    eng = SimEngine(workload="stable", policy="credit", seed=1,
+                    horizon_ns=20 * MS, record=False,
+                    probe_cls=ListSchedulerProbe)
+    eng.run()
+    assert eng.native_tier_used is None
+    # Multi-executor: outside the sweep configuration the core models.
+    eng = SimEngine(workload="stable", policy="credit", seed=1,
+                    horizon_ns=20 * MS, record=False, n_executors=2)
+    eng.run()
+    assert eng.native_tier_used is None
+    # Non-hot policy: credit2 has no native implementation.
+    eng = SimEngine(workload="stable", policy="credit2", seed=1,
+                    horizon_ns=20 * MS, record=False)
+    eng.run()
+    assert eng.native_tier_used is None
+    # Auto mode keeps recorded runs on the witness engine.
+    eng = SimEngine(workload="stable", policy="credit", seed=1,
+                    horizon_ns=20 * MS)
+    eng.run()
+    assert eng.native_tier_used is None
+
+
+def test_auto_degrades_when_core_unavailable(monkeypatch):
+    """Simulated toolchain-less host: auto mode silently runs the
+    witness engine; an explicit request raises with the reason."""
+    from pbs_tpu.sim import native_core
+
+    monkeypatch.setattr(native_core, "available_tier",
+                        lambda want=None: None)
+    eng = SimEngine(workload="stable", policy="feedback", seed=2,
+                    horizon_ns=20 * MS, record=False)
+    eng.run()
+    assert eng.native_tier_used is None
+    st = native_core.stamp()
+    assert st["native_available"] is False and st["native_error"]
+    with pytest.raises(RuntimeError, match="native"):
+        SimEngine(workload="stable", policy="feedback", seed=2,
+                  horizon_ns=20 * MS, record=False, native=True).run()
+
+
+def test_explicit_native_request_raises_when_unusable():
+    with pytest.raises(RuntimeError, match="native"):
+        SimEngine(workload="stable", policy="credit", seed=1,
+                  horizon_ns=20 * MS, record=False, n_executors=2,
+                  native=True).run()
+
+
+def test_native_stamp_shape():
+    st = native_stamp()
+    assert set(st) >= {"native_available", "native_tier"}
+    if not st["native_available"]:
+        assert st["native_error"]
+
+
+# -- sweep substrate: worker parity with the core forced on and off ----------
+
+
+def _sweep_cells():
+    return build_grid(["contended"], ["credit", "feedback"], n_reps=2,
+                      horizon_ns=30 * MS)
+
+
+def test_sweep_worker_parity_native_off():
+    cells = _sweep_cells()
+    inline = sweep(cells, base_seed=3, workers=1, native=False)
+    fanned = sweep(cells, base_seed=3, workers=2, native=False)
+    assert inline == fanned
+    assert sweep_digest(inline) == sweep_digest(fanned)
+
+
+def test_sweep_worker_parity_native_on(native_lib):
+    cells = _sweep_cells()
+    inline = sweep(cells, base_seed=3, workers=1, native=True)
+    fanned = sweep(cells, base_seed=3, workers=2, native=True)
+    assert inline == fanned
+    # AND the digest ties back to the forced-off witness sweep: the
+    # provenance keys differ, the hashed payload must not.
+    off = sweep(cells, base_seed=3, workers=1, native=False)
+    assert sweep_digest(inline) == sweep_digest(off)
+    assert all(r["native_tier"] != "python" for r in inline)
+    assert all(r["native_tier"] == "python" for r in off)
+    for r in inline:
+        assert set(META_KEYS) <= set(r)
+
+
+# -- tuned profiles replay natively ------------------------------------------
+
+
+def test_tuned_profile_check_digest_cross_tier(native_lib):
+    """A tuned-profile score digest is tier-invariant: the same check
+    grid scored on the native core and on the python witness hashes
+    identically (what lets a toolchain-less CI host verify profiles
+    recorded on a native host, and vice versa)."""
+    from pbs_tpu.sched import tune
+
+    wl = "contended"
+    prof = tune.load_profile(wl)
+    kw = dict(base_seed=0, horizon_ns=40 * MS, n_reps=1, n_tenants=4)
+    a = tune.check_block(wl, prof["policy"], prof["params"], **kw)
+    assert a["tier"] in ("fastcall", "ctypes")
+    # Force the witness tier by running the same grid via sweep().
+    cells = tune._cells_for(wl, prof["policy"], prof["params"],
+                            kw["horizon_ns"], kw["n_reps"])
+    nat = sweep(cells, base_seed=0, native=True)
+    py = sweep(cells, base_seed=0, native=False)
+    assert sweep_digest(nat) == sweep_digest(py)
+
+
+# -- full catalog soak (slow tier) -------------------------------------------
+
+
+@pytest.mark.slow
+def test_full_catalog_cross_tier_digests(native_lib):
+    """All 15 (workload × policy) cells: record-mode trace digests and
+    full reports bit-identical between the native core and the Python
+    witness engine — the acceptance bar of the PR, in long form."""
+    from pbs_tpu.sim.workload import workload_names
+
+    for wl in workload_names():
+        for pol in ("credit", "feedback", "atc"):
+            py = SimEngine(workload=wl, policy=pol, seed=11,
+                           n_tenants=4, horizon_ns=100 * MS,
+                           native=False).run()
+            for tier in _tiers():
+                nat = SimEngine(workload=wl, policy=pol, seed=11,
+                                n_tenants=4, horizon_ns=100 * MS,
+                                native=tier).run()
+                assert nat["trace_digest"] == py["trace_digest"], \
+                    (wl, pol, tier)
+                assert json.dumps(nat, sort_keys=True) == \
+                    json.dumps(py, sort_keys=True), (wl, pol, tier)
