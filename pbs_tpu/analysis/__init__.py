@@ -10,9 +10,11 @@ from pbs_tpu.analysis.core import CheckContext, Finding, Pass, SourceFile
 from pbs_tpu.analysis.runner import (
     ALL_PASSES,
     CheckResult,
+    changed_py_files,
     check_paths,
     format_human,
     iter_py_files,
+    list_suppressions,
     load_dynamic_graph,
     pass_ids,
 )
@@ -24,9 +26,11 @@ __all__ = [
     "Finding",
     "Pass",
     "SourceFile",
+    "changed_py_files",
     "check_paths",
     "format_human",
     "iter_py_files",
+    "list_suppressions",
     "load_dynamic_graph",
     "pass_ids",
 ]

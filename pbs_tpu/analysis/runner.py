@@ -20,6 +20,7 @@ from typing import Any, Iterable
 from pbs_tpu.analysis.core import CheckContext, Finding, Pass, SourceFile
 from pbs_tpu.analysis.counterapi import CounterApiPass
 from pbs_tpu.analysis.gatewaypass import GatewayDisciplinePass
+from pbs_tpu.analysis.knobspass import KnobDisciplinePass
 from pbs_tpu.analysis.locks import LockDisciplinePass
 from pbs_tpu.analysis.netdiscipline import NetDisciplinePass
 from pbs_tpu.analysis.obspass import ObsDisciplinePass
@@ -37,6 +38,7 @@ ALL_PASSES: tuple[type[Pass], ...] = (
     GatewayDisciplinePass,
     PerfDisciplinePass,
     ObsDisciplinePass,
+    KnobDisciplinePass,
 )
 
 
@@ -126,6 +128,89 @@ def load_dynamic_graph(path: str) -> set[tuple[str, str]]:
             out.add((str(pair[0]), str(pair[1])))
     else:
         raise ValueError("graph holds no edges dict or pair list")
+    return out
+
+
+def changed_py_files(base_ref: str, paths: Iterable[str],
+                     root: str | None = None) -> list[str]:
+    """The ``--changed`` fast path: python files under ``paths`` that
+    differ from ``base_ref`` in git (working tree vs ref, deletions
+    excluded) plus untracked files. Raises ValueError when git cannot
+    answer (not a repo, unknown ref) — the CLI maps that to a usage
+    error, never to a silently-empty "clean" run.
+
+    Caveat (documented in docs/ANALYSIS.md): cross-file analyses
+    (static lock-order graph, knob-native-drift, knob constant
+    resolution across modules) see only the changed subset in this
+    mode — it is the pre-commit fast path; CI runs the full tree."""
+    import subprocess
+
+    root = os.path.abspath(root or os.getcwd())
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            cwd=root, capture_output=True, text=True, timeout=60)
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "--diff-filter=d", base_ref],
+            cwd=root, capture_output=True, text=True, timeout=60)
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=60)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise ValueError(f"git unavailable for --changed: {e}") from None
+    if top.returncode != 0 or diff.returncode != 0:
+        raise ValueError(
+            f"git diff {base_ref!r} failed: "
+            f"{(diff.stderr or top.stderr).strip() or 'unknown error'}")
+    # `git diff --name-only` paths are TOPLEVEL-relative; `ls-files
+    # --others` paths are cwd-relative. Anchor each against the right
+    # base or a subdirectory invocation silently reports clean.
+    toplevel = top.stdout.strip()
+    changed = {os.path.abspath(os.path.join(toplevel, n))
+               for n in diff.stdout.splitlines() if n.endswith(".py")}
+    if untracked.returncode == 0:
+        changed |= {os.path.abspath(os.path.join(root, n))
+                    for n in untracked.stdout.splitlines()
+                    if n.endswith(".py")}
+    wanted = set()
+    for p in iter_py_files(paths):
+        ap = os.path.abspath(p)
+        if ap in changed and os.path.isfile(ap):
+            wanted.add(p)
+    return sorted(wanted)
+
+
+def list_suppressions(paths: Iterable[str],
+                      root: str | None = None) -> list[dict]:
+    """Every suppression comment under ``paths`` with file:line,
+    rules, scope, and justification — the ``pbst check
+    --list-suppressions`` audit surface. Unparseable/justification-
+    less comments are listed too (rule ``bad-suppression``), so the
+    audit can't under-report the escape hatch."""
+    root = root or os.getcwd()
+    out: list[dict] = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        rel = os.path.relpath(os.path.abspath(path), root)
+        src = SourceFile(path, text, rel_path=rel.replace(os.sep, "/"))
+        for s in src.suppressions:
+            out.append({
+                "path": src.rel_path, "line": s.line,
+                "rules": list(s.rules),
+                "scope": "file" if s.file_wide else "line",
+                "justification": s.justification,
+            })
+        for f_ in src.bad_suppressions:
+            out.append({
+                "path": src.rel_path, "line": f_.line,
+                "rules": ["bad-suppression"], "scope": "line",
+                "justification": "",
+            })
+    out.sort(key=lambda d: (d["path"], d["line"]))
     return out
 
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -481,8 +482,10 @@ def cmd_check(args) -> int:
     counter-API usage. Exit 0 clean / 1 findings / 2 usage error."""
     from pbs_tpu.analysis import (
         ALL_PASSES,
+        changed_py_files,
         check_paths,
         format_human,
+        list_suppressions,
         load_dynamic_graph,
     )
 
@@ -490,6 +493,20 @@ def cmd_check(args) -> int:
         for cls in ALL_PASSES:
             print(f"{cls.id:<16} rules: {', '.join(cls.rules)}")
             print(f"{'':<16} {cls.description}")
+        return 0
+    if args.list_suppressions:
+        sups = list_suppressions(args.paths)
+        if args.format == "json":
+            print(json.dumps({"version": 1, "count": len(sups),
+                              "suppressions": sups},
+                             indent=1, sort_keys=True))
+        else:
+            for s in sups:
+                scope = "file-wide" if s["scope"] == "file" else "line"
+                print(f"{s['path']}:{s['line']}: "
+                      f"[{', '.join(s['rules'])}] ({scope}) -- "
+                      f"{s['justification'] or 'NO JUSTIFICATION'}")
+            print(f"{len(sups)} suppression(s)")
         return 0
     dynamic = None
     if args.lockdep_graph:
@@ -499,14 +516,28 @@ def cmd_check(args) -> int:
             print(f"pbst: bad --lockdep-graph {args.lockdep_graph!r}: {e}",
                   file=sys.stderr)
             return 2
+    paths = args.paths
+    if args.changed:
+        try:
+            paths = changed_py_files(args.changed, args.paths)
+        except ValueError as e:
+            print(f"pbst: bad --changed {args.changed!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        if not paths:
+            # A legitimately empty change set is clean, not a usage
+            # error — this is the pre-commit fast path.
+            print(f"pbst check: no python files changed vs "
+                  f"{args.changed} under {args.paths}")
+            return 0
     try:
-        result = check_paths(args.paths, passes=args.passes,
+        result = check_paths(paths, passes=args.passes,
                              dynamic_graph=dynamic)
     except KeyError as e:
         print(f"pbst: {e.args[0]}", file=sys.stderr)
         return 2
     if result.files_scanned == 0:
-        print(f"pbst: no python files under {args.paths}", file=sys.stderr)
+        print(f"pbst: no python files under {paths}", file=sys.stderr)
         return 2
     if args.format == "json":
         print(json.dumps(result.as_dict(), indent=1, sort_keys=True))
@@ -530,6 +561,213 @@ def cmd_selftest(args) -> int:
     for r in results:
         print(r.row())
     return 0 if all(r.ok for r in results) else 1
+
+
+def _parse_knob_value(raw: str):
+    """CLI value -> python value. JSON first (ints stay ints, floats
+    floats); anything unparseable passes through as the raw string so
+    the REGISTRY rejects it with a typed problem — `pbst knobs set
+    x=banana` must exercise the malformed-push path, not argparse."""
+    try:
+        return json.loads(raw)
+    except json.JSONDecodeError:
+        return raw
+
+
+def cmd_knobs(args) -> int:
+    """Typed knob registry + atomic hot-reload channel (docs/KNOBS.md).
+    ``list`` dumps the declarations; ``get``/``set``/``watch`` ride a
+    file-backed channel (``--channel``); ``init`` creates one;
+    ``load-profile`` pushes a tuned profile as a knob file. Exit 0 ok /
+    1 rejected push or watch problem / 2 usage error."""
+    from pbs_tpu import knobs as registry
+    from pbs_tpu.knobs.channel import KnobChannel
+    from pbs_tpu.knobs.registry import KnobError
+
+    def open_channel(writable: bool, create: bool = False):
+        if not args.channel:
+            print("pbst: this action needs --channel PATH",
+                  file=sys.stderr)
+            return None
+        if create and not os.path.exists(args.channel):
+            return KnobChannel.create(args.channel)
+        return KnobChannel.attach(args.channel, writable=writable)
+
+    if args.action == "list":
+        try:
+            if args.json:
+                doc = registry.schema()
+                if args.channel:
+                    ch = KnobChannel.attach(args.channel)
+                    gen, vals = ch.snapshot()
+                    doc["channel"] = {"path": args.channel,
+                                      "generation": gen, "values": vals}
+                print(json.dumps(doc, indent=1, sort_keys=True))
+                return 0
+            vals = None
+            if args.channel:
+                _, vals = KnobChannel.attach(args.channel).snapshot()
+        except (KnobError, OSError) as e:
+            print(f"pbst: bad --channel {args.channel!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        print(f"{'name':<42} {'type':<6} {'unit':<10} "
+              f"{'default':>12} {'range':<24} {'value':>12}")
+        for k in registry.all_knobs():
+            cur = vals.get(k.name, k.default) if vals is not None \
+                else registry.get(k.name)
+            print(f"{k.name:<42} {k.kind:<6} {k.unit or '-':<10} "
+                  f"{k.default:>12} "
+                  f"{f'[{k.lo}, {k.hi}]':<24} {cur:>12}")
+        return 0
+
+    if args.action == "init":
+        if not args.channel:
+            print("pbst: init needs --channel PATH", file=sys.stderr)
+            return 2
+        try:
+            # Always a fresh create: init is also the recovery path
+            # for a wedged channel (writer crashed mid-push), so it
+            # must rewrite the file, not attach to the wreck.
+            ch = KnobChannel.create(args.channel)
+        except (KnobError, OSError) as e:
+            print(f"pbst: bad --channel {args.channel!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        gen, vals = ch.snapshot()
+        print(f"knob channel {args.channel}: {len(vals)} knob(s), "
+              f"generation {gen}")
+        return 0
+
+    if args.action == "get":
+        if not args.items:
+            print("pbst: get needs at least one knob name",
+                  file=sys.stderr)
+            return 2
+        try:
+            ch = open_channel(writable=False) if args.channel else None
+        except (KnobError, OSError) as e:
+            print(f"pbst: bad --channel {args.channel!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        out = {}
+        for name in args.items:
+            if not registry.exists(name):
+                print(f"pbst: unknown knob {name!r}", file=sys.stderr)
+                return 2
+            out[name] = ch.get(name) if ch is not None \
+                else registry.get(name)
+        if args.json:
+            print(json.dumps(out, indent=1, sort_keys=True))
+        else:
+            for name, v in out.items():
+                print(f"{name}={v}")
+        return 0
+
+    if args.action == "set":
+        if not args.items:
+            print("pbst: set needs NAME=VALUE arguments",
+                  file=sys.stderr)
+            return 2
+        updates = {}
+        for item in args.items:
+            name, eq, raw = item.partition("=")
+            if not eq:
+                print(f"pbst: set takes NAME=VALUE, got {item!r}",
+                      file=sys.stderr)
+                return 2
+            updates[name] = _parse_knob_value(raw)
+        try:
+            ch = open_channel(writable=True, create=True)
+        except (KnobError, OSError) as e:
+            print(f"pbst: bad --channel {args.channel!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        if ch is None:
+            return 2
+        try:
+            gen = ch.push(updates)
+        except KnobError as e:
+            print("pbst: knob push REJECTED (atomic — nothing "
+                  "applied):", file=sys.stderr)
+            for p in e.problems:
+                print(f"  {p}", file=sys.stderr)
+            return 1
+        print(f"applied {len(updates)} knob(s) at generation {gen}")
+        return 0
+
+    if args.action == "watch":
+        try:
+            ch = open_channel(writable=False)
+        except (KnobError, OSError) as e:
+            print(f"pbst: bad --channel {args.channel!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        if ch is None:
+            return 2
+
+        def on_change(gen, values):
+            if args.json:
+                print(json.dumps({"generation": gen, "values": values},
+                                 sort_keys=True), flush=True)
+            else:
+                print(f"generation {gen}:", flush=True)
+                for k in sorted(values):
+                    print(f"  {k}={values[k]}", flush=True)
+
+        try:
+            n = ch.watch(on_change, timeout_s=args.timeout,
+                         max_events=args.max_events)
+        except KnobError as e:
+            # e.g. snapshot retries exhausted against a wedged writer.
+            print(f"pbst: watch failed: {e}", file=sys.stderr)
+            return 1
+        print(f"watch done: {n} update(s)", file=sys.stderr)
+        return 0
+
+    if args.action == "load-profile":
+        from pbs_tpu.knobs.profile import profile_knob_document
+        from pbs_tpu.sched import tune
+
+        if not args.items:
+            print("pbst: load-profile needs a workload name "
+                  f"({tune.tuned_workloads(args.tuned_dir)})",
+                  file=sys.stderr)
+            return 2
+        try:
+            prof = tune.load_profile(args.items[0], args.tuned_dir)
+            updates = profile_knob_document(prof)
+        except (OSError, ValueError, KeyError, KnobError) as e:
+            print(f"pbst: bad tuned profile {args.items[0]!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        if not args.channel:
+            # Dry surface: show what the profile stands for.
+            for k in sorted(updates):
+                print(f"{k}={updates[k]}")
+            return 0
+        try:
+            ch = open_channel(writable=True, create=True)
+        except (KnobError, OSError) as e:
+            print(f"pbst: bad --channel {args.channel!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        try:
+            gen = ch.push(updates)
+        except KnobError as e:
+            print(f"pbst: profile push REJECTED: {e}", file=sys.stderr)
+            return 1
+        print(f"profile {args.items[0]!r}: {len(updates)} knob(s) "
+              f"live at generation {gen}")
+        return 0
+
+    print(f"pbst: unknown knobs action {args.action!r}", file=sys.stderr)
+    return 2
+
+
+def knobs_entry() -> None:
+    """Console entry ``pbst-knobs``."""
+    sys.exit(main(["knobs", *sys.argv[1:]]))
 
 
 def cmd_params(args) -> int:
@@ -1506,6 +1744,14 @@ def main(argv=None) -> int:
                     help="run only this pass (repeatable; default: all)")
     sp.add_argument("--list-passes", action="store_true",
                     help="list passes and rule ids, then exit")
+    sp.add_argument("--list-suppressions", action="store_true",
+                    help="audit every suppression comment (file:line, "
+                         "rules, justification), then exit")
+    sp.add_argument("--changed", metavar="REF",
+                    help="incremental mode: analyze only files changed "
+                         "vs this git ref (pre-commit fast path; "
+                         "cross-file analyses see the subset only — "
+                         "CI still runs the full tree)")
     sp.add_argument("--lockdep-graph", metavar="GRAPH.json",
                     help="dynamic lock-order graph (pbst lockdep "
                          "--dump-graph) to cross-check static edges "
@@ -1523,6 +1769,29 @@ def main(argv=None) -> int:
     g.add_argument("--file", help="obs dump JSON; default: this process")
     g.add_argument("--cmdline", help="apply a 'k=v k2 no-k3' string first")
     sp.set_defaults(fn=cmd_params)
+
+    sp = sub.add_parser(
+        "knobs", help="typed knob registry + atomic hot-reload "
+                      "(docs/KNOBS.md)")
+    sp.add_argument("action",
+                    choices=["list", "init", "get", "set", "watch",
+                             "load-profile"])
+    sp.add_argument("items", nargs="*",
+                    help="get: knob names; set: NAME=VALUE pairs; "
+                         "load-profile: workload class")
+    sp.add_argument("--channel", metavar="PATH",
+                    help="file-backed knob channel (seqlock ledger "
+                         "protocol; created on init/set if missing)")
+    sp.add_argument("--timeout", type=float, default=None,
+                    help="watch: stop after this many seconds")
+    sp.add_argument("--max-events", type=int, default=None,
+                    dest="max_events",
+                    help="watch: stop after this many updates")
+    sp.add_argument("--tuned-dir", default=None, dest="tuned_dir",
+                    help="load-profile: profile directory (default: "
+                         "the checked-in pbs_tpu/sched/tuned/)")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_knobs)
 
     def agent_args(sp):
         sp.add_argument("--connect", required=True,

@@ -33,10 +33,19 @@ import zlib
 from typing import Any, Callable
 
 from pbs_tpu.faults import injector as faults
+from pbs_tpu import knobs
 from pbs_tpu.obs import console as _console
 from pbs_tpu.obs.lockprof import ProfiledLock
 
 MAX_MSG_BYTES = 64 << 20
+
+# Transport retry/backoff envelope, declared in the knob registry
+# (dist.rpc.*): the constructor defaults every client rides unless a
+# caller overrides per-connection.
+RPC_MAX_RETRIES = knobs.default("dist.rpc.max_retries")
+RPC_BACKOFF_BASE_S = knobs.default("dist.rpc.backoff_base_s")
+RPC_BACKOFF_CAP_S = knobs.default("dist.rpc.backoff_cap_s")
+RPC_TIMEOUT_S = knobs.default("dist.rpc.timeout_s")
 _LEN = struct.Struct(">I")
 
 #: Process-unique client ids feeding idempotency-token prefixes.
@@ -358,10 +367,12 @@ class RpcClient:
     name, not host:port) so seeded chaos runs are reproducible.
     """
 
-    def __init__(self, address: tuple[str, int], timeout_s: float = 5.0,
+    def __init__(self, address: tuple[str, int],
+                 timeout_s: float = RPC_TIMEOUT_S,
                  auth_token: str | None = None, fault_key: str = "client",
-                 max_retries: int = 3, backoff_base_s: float = 0.005,
-                 backoff_cap_s: float = 0.05,
+                 max_retries: int = RPC_MAX_RETRIES,
+                 backoff_base_s: float = RPC_BACKOFF_BASE_S,
+                 backoff_cap_s: float = RPC_BACKOFF_CAP_S,
                  deadline_s: float | None = None):
         self.address = (address[0], int(address[1]))
         self.timeout_s = timeout_s

@@ -27,23 +27,37 @@ from __future__ import annotations
 
 import dataclasses
 
-from pbs_tpu.utils.clock import MS, SEC
+from pbs_tpu import knobs
+from pbs_tpu.utils.clock import SEC
 
 #: SLO classes the fair queue schedules between (docs/GATEWAY.md).
 INTERACTIVE = "interactive"
 BATCH = "batch"
 SLO_CLASSES = (INTERACTIVE, BATCH)
 
+# Admission defaults + shed retry-after hints, declared in the knob
+# registry (gateway.admission.*, docs/KNOBS.md).
+DEFAULT_RATE = knobs.default("gateway.admission.default_rate")
+DEFAULT_BURST = knobs.default("gateway.admission.default_burst")
+DEFAULT_WEIGHT = knobs.default("gateway.admission.default_weight")
+DEFAULT_MAX_QUEUED = knobs.default("gateway.admission.default_max_queued")
+DEFAULT_MAX_QUEUED_TOTAL = knobs.default("gateway.admission.max_queued_total")
+#: Retry-after for transient pressure (queue slots drain in ~this).
+SHED_RETRY_NS = knobs.default("gateway.admission.shed_retry_ns")
+#: Retry-after for permanent conditions (no contract, cost can never
+#: fit the bucket) — long, so contract-following clients stop hammering.
+PERMANENT_RETRY_NS = knobs.default("gateway.admission.permanent_retry_ns")
+
 
 @dataclasses.dataclass
 class TenantQuota:
     """One tenant's admission contract."""
 
-    rate: float = 100.0  # sustained cost-units per second
-    burst: float = 50.0  # bucket capacity (peak debt)
-    weight: int = 256  # fair-queue share (SchedParams.weight scale)
+    rate: float = DEFAULT_RATE  # sustained cost-units per second
+    burst: float = DEFAULT_BURST  # bucket capacity (peak debt)
+    weight: int = DEFAULT_WEIGHT  # fair-queue share (SchedParams scale)
     slo: str = BATCH  # SLO class: "interactive" | "batch"
-    max_queued: int = 64  # per-tenant gateway queue-slot bound
+    max_queued: int = DEFAULT_MAX_QUEUED  # per-tenant queue-slot bound
 
     def __post_init__(self) -> None:
         if self.slo not in SLO_CLASSES:
@@ -115,7 +129,7 @@ class AdmissionController:
     tenant's bucket for a request it cannot take anyway.
     """
 
-    def __init__(self, max_queued_total: int = 256,
+    def __init__(self, max_queued_total: int = DEFAULT_MAX_QUEUED_TOTAL,
                  default_quota: TenantQuota | None = None,
                  bucket_factory=None):
         self.max_queued_total = int(max_queued_total)
@@ -167,18 +181,18 @@ class AdmissionController:
         quota = self.quota_of(tenant)
         if quota is None:
             # No contract at all: permanent condition, long retry-after.
-            return self._shed("unknown-tenant", SEC)
+            return self._shed("unknown-tenant", PERMANENT_RETRY_NS)
         if total_queued >= self.max_queued_total:
             # Global backpressure: retry when a slot plausibly drains.
-            return self._shed("queue-full", 50 * MS)
+            return self._shed("queue-full", SHED_RETRY_NS)
         if tenant_queued >= quota.max_queued:
-            return self._shed("tenant-queue-full", 50 * MS)
+            return self._shed("tenant-queue-full", SHED_RETRY_NS)
         if cost > quota.burst:
             # The bucket can NEVER cover this request (level <= burst):
             # shedding with a finite bucket-refill hint would send a
             # contract-following client into a retry livelock. Permanent
             # condition, long retry-after — like unknown-tenant.
-            return self._shed("cost-over-burst", SEC)
+            return self._shed("cost-over-burst", PERMANENT_RETRY_NS)
         bucket = self._buckets.get(tenant)
         if bucket is None:  # default-quota tenant: lazily materialize
             bucket = self._buckets[tenant] = self._make_bucket(

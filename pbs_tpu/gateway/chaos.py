@@ -280,16 +280,32 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
                          plan: FaultPlan | None = None,
                          trace_path: str | None = None,
                          drain_rejoin: bool = True,
-                         obs_dir: str | None = None) -> dict:
+                         obs_dir: str | None = None,
+                         knob_plan: list[dict] | None = None) -> dict:
     """One seeded federated-gateway chaos scenario; returns the report
     dict (``ok`` = every invariant held). Gateway deaths, partitions,
     and lease expiries come from the armed plan; a drain of a seeded
     victim at ``ticks/3`` and a fresh-member rejoin at ``2·ticks/3``
     come from the harness schedule (both pure functions of ``seed``).
-    Installs the plan process-wide for the duration."""
+    Installs the plan process-wide for the duration.
+
+    ``knob_plan`` injects mid-run hot-reloads over a real file-backed
+    knob channel (docs/KNOBS.md): each entry is ``{"tick": T, "set":
+    {knob: value}}`` plus optional ``"expect": "rejected"`` for a
+    malformed/out-of-range push the channel must refuse ATOMICALLY
+    (generation unmoved, books untouched). The federation adopts
+    applied pushes at the top of its ``tick()`` pump — BEFORE that
+    round's lease renewals, so a push at a renewal tick genuinely
+    races the renewal path. The no-job-lost and no-rate-inflation
+    invariants must hold across every push; the mint bound integrates
+    the rate-scale timeline piecewise. With ``knob_plan=None`` the
+    run — and both digests — are byte-identical to the pre-knob
+    harness."""
     plan = plan if plan is not None else FaultPlan.federation(seed)
     inj = faults_mod.install(plan, trace_path=trace_path)
     problems: list[str] = []
+    knob_events: list[dict] = []
+    knob_dir = None
     try:
         clock = VirtualClock()
         members = [
@@ -313,6 +329,62 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
         rejoin_at = (2 * ticks) // 3 if drain_rejoin else -1
 
         start_ns = clock.now_ns()
+        # Rate-scale timeline for the piecewise mint bound:
+        # [(t_ns, scale)] segments; scale 1.0 from the start.
+        scale_timeline: list[tuple[int, float]] = [(start_ns, 1.0)]
+        knob_writer = None
+        pushes_by_tick: dict[int, list[dict]] = {}
+        if knob_plan:
+            import tempfile
+
+            from pbs_tpu.knobs.channel import KnobChannel
+            from pbs_tpu.knobs.registry import KnobError
+
+            knob_dir = tempfile.mkdtemp(prefix="pbst-knobs-")
+            ch_path = f"{knob_dir}/knobs.led"
+            knob_writer = KnobChannel.create(ch_path)
+            fed.attach_knobs(KnobChannel.attach(ch_path))
+            for entry in knob_plan:
+                pushes_by_tick.setdefault(int(entry["tick"]),
+                                          []).append(entry)
+
+        def _push_knobs(tick: int) -> None:
+            for entry in pushes_by_tick.get(tick, ()):
+                expect_reject = entry.get("expect") == "rejected"
+                gen_before = knob_writer.generation
+                try:
+                    gen = knob_writer.push(dict(entry["set"]))
+                    applied, errors = True, []
+                except KnobError as e:
+                    applied, errors = False, list(e.problems)
+                    gen = knob_writer.generation
+                if applied and not expect_reject and \
+                        "gateway.admission.rate_scale" in entry["set"]:
+                    # Adoption happens at the top of THIS tick's pump.
+                    scale_timeline.append(
+                        (clock.now_ns(),
+                         float(entry["set"]
+                               ["gateway.admission.rate_scale"])))
+                if expect_reject and applied:
+                    problems.append(
+                        f"knob push at tick {tick} expected rejected "
+                        f"but applied: {entry['set']!r}")
+                if not expect_reject and not applied:
+                    problems.append(
+                        f"knob push at tick {tick} unexpectedly "
+                        f"rejected: {errors}")
+                if not applied and gen != gen_before:
+                    problems.append(
+                        f"REJECTED push at tick {tick} moved the "
+                        f"channel generation {gen_before}->{gen} — "
+                        "rejection was not atomic")
+                knob_events.append({
+                    "tick": tick, "applied": applied,
+                    "generation": gen,
+                    "set": {k: str(v) for k, v in
+                            sorted(entry["set"].items())},
+                    "errors": errors,
+                })
         admitted_cost: dict[str, float] = {}
         admitted_rids: list[str] = []
         shed_results = 0
@@ -327,6 +399,8 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
                     f"inflight {fed.inflight_count()}")
 
         for tick in range(int(ticks)):
+            if knob_writer is not None:
+                _push_knobs(tick)
             if tick == drain_at and len(fed.members) > 1:
                 candidates = [n for n in sorted(fed.members)
                               if n not in fed._draining]
@@ -383,6 +457,17 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
 
         # No-rate-inflation: every admitted cost unit is token-backed.
         elapsed_s = (clock.now_ns() - start_ns) / SEC
+        # Piecewise ∫scale·dt for the mint bound: a mid-run rate-scale
+        # push re-rates the banks settle-then-switch
+        # (LeaseBroker.set_rate_scale), so minted tokens must stay
+        # under burst + rate·Σ scaleᵢ·dtᵢ. No pushes ⇒ this is exactly
+        # the old burst + rate·elapsed bound.
+        end_ns = clock.now_ns()
+        scaled_elapsed_s = 0.0
+        for i, (t0, sc) in enumerate(scale_timeline):
+            t1 = (scale_timeline[i + 1][0]
+                  if i + 1 < len(scale_timeline) else end_ns)
+            scaled_elapsed_s += sc * max(0, t1 - t0) / SEC
         audit = fed.lease_audit()
         for tname, a in sorted(audit.items()):
             q = quotas.get(tname)
@@ -397,11 +482,11 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
                     f"{tname}: bank over-issued (granted "
                     f"{a['granted']:.3f} > minted {a['minted']:.3f} "
                     f"+ deposited {a['deposited']:.3f})")
-            if a["minted"] > q.burst + q.rate * elapsed_s + 1e-6:
+            if a["minted"] > q.burst + q.rate * scaled_elapsed_s + 1e-6:
                 problems.append(
                     f"{tname}: minted {a['minted']:.3f} beyond "
-                    f"burst + rate*t = "
-                    f"{q.burst + q.rate * elapsed_s:.3f}")
+                    f"burst + rate*∫scale·dt = "
+                    f"{q.burst + q.rate * scaled_elapsed_s:.3f}")
             accounted = (a["leased_spent"] + a["held"] + a["deposited"]
                          + a["destroyed"])
             if accounted > a["granted"] + eps:
@@ -441,6 +526,10 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
         })
     finally:
         faults_mod.uninstall()
+        if knob_dir is not None:
+            import shutil
+
+            shutil.rmtree(knob_dir, ignore_errors=True)
 
     fault_counts: dict[str, int] = {}
     for rec in inj.records:
@@ -453,13 +542,24 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
     # The scenario digest: a second determinism witness over the BOOKS
     # (the fault-trace digest only proves the injector replayed; this
     # proves the federation's response did too).
-    digest_src = json.dumps({
+    digest_payload = {
         "admitted": fed.admitted, "completed": fed.completed,
         "handoffs": fed.handoffs, "events": events,
         "admitted_cost": {k: round(v, 6)
                           for k, v in sorted(admitted_cost.items())},
         "shed": st["shed"],
-    }, sort_keys=True, separators=(",", ":"))
+    }
+    if knob_plan is not None:
+        # Knob-armed runs witness the RECONFIGURATION RESPONSE too:
+        # every push (applied or atomically rejected) and what the
+        # federation adopted. Keyed in only when a knob plan is armed,
+        # so plain runs keep their pre-knob digests byte-identical.
+        digest_payload["knob_events"] = knob_events
+        digest_payload["applied_knobs"] = {
+            k: round(float(v), 6)
+            for k, v in sorted(fed.applied_knobs.items())}
+    digest_src = json.dumps(digest_payload, sort_keys=True,
+                            separators=(",", ":"))
     report: dict[str, Any] = {
         "workload": workload, "seed": seed, "gateways": n_gateways,
         "tenants": n_tenants, "ticks": ticks,
@@ -475,4 +575,9 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
         "problems": problems,
         "ok": not problems,
     }
+    if knob_plan is not None:
+        report["knob_events"] = knob_events
+        report["applied_knobs"] = {
+            k: round(float(v), 6)
+            for k, v in sorted(fed.applied_knobs.items())}
     return report

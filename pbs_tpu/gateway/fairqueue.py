@@ -30,13 +30,17 @@ import dataclasses
 from collections import deque
 from typing import Any
 
+from pbs_tpu import knobs
 from pbs_tpu.gateway.admission import BATCH, INTERACTIVE, SLO_CLASSES
 
-#: Class dispatch cycle: interactive-heavy, batch floor-share.
-DEFAULT_CLASS_CYCLE = (INTERACTIVE, INTERACTIVE, INTERACTIVE, INTERACTIVE,
-                       BATCH)
+#: Class dispatch cycle: interactive-heavy, batch floor-share. The
+#: 4:1 shape is declared per class in the knob registry
+#: (gateway.fairqueue.interactive_slots / batch_slots).
+DEFAULT_CLASS_CYCLE = (
+    (INTERACTIVE,) * knobs.default("gateway.fairqueue.interactive_slots")
+    + (BATCH,) * knobs.default("gateway.fairqueue.batch_slots"))
 #: Deficit top-up per DRR visit at weight 256, in cost units.
-DEFAULT_QUANTUM = 16
+DEFAULT_QUANTUM = knobs.default("gateway.fairqueue.drr_quantum")
 
 
 @dataclasses.dataclass

@@ -52,17 +52,22 @@ import bisect
 import dataclasses
 import hashlib
 
+from pbs_tpu import knobs
 from pbs_tpu.faults import injector as _faults
 from pbs_tpu.gateway.admission import SLO_CLASSES, TenantQuota, TokenBucket
 from pbs_tpu.gateway.gateway import Gateway, SubmitResult
-from pbs_tpu.utils.clock import MS, SEC
+from pbs_tpu.utils.clock import SEC
 
 #: Default lease cadence: renew every period, die after ttl. The ttl is
 #: deliberately < 2 renew periods, so ONE refused renewal opens a short
 #: degraded window — lease loss is a condition the tier lives with, not
-#: an edge case.
-DEFAULT_RENEW_PERIOD_NS = 4 * MS
-DEFAULT_LEASE_TTL_NS = 6 * MS
+#: an edge case. Declared in the knob registry (gateway.federation.*).
+DEFAULT_RENEW_PERIOD_NS = knobs.default("gateway.federation.renew_period_ns")
+DEFAULT_LEASE_TTL_NS = knobs.default("gateway.federation.lease_ttl_ns")
+#: Retry-after when no front door can serve at all.
+NO_GATEWAY_RETRY_NS = knobs.default("gateway.federation.no_gateway_retry_ns")
+#: Default gateway.partition fault duration before the heal fires.
+PARTITION_HEAL_NS = knobs.default("gateway.federation.partition_heal_ns")
 
 
 def _hash64(key: str) -> int:
@@ -199,13 +204,35 @@ class LeaseBroker:
         self.banks: dict[str, GlobalBucket] = {}
         self.quotas: dict[str, TenantQuota] = {}
         self.leases: dict[tuple[str, str], Lease] = {}
+        #: Live multiplier on every tenant's mint rate — the
+        #: hot-reloadable global throttle (knob
+        #: ``gateway.admission.rate_scale``, docs/KNOBS.md).
+        self.rate_scale = 1.0
 
     def register(self, tenant: str, quota: TenantQuota,
                  now_ns: int) -> None:
         if tenant not in self.banks:
-            self.banks[tenant] = GlobalBucket(quota.rate, quota.burst,
-                                              now_ns)
+            self.banks[tenant] = GlobalBucket(
+                quota.rate * self.rate_scale, quota.burst, now_ns)
             self.quotas[tenant] = quota
+
+    def set_rate_scale(self, scale: float, now_ns: int) -> None:
+        """Atomic live re-rate of every bank: settle each bucket's mint
+        at the OLD rate up to ``now_ns``, then switch. Settling first
+        keeps the mint odometer a true piecewise integral — a scale
+        change can never mint retroactively, so the no-rate-inflation
+        audit bound (minted <= burst + Σ scaleᵢ·rate·dtᵢ) holds across
+        any number of mid-run pushes."""
+        scale = float(scale)
+        if not (scale > 0.0):
+            from pbs_tpu.knobs.registry import KnobError
+
+            raise KnobError([f"rate_scale {scale!r} must be > 0"])
+        for tenant in sorted(self.banks):
+            bank = self.banks[tenant]
+            bank._refill(now_ns)  # settle the old-rate interval
+            bank.rate = self.quotas[tenant].rate * scale
+        self.rate_scale = scale
 
     def grant(self, tenant: str, gateway: str, want: float,
               now_ns: int, ttl_ns: int) -> Lease | None:
@@ -423,6 +450,11 @@ class FederatedGateway:
         self.fed_sheds: dict[str, int] = {}
         self.destroyed: dict[str, float] = {}  # tokens dead boxes took down
         self.events: list[dict] = []
+        #: Live-knob bridge (attach_knobs): polled once per tick, so
+        #: application points are a deterministic function of the
+        #: federation's own timeline.
+        self._knob_watcher = None
+        self.applied_knobs: dict[str, float | int] = {}
         self._last_renew_ns: int | None = None
         self._health_cache: tuple[int, dict] = (-1, {})
         for gw in members:
@@ -694,11 +726,45 @@ class FederatedGateway:
             # with a backoff hint, never a hang or a silent drop.
             self.fed_sheds["no-gateway"] = \
                 self.fed_sheds.get("no-gateway", 0) + 1
-            return SubmitResult(False, None, "no-gateway", 50 * MS)
+            return SubmitResult(False, None, "no-gateway",
+                                NO_GATEWAY_RETRY_NS)
         r = target.submit(tenant, payload, cost=cost, slo=slo)
         if r.admitted:
             self.admitted += 1
         return r
+
+    # -- live knobs (docs/KNOBS.md) --------------------------------------
+
+    def attach_knobs(self, channel) -> None:
+        """Subscribe this federation to a knob channel
+        (knobs/channel.py). Pushes are adopted at the next ``tick()``
+        — one poll per pump round, so mid-run reconfiguration lands at
+        a deterministic point of the run's own timeline (virtual-clock
+        chaos runs replay bit-identically). A push the channel
+        REJECTED (malformed/out-of-range) never moves the generation,
+        so it is invisible here by construction — atomicity end to
+        end."""
+        from pbs_tpu.knobs.channel import KnobWatcher
+
+        watcher = KnobWatcher(channel)
+        watcher.add(self._apply_knobs)
+        self._knob_watcher = watcher
+
+    def _apply_knobs(self, changed: dict, values: dict) -> None:
+        now = self.clock.now_ns()
+        if "gateway.admission.rate_scale" in changed:
+            # The live throttle: settle-then-switch on every bank (see
+            # LeaseBroker.set_rate_scale for the audit argument).
+            self.broker.set_rate_scale(
+                float(changed["gateway.admission.rate_scale"]), now)
+        self.applied_knobs.update(changed)
+        # Digest-covered adoption record: the scenario digest proves
+        # WHEN the federation adopted WHAT (gateway/chaos.py).
+        self.events.append({
+            "now_ns": now, "event": "knobs",
+            "gateway": ",".join(f"{k}={values[k]}"
+                                for k in sorted(changed)) or "-",
+        })
 
     # -- leases ----------------------------------------------------------
 
@@ -762,13 +828,15 @@ class FederatedGateway:
         drained members that emptied. Returns this tick's completions
         across all members."""
         now = self.clock.now_ns()
+        if self._knob_watcher is not None:
+            self._knob_watcher.poll()
         for name in sorted(self.members):
             if name in self._partitioned:
                 continue
             f = _faults.consult("gateway.partition", name)
             if f is not None:
                 self._partitioned[name] = now + int(
-                    f.args.get("duration_ns", 20 * MS))
+                    f.args.get("duration_ns", PARTITION_HEAL_NS))
                 self.events.append({"now_ns": now, "event": "partition",
                                     "gateway": name})
         for name in sorted(self.members):

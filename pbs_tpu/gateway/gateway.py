@@ -37,6 +37,7 @@ from typing import Any, Callable
 
 import numpy as np
 
+from pbs_tpu import knobs
 from pbs_tpu.faults import injector as _faults
 from pbs_tpu.gateway.admission import (
     INTERACTIVE,
@@ -46,7 +47,11 @@ from pbs_tpu.gateway.admission import (
     TenantQuota,
 )
 from pbs_tpu.gateway.backends import Backend
-from pbs_tpu.gateway.fairqueue import DeficitRoundRobin, Request
+from pbs_tpu.gateway.fairqueue import (
+    DEFAULT_QUANTUM as DEFAULT_DRR_QUANTUM,
+    DeficitRoundRobin,
+    Request,
+)
 from pbs_tpu.obs.spans import HistBatch, LatencyHistograms, SpanRecorder
 from pbs_tpu.obs.trace import EmitBatch, Ev, TraceBuffer
 from pbs_tpu.telemetry.counters import NUM_COUNTERS, Counter
@@ -63,6 +68,11 @@ from pbs_tpu.utils.clock import MS, MonotonicClock
 #:   COMPILES       sheds (explicit rejections)
 #:   TOKENS         cost units completed
 GW_LEDGER_SLOTS = {cls: i for i, cls in enumerate(SLO_CLASSES)}
+
+#: Queue-delay feedback export cadence (knob registry,
+#: gateway.gateway.feedback_period_ns).
+DEFAULT_FEEDBACK_PERIOD_NS = knobs.default(
+    "gateway.gateway.feedback_period_ns")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -88,8 +98,8 @@ class Gateway:
         trace_capacity: int = 0,
         ledger_path: str | None = None,
         feedback_sink: Callable[[str, int, int], None] | None = None,
-        feedback_period_ns: int = 10 * MS,
-        drr_quantum: int = 16,
+        feedback_period_ns: int = DEFAULT_FEEDBACK_PERIOD_NS,
+        drr_quantum: int = DEFAULT_DRR_QUANTUM,
         name: str = "gw",
         spans: SpanRecorder | None = None,
         hist_slots: int = 256,

@@ -1,0 +1,38 @@
+"""pbs_tpu.knobs — typed knob registry + atomic hot-reload.
+
+- ``registry``: the declarations (name, type, unit, safe range,
+  default, subsystem, native ABI symbol) and the process-local live
+  overlay. Import-light by design (stdlib only).
+- ``channel``: the file-backed seqlock transport (``pbst knobs
+  get/set/watch``) with all-or-nothing pushes.
+- ``profile``: tuned profiles as knob documents.
+
+Convention (enforced by the ``knob-discipline`` pass, docs/KNOBS.md):
+module-level tunable constants derive from ``knobs.default(...)``;
+live consumers read ``knobs.get(...)`` or subscribe via
+``channel.KnobWatcher``.
+"""
+
+from pbs_tpu.knobs.registry import (  # noqa: F401
+    BAND_PAIRS,
+    Knob,
+    KnobError,
+    all_knobs,
+    check_value,
+    default,
+    exists,
+    get,
+    knob,
+    names,
+    reset_local,
+    schema,
+    set_local,
+    snapshot,
+    validate_set,
+)
+
+__all__ = [
+    "BAND_PAIRS", "Knob", "KnobError", "all_knobs", "check_value",
+    "default", "exists", "get", "knob", "names", "reset_local",
+    "schema", "set_local", "snapshot", "validate_set",
+]

@@ -1,0 +1,497 @@
+"""Typed knob registry — the declared-tunable model (ROADMAP 3).
+
+The paper's core mechanism is a handful of live-tuned scheduler
+constants compiled into the hypervisor (the 100 µs–30 ms time-slice
+band, the window filter depth, the miss-rate thresholds); our
+reproduction had the same constants scattered as ~44 module-level
+``_NS``/``_US``/``_MS`` literals across 16 files. Following Xkernel's
+declared-tunable blueprint (PAPERS.md, arXiv 2512.12530), every
+tunable is DECLARED here once — name, type, unit, safe range, default,
+subsystem, and (where the C sim core marshals it) the native ABI
+symbol — and the consuming modules derive their constants from the
+declaration::
+
+    from pbs_tpu import knobs
+    TSLICE_MIN_US = knobs.default("sched.feedback.tslice_min_us")
+
+Three layers stand on the declarations:
+
+- **provenance** — the ``knob-discipline`` pass of ``pbst check``
+  (analysis/knobspass.py) fails any hot-path tunable NOT routed
+  through the registry, cross-checks the ``_NS/_US/_MS`` suffix of the
+  routed constant's name against the declared unit, and lints the
+  C-ABI marshalling mirror (``native=`` symbols vs
+  ``sim/native_core.py`` vs ``native/pbst_runtime.cc``);
+- **hot-reload** — ``knobs.channel.KnobChannel`` publishes current
+  values over a file-backed seqlock channel (``pbst knobs
+  get/set/watch``) with atomic all-or-nothing pushes validated against
+  the declared ranges;
+- **profiles** — a tuned profile (``pbs_tpu/sched/tuned/*.json``) maps
+  onto registry knobs (knobs/profile.py) and becomes just a knob file
+  loadable live.
+
+This module is deliberately dependency-free (stdlib only): it imports
+before numpy/jax exist and is consumed by the static analysis pass,
+which must run on bare CI images.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Iterable
+
+# Inlined time factors (utils/clock.py values). The registry is the
+# single module allowed to restate them: importing utils.clock here
+# would put the registry below it in the import order, and every
+# subsystem above BOTH.
+_US = 1_000
+_MS = 1_000_000
+_SEC = 1_000_000_000
+
+#: Unit vocabulary. Time units match the ``_ns/_us/_ms`` name-suffix
+#: convention the time-units pass enforces; the rest are dimensional
+#: annotations the suffix checker ignores.
+UNITS = ("ns", "us", "ms", "s", "", "per_s", "tokens", "records",
+         "steps", "flop_per_s", "bytes_per_s")
+
+SUBSYSTEMS = ("sched", "gateway", "telemetry", "obs", "runtime", "dist")
+
+
+class KnobError(ValueError):
+    """A knob push/declaration that violates the registry contract.
+
+    Carries every problem of the batch (``problems``): an atomic push
+    reports ALL its violations, then applies nothing.
+    """
+
+    def __init__(self, problems: list[str]):
+        self.problems = list(problems)
+        super().__init__("; ".join(self.problems))
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One declared tunable."""
+
+    name: str  # dotted: "<subsystem>.<module>.<knob>"
+    kind: str  # "int" | "float"
+    unit: str  # see UNITS
+    default: int | float
+    lo: int | float  # safe range (inclusive)
+    hi: int | float
+    subsystem: str
+    doc: str = ""
+    #: C-ABI marshalling symbol in sim/native_core.py +
+    #: native/pbst_runtime.cc (GS_*/GF_*), or None for a knob the
+    #: native sim core deliberately does not model. The knob-discipline
+    #: pass holds both sides to this declaration.
+    native: str | None = None
+
+    def coerce(self, value: Any) -> int | float:
+        """Validate + convert one raw value; raises KnobError."""
+        problems = check_value(self, value)
+        if problems:
+            raise KnobError(problems)
+        return int(value) if self.kind == "int" else float(value)
+
+    def as_dict(self) -> dict[str, Any]:
+        d = {
+            "name": self.name, "kind": self.kind, "unit": self.unit,
+            "default": self.default, "lo": self.lo, "hi": self.hi,
+            "subsystem": self.subsystem,
+        }
+        if self.doc:
+            d["doc"] = self.doc
+        if self.native:
+            d["native"] = self.native
+        return d
+
+
+def check_value(knob: Knob, value: Any) -> list[str]:
+    """The problems (empty = none) with assigning ``value`` to
+    ``knob``. Shared by direct sets, channel pushes, and profile
+    loads, so "malformed" and "out-of-range" mean the same thing on
+    every path."""
+    n = knob.name
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        return [f"{n}: {value!r} is not a number"]
+    if isinstance(value, float) and not math.isfinite(value):
+        return [f"{n}: {value!r} is not finite"]
+    if knob.kind == "int" and isinstance(value, float) \
+            and value != int(value):
+        return [f"{n}: {value!r} is not an integer "
+                f"(declared kind: int)"]
+    v = int(value) if knob.kind == "int" else float(value)
+    if not (knob.lo <= v <= knob.hi):
+        return [f"{n}: {v!r} outside safe range "
+                f"[{knob.lo}, {knob.hi}] ({knob.unit or 'unitless'})"]
+    return []
+
+
+_REGISTRY: dict[str, Knob] = {}
+
+#: Cross-knob constraints an atomic push must also satisfy: every
+#: (lo_knob, hi_knob) pair must end with lo <= hi after the push.
+BAND_PAIRS: tuple[tuple[str, str], ...] = (
+    ("sched.feedback.tslice_min_us", "sched.feedback.tslice_max_us"),
+    ("sched.atc.tslice_min_us", "sched.atc.tslice_max_us"),
+    ("sched.base.tslice_min_us", "sched.base.tslice_max_us"),
+    ("sched.credit.tslice_min_bound_us", "sched.credit.tslice_max_bound_us"),
+    ("sched.sedf.period_min_us", "sched.sedf.period_max_us"),
+    ("sched.feedback.stable_lo", "sched.feedback.stable_hi"),
+    ("dist.rpc.backoff_base_s", "dist.rpc.backoff_cap_s"),
+)
+
+
+def _declare(name: str, kind: str, unit: str, default, lo, hi,
+             doc: str = "", native: str | None = None) -> None:
+    subsystem = name.split(".", 1)[0]
+    problems: list[str] = []
+    if subsystem not in SUBSYSTEMS:
+        problems.append(f"{name}: unknown subsystem {subsystem!r}")
+    if unit not in UNITS:
+        problems.append(f"{name}: unknown unit {unit!r}")
+    if name in _REGISTRY:
+        problems.append(f"{name}: declared twice")
+    # The declared unit and the name's own suffix must agree — the
+    # registry holds itself to the convention it enforces on consumers.
+    leaf = name.rsplit(".", 1)[-1]
+    for suf in ("ns", "us", "ms"):
+        if leaf.endswith("_" + suf) and unit != suf:
+            problems.append(f"{name}: name suffix _{suf} vs declared "
+                            f"unit {unit!r}")
+    if problems:
+        raise KnobError(problems)
+    knob = Knob(name=name, kind=kind, unit=unit, default=default,
+                lo=lo, hi=hi, subsystem=subsystem, doc=doc,
+                native=native)
+    bad = check_value(knob, default)
+    if bad:
+        raise KnobError([f"{name}: default invalid: {b}" for b in bad])
+    _REGISTRY[name] = knob
+
+
+# ---------------------------------------------------------------------------
+# Declarations. Defaults ARE the former module literals — an
+# unconfigured tree is bit-identical to the pre-registry one (every
+# golden digest is the witness).
+# ---------------------------------------------------------------------------
+
+# -- sched.feedback: the research-core adaptation loop (sched/feedback.py)
+_declare("sched.feedback.metric_tick_period_ns", "int", "ns",
+         1 * _MS, 100 * _US, 1 * _SEC,
+         doc="CSCHED_METRIC_TICK_PERIOD (sched_credit.c:55)")
+_declare("sched.feedback.window", "int", "",
+         5, 1, 128,
+         doc="event filter window depth (sched_credit.c:114); hi is "
+             "native_core.MAX_WINDOW",
+         native="GS_WINDOW_LEN")
+_declare("sched.feedback.stable_lo", "float", "",
+         0.70, 0.0, 1.0,
+         doc="stability band lower factor (sched_credit.c:354-357)")
+_declare("sched.feedback.stable_hi", "float", "",
+         1.30, 1.0, 10.0,
+         doc="stability band upper factor")
+_declare("sched.feedback.stall_threshold", "float", "",
+         100.0, 0.0, 1e6,
+         doc="HBM-stall phase threshold (100 = 10% of device time)",
+         native="GF_STALL_THRESHOLD")
+_declare("sched.feedback.tslice_min_us", "int", "us",
+         100, 10, 1_000_000,
+         doc="adaptation band floor (sched_credit.c:286-300)",
+         native="GS_MIN_US")
+_declare("sched.feedback.tslice_max_us", "int", "us",
+         1_100, 10, 1_000_000,
+         doc="adaptation band cap of the built variant",
+         native="GS_MAX_US")
+_declare("sched.feedback.grow_step_us", "int", "us",
+         100, 1, 100_000,
+         doc="LOW_PHASE slice growth per stable window",
+         native="GS_GROW_STEP_US")
+_declare("sched.feedback.shrink_sub_us", "int", "us",
+         200, 1, 100_000,
+         doc="HIGH_PHASE subtractive shrink when cur//3 under-floors",
+         native="GS_SHRINK_SUB_US")
+_declare("sched.feedback.qdelay_threshold_ns", "int", "ns",
+         2 * _MS, 1, 1 * _SEC,
+         doc="gateway queue-delay per-event threshold (py-only: the "
+             "native sim core has no gateway in the loop)")
+_declare("sched.feedback.gw_hot_after", "int", "",
+         3, 1, 100,
+         doc="consecutive over-threshold reports before BOOST+shrink "
+             "(py-only: no gateway in the native sim core)")
+
+# -- sched.atc: the atc quantum law (sched/atc.py)
+_declare("sched.atc.alpha", "int", "",
+         4, 1, 64, doc="EWMA weight (sched_credit_atc.c ALPHA)")
+_declare("sched.atc.history", "int", "",
+         4, 1, 64, doc="state-history hysteresis depth")
+_declare("sched.atc.slice_base_us", "int", "us",
+         49_980, 1, 1_000_000, doc="linear law intercept (atc:336-347)")
+_declare("sched.atc.slice_step_us", "int", "us",
+         3_300, 1, 1_000_000, doc="per-bucket decrement")
+_declare("sched.atc.tslice_min_us", "int", "us",
+         300, 10, 1_000_000, doc="atc band floor")
+_declare("sched.atc.tslice_max_us", "int", "us",
+         30_000, 10, 1_000_000,
+         doc="atc band cap — the paper's 30 ms upper band edge")
+
+# -- sched.base: the dispatch-legal envelope (sched/base.py)
+_declare("sched.base.tslice_min_us", "int", "us",
+         100, 1, 1_000_000,
+         doc="outer clamp floor every do_schedule applies")
+_declare("sched.base.tslice_max_us", "int", "us",
+         1_000_000, 1, 10_000_000,
+         doc="outer clamp cap (sysctl UMAX, public/sysctl.h:571)")
+
+# -- sched.credit (sched/credit.py)
+_declare("sched.credit.acct_period_us", "int", "us",
+         30_000, 1_000, 1_000_000,
+         doc="CSCHED_ACCT_PERIOD (sched_credit.c:50)")
+_declare("sched.credit.tslice_min_bound_us", "int", "us",
+         1_000, 1, 1_000_000, doc="sysctl UMIN (public/sysctl.h:570)")
+_declare("sched.credit.tslice_max_bound_us", "int", "us",
+         1_000_000, 1, 10_000_000, doc="sysctl UMAX")
+
+# -- sched.credit2 (sched/credit2.py)
+_declare("sched.credit2.credit_init", "float", "",
+         10_000.0, 1.0, 1e9,
+         doc="starting credit (credit units ≈ µs at the runqueue's "
+             "max weight — a currency, not a clock reading, so no "
+             "time-suffix contract)")
+_declare("sched.credit2.reset_threshold", "float", "",
+         0.0, -1e9, 1e9,
+         doc="credit level that triggers a reset epoch "
+             "(CSCHED2_CREDIT_RESET)")
+_declare("sched.credit2.tickle_margin", "float", "",
+         500.0, 0.0, 1e9,
+         doc="preemption margin in credit units")
+_declare("sched.credit2.balance_every", "int", "",
+         16, 1, 1_000_000, doc="load-balance cadence in schedule calls")
+_declare("sched.credit2.balance_threshold", "float", "",
+         1.0, 0.0, 1e9, doc="EWMA load delta that justifies a steal")
+_declare("sched.credit2.load_alpha", "float", "",
+         0.125, 0.0, 1.0, doc="runqueue load EWMA weight")
+_declare("sched.credit2.default_weight", "int", "",
+         256, 1, 65_536, doc="credit2 default job weight")
+_declare("sched.credit2.carry_frac", "float", "",
+         0.5, 0.0, 1.0, doc="credit carried across a reset epoch")
+
+# -- sched.sedf (sched/sedf.py)
+_declare("sched.sedf.extra_quantum_ns", "int", "ns",
+         500 * _US, 1_000, 1 * _SEC,
+         doc="EXTRA_QUANTUM (sched_sedf.c:40)")
+_declare("sched.sedf.weight_period_us", "int", "us",
+         100_000, 1_000, 10_000_000, doc="MILLISECS(100)")
+_declare("sched.sedf.weight_safety_us", "int", "us",
+         5_000, 0, 1_000_000, doc="MILLISECS(5) headroom")
+_declare("sched.sedf.period_min_us", "int", "us",
+         10, 1, 1_000_000, doc="PERIOD_MIN")
+_declare("sched.sedf.period_max_us", "int", "us",
+         10_000_000, 1_000, 100_000_000, doc="PERIOD_MAX")
+_declare("sched.sedf.slice_min_us", "int", "us",
+         5, 1, 1_000_000, doc="SLICE_MIN")
+
+# -- sched.arinc653 (sched/arinc653.py)
+_declare("sched.arinc653.default_window_us", "int", "us",
+         10_000, 100, 10_000_000,
+         doc="default per-job minor-frame window")
+
+# -- gateway.admission (gateway/admission.py)
+_declare("gateway.admission.default_rate", "float", "per_s",
+         100.0, 0.001, 1e9,
+         doc="TenantQuota default sustained cost-units/s")
+_declare("gateway.admission.default_burst", "float", "tokens",
+         50.0, 0.001, 1e9, doc="TenantQuota default bucket capacity")
+_declare("gateway.admission.default_weight", "int", "",
+         256, 1, 65_536, doc="TenantQuota default fair-queue share")
+_declare("gateway.admission.default_max_queued", "int", "",
+         64, 1, 1_000_000,
+         doc="TenantQuota default per-tenant queue-slot bound")
+_declare("gateway.admission.max_queued_total", "int", "",
+         256, 1, 10_000_000, doc="gateway-wide queue bound")
+_declare("gateway.admission.shed_retry_ns", "int", "ns",
+         50 * _MS, 1 * _MS, 60 * _SEC,
+         doc="retry-after hint for transient sheds (queue pressure)")
+_declare("gateway.admission.permanent_retry_ns", "int", "ns",
+         1 * _SEC, 1 * _MS, 3_600 * _SEC,
+         doc="retry-after hint for permanent conditions "
+             "(unknown-tenant, cost-over-burst)")
+_declare("gateway.admission.rate_scale", "float", "",
+         1.0, 0.01, 100.0,
+         doc="live multiplier on every tenant's mint rate — the "
+             "hot-reloadable global throttle (docs/KNOBS.md); applied "
+             "by LeaseBroker.set_rate_scale at the next settle")
+
+# -- gateway.fairqueue (gateway/fairqueue.py)
+_declare("gateway.fairqueue.drr_quantum", "int", "tokens",
+         16, 1, 1_000_000,
+         doc="deficit top-up per DRR visit at weight 256")
+_declare("gateway.fairqueue.interactive_slots", "int", "",
+         4, 1, 64, doc="interactive share of the class dispatch cycle")
+_declare("gateway.fairqueue.batch_slots", "int", "",
+         1, 1, 64, doc="batch floor share of the class dispatch cycle")
+
+# -- gateway.gateway (gateway/gateway.py)
+_declare("gateway.gateway.feedback_period_ns", "int", "ns",
+         10 * _MS, 1 * _MS, 60 * _SEC,
+         doc="queue-delay feedback export cadence")
+
+# -- gateway.federation (gateway/federation.py)
+_declare("gateway.federation.renew_period_ns", "int", "ns",
+         4 * _MS, 1 * _MS, 60 * _SEC,
+         doc="lease renewal cadence")
+_declare("gateway.federation.lease_ttl_ns", "int", "ns",
+         6 * _MS, 1 * _MS, 120 * _SEC,
+         doc="lease validity; deliberately < 2 renew periods")
+_declare("gateway.federation.no_gateway_retry_ns", "int", "ns",
+         50 * _MS, 1 * _MS, 60 * _SEC,
+         doc="retry-after when every front door is dead/partitioned")
+_declare("gateway.federation.partition_heal_ns", "int", "ns",
+         20 * _MS, 1 * _MS, 60 * _SEC,
+         doc="default gateway.partition fault duration before heal")
+
+# -- runtime (runtime/doorbell.py, runtime/executor.py)
+_declare("runtime.doorbell.poll_ns", "int", "ns",
+         500 * _US, 1 * _US, 1 * _SEC,
+         doc="doorbell poll period when no waiter is armed")
+_declare("runtime.executor.max_steps_per_quantum", "int", "steps",
+         1024, 1, 1_000_000,
+         doc="quantum_to_steps ceiling — bounds a quantum's compiled "
+             "step count whatever the slice band says")
+
+# -- obs.trace (obs/trace.py EmitBatch watermarks)
+_declare("obs.trace.emit_batch_capacity", "int", "records",
+         256, 1, 1_000_000,
+         doc="EmitBatch size watermark (staged records per flush)")
+_declare("obs.trace.emit_batch_flush_ns", "int", "ns",
+         1 * _MS, 1 * _US, 60 * _SEC,
+         doc="EmitBatch time watermark over staged event timestamps")
+
+# -- dist.rpc backoff envelope (dist/rpc.py)
+_declare("dist.rpc.max_retries", "int", "",
+         3, 0, 100, doc="bounded transport retries per call")
+_declare("dist.rpc.backoff_base_s", "float", "s",
+         0.005, 0.0001, 60.0, doc="exponential backoff base")
+_declare("dist.rpc.backoff_cap_s", "float", "s",
+         0.05, 0.0001, 600.0, doc="exponential backoff cap")
+_declare("dist.rpc.timeout_s", "float", "s",
+         5.0, 0.001, 3_600.0, doc="socket timeout per attempt")
+
+# -- telemetry.source hardware model (telemetry/source.py)
+_declare("telemetry.source.peak_flops", "float", "flop_per_s",
+         197e12, 1e9, 1e18, doc="bf16 peak FLOP/s of the modeled chip")
+_declare("telemetry.source.peak_hbm_bw", "float", "bytes_per_s",
+         819e9, 1e6, 1e15, doc="peak HBM bandwidth of the modeled chip")
+
+
+# ---------------------------------------------------------------------------
+# Accessors
+# ---------------------------------------------------------------------------
+
+#: Process-local overlay: live (hot-reloaded) values. Import-time
+#: constants read ``default()`` and stay frozen; live consumers read
+#: ``get()`` or subscribe through knobs.channel.KnobWatcher.
+_current: dict[str, int | float] = {}
+
+
+def knob(name: str) -> Knob:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KnobError([f"unknown knob {name!r}"]) from None
+
+
+def exists(name: str) -> bool:
+    return name in _REGISTRY
+
+
+def default(name: str) -> int | float:
+    """The declared default — what the former module literal was."""
+    return knob(name).default
+
+
+def get(name: str) -> int | float:
+    """Current live value (default unless hot-reloaded)."""
+    k = knob(name)
+    return _current.get(name, k.default)
+
+
+def all_knobs() -> list[Knob]:
+    return [_REGISTRY[n] for n in sorted(_REGISTRY)]
+
+
+def names() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def snapshot() -> dict[str, int | float]:
+    """Every knob's current live value, sorted by name."""
+    return {n: get(n) for n in sorted(_REGISTRY)}
+
+
+def validate_set(updates: dict[str, Any],
+                 base: dict[str, int | float] | None = None
+                 ) -> dict[str, int | float]:
+    """Validate a whole push; returns the coerced updates or raises
+    :class:`KnobError` carrying EVERY problem — the atomicity
+    contract's first half (the second is that callers apply the
+    returned dict all-or-nothing). ``base`` is the value set the push
+    lands on (defaults: the declaration defaults) for cross-knob band
+    checks."""
+    problems: list[str] = []
+    coerced: dict[str, int | float] = {}
+    if not isinstance(updates, dict) or not updates:
+        raise KnobError(["push carries no knob=value updates"])
+    for name in sorted(updates):
+        if not isinstance(name, str) or name not in _REGISTRY:
+            problems.append(f"unknown knob {name!r}")
+            continue
+        k = _REGISTRY[name]
+        bad = check_value(k, updates[name])
+        if bad:
+            problems.extend(bad)
+            continue
+        coerced[name] = (int(updates[name]) if k.kind == "int"
+                         else float(updates[name]))
+
+    def effective(n: str):
+        if n in coerced:
+            return coerced[n]
+        if base is not None and n in base:
+            return base[n]
+        return _REGISTRY[n].default
+
+    if not problems:
+        for lo_name, hi_name in BAND_PAIRS:
+            if lo_name in coerced or hi_name in coerced:
+                lo, hi = effective(lo_name), effective(hi_name)
+                if lo > hi:
+                    problems.append(
+                        f"band inverted: {lo_name}={lo} > "
+                        f"{hi_name}={hi}")
+    if problems:
+        raise KnobError(problems)
+    return coerced
+
+
+def set_local(updates: dict[str, Any]) -> dict[str, int | float]:
+    """Atomic process-local apply: validate everything, then apply
+    everything (or nothing). Returns the coerced updates."""
+    coerced = validate_set(updates, base=snapshot())
+    _current.update(coerced)
+    return coerced
+
+
+def reset_local() -> None:
+    """Test hook: drop every hot-reloaded value."""
+    _current.clear()
+
+
+def schema() -> dict[str, Any]:
+    """JSON-stable declaration dump (``pbst knobs list --json``)."""
+    return {
+        "version": 1,
+        "knobs": [k.as_dict() for k in all_knobs()],
+    }

@@ -43,10 +43,17 @@ import struct
 
 import numpy as np
 
+from pbs_tpu import knobs
 from pbs_tpu.utils.params import integer_param
 
 TRACE_HEADER_WORDS = 4
 TRACE_REC_WORDS = 8
+
+# EmitBatch staging watermarks, declared in the knob registry
+# (obs.trace.emit_batch_*): how many records one producer stages, and
+# the staged-timestamp span that forces a flush.
+EMIT_BATCH_CAPACITY = knobs.default("obs.trace.emit_batch_capacity")
+EMIT_BATCH_FLUSH_NS = knobs.default("obs.trace.emit_batch_flush_ns")
 
 _U64_MASK = 2**64 - 1
 
@@ -381,8 +388,8 @@ class EmitBatch:
                  "_bufp", "_fc_flush", "_n", "_t0", "emitted",
                  "flushes")
 
-    def __init__(self, ring: TraceBuffer, capacity: int = 256,
-                 flush_ns: int = 1_000_000):
+    def __init__(self, ring: TraceBuffer, capacity: int = EMIT_BATCH_CAPACITY,
+                 flush_ns: int = EMIT_BATCH_FLUSH_NS):
         if capacity <= 0:
             raise ValueError("EmitBatch capacity must be > 0")
         self.ring = ring

@@ -28,6 +28,7 @@ import time
 
 import numpy as np
 
+from pbs_tpu import knobs
 from pbs_tpu.utils.clock import SEC, US
 
 HEADER_WORDS = 4
@@ -35,9 +36,9 @@ _MAGIC = 0x70627374_6462  # "pbstdb"
 
 # Pure-Python wait() poll period. The native path blocks in the
 # library; the fallback polls the notify sequence at this cadence — a
-# named constant so the unit checker (and future tuning, e.g. an
-# adaptive backoff param) can see it instead of a bare sleep literal.
-DOORBELL_POLL_NS = 500 * US
+# registry-declared knob (runtime.doorbell.poll_ns) so the unit
+# checker and `pbst knobs` both see it instead of a bare sleep literal.
+DOORBELL_POLL_NS = knobs.default("runtime.doorbell.poll_ns")
 
 
 class Doorbell:

@@ -20,6 +20,7 @@ from typing import TYPE_CHECKING
 
 import numpy as np
 
+from pbs_tpu import knobs
 from pbs_tpu.obs.trace import Ev
 from pbs_tpu.runtime.job import ContextState, ExecutionContext
 from pbs_tpu.telemetry.counters import NUM_COUNTERS, Counter
@@ -29,7 +30,11 @@ if TYPE_CHECKING:
 
 #: Upper bound on steps per quantum, so a mispredicted avg_step_ns can't
 #: starve the partition (no analog needed in Xen — timers preempt).
-MAX_STEPS_PER_QUANTUM = 1024
+#: Declared in the knob registry (runtime.executor.max_steps_per_quantum);
+#: the native sim core restates it (sim/native_core.py) because the C
+#: loop cannot read Python state.
+MAX_STEPS_PER_QUANTUM = knobs.default(
+    "runtime.executor.max_steps_per_quantum")
 
 # Plain-int counter indices for the dispatch hot path (an IntEnum
 # index pays an __index__ round trip per numpy access).

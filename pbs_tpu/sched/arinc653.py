@@ -30,10 +30,11 @@ from __future__ import annotations
 
 import dataclasses
 
+from pbs_tpu import knobs
 from pbs_tpu.sched.base import Decision, Scheduler, register_scheduler
 from pbs_tpu.utils.clock import US
 
-DEFAULT_WINDOW_US = 10_000
+DEFAULT_WINDOW_US = knobs.default("sched.arinc653.default_window_us")
 
 
 @dataclasses.dataclass

@@ -32,18 +32,23 @@ from typing import TYPE_CHECKING
 
 import numpy as np
 
+from pbs_tpu import knobs
 from pbs_tpu.sched.feedback import FeedbackPolicy, JobMetricState
 from pbs_tpu.utils.clock import MS
 
 if TYPE_CHECKING:
     from pbs_tpu.runtime.job import Job
 
-ALPHA = 4  # EWMA weight (sched_credit_atc.c ALPHA)
-HISTORY = 4  # state-history depth (update_time_slice)
-SLICE_BASE_US = 49_980  # linear law intercept (atc:336-347)
-SLICE_STEP_US = 3_300  # per-bucket decrement
-ATC_MIN_US = 300
-ATC_MAX_US = 30_000
+# Reference constants, declared in the knob registry
+# (knobs/registry.py sched.atc.*) — defaults are the sched_credit_atc.c
+# values, so the unconfigured policy is bit-identical to the pre-knob
+# one.
+ALPHA = knobs.default("sched.atc.alpha")
+HISTORY = knobs.default("sched.atc.history")
+SLICE_BASE_US = knobs.default("sched.atc.slice_base_us")
+SLICE_STEP_US = knobs.default("sched.atc.slice_step_us")
+ATC_MIN_US = knobs.default("sched.atc.tslice_min_us")
+ATC_MAX_US = knobs.default("sched.atc.tslice_max_us")
 
 
 @dataclasses.dataclass
@@ -60,6 +65,8 @@ class AtcJobState:
 
 class AtcFeedbackPolicy(FeedbackPolicy):
     """Drop-in alternative to FeedbackPolicy with the atc quantum law."""
+
+    KNOB_POLICY = "atc"
 
     def __init__(self, partition, tick_ns: int = 1 * MS, **kw):
         # Tunable passthrough (`pbst tune --policy atc`): the atc band

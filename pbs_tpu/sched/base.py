@@ -18,6 +18,8 @@ import abc
 import dataclasses
 from typing import TYPE_CHECKING, Any
 
+from pbs_tpu import knobs
+
 if TYPE_CHECKING:
     from pbs_tpu.runtime.executor import Executor
     from pbs_tpu.runtime.job import ExecutionContext, Job
@@ -32,8 +34,10 @@ if TYPE_CHECKING:
 # ``do_schedule`` clamps at the Decision site so a bad stored value
 # can never become a dispatched quantum (the bug class PR 1's
 # ``_shrink`` clamp fixed — enforced by ``pbst check`` sched-ops).
-TSLICE_MIN_US = 100
-TSLICE_MAX_US = 1_000_000
+# Declared in the knob registry (sched.base.*): the envelope is a
+# tunable like the bands it contains.
+TSLICE_MIN_US = knobs.default("sched.base.tslice_min_us")
+TSLICE_MAX_US = knobs.default("sched.base.tslice_max_us")
 
 
 def clamp_tslice_us(us: int) -> int:

@@ -37,6 +37,7 @@ from __future__ import annotations
 import dataclasses
 from typing import TYPE_CHECKING
 
+from pbs_tpu import knobs
 from pbs_tpu.runtime.job import ContextState
 from pbs_tpu.sched.base import (
     Decision,
@@ -56,9 +57,12 @@ PRI_BOOST = 0
 PRI_UNDER = -1
 PRI_OVER = -2
 
-DEFAULT_ACCT_PERIOD_US = 30_000
-TSLICE_US_MIN_BOUND = 1_000  # sysctl UMIN (public/sysctl.h:570)
-TSLICE_US_MAX_BOUND = 1_000_000  # sysctl UMAX (public/sysctl.h:571)
+# Declared in the knob registry (sched.credit.*); defaults are the
+# reference values. (The sysctl bounds carry the _US suffix last so
+# the unit checkers read them as microseconds.)
+DEFAULT_ACCT_PERIOD_US = knobs.default("sched.credit.acct_period_us")
+TSLICE_MIN_BOUND_US = knobs.default("sched.credit.tslice_min_bound_us")
+TSLICE_MAX_BOUND_US = knobs.default("sched.credit.tslice_max_bound_us")
 
 
 @dataclasses.dataclass
@@ -325,10 +329,10 @@ class CreditScheduler(Scheduler):
     def adjust_global(self, **params) -> None:
         if "acct_period_us" in params:
             v = int(params.pop("acct_period_us"))
-            if not (TSLICE_US_MIN_BOUND <= v <= TSLICE_US_MAX_BOUND):
+            if not (TSLICE_MIN_BOUND_US <= v <= TSLICE_MAX_BOUND_US):
                 raise ValueError(
                     f"acct_period_us out of sysctl bounds "
-                    f"[{TSLICE_US_MIN_BOUND}, {TSLICE_US_MAX_BOUND}]"
+                    f"[{TSLICE_MIN_BOUND_US}, {TSLICE_MAX_BOUND_US}]"
                 )
             self.acct_period_us = v
             if self._acct_timer is not None:

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import dataclasses
 
+from pbs_tpu import knobs
 from pbs_tpu.sched.base import (
     Decision,
     Scheduler,
@@ -51,24 +52,26 @@ from pbs_tpu.sched.base import (
 )
 from pbs_tpu.utils.clock import US
 
-CREDIT_INIT = 10_000.0  # µs at the runqueue's max weight
+# Declared in the knob registry (sched.credit2.*); defaults are the
+# reference values.
+CREDIT_INIT = knobs.default("sched.credit2.credit_init")
 #: Reset when the dispatch candidate has burned below zero
 #: (CSCHED2_CREDIT_RESET).
-RESET_THRESHOLD = 0.0
+RESET_THRESHOLD = knobs.default("sched.credit2.reset_threshold")
 #: Carryover bound on reset: at most this fraction of CREDIT_INIT of
 #: earned (or owed) spacing survives a reset.
-CARRY_FRAC = 0.5
+CARRY_FRAC = knobs.default("sched.credit2.carry_frac")
 #: Tickle margin (CSCHED2_MIGRATE_RESIST in spirit): a waker must beat
 #: a resident by this many credit-µs to count as a preempting wake.
-TICKLE_MARGIN = 500.0
+TICKLE_MARGIN = knobs.default("sched.credit2.tickle_margin")
 #: Dispatches between load-balance checks (opt_load_balance tick).
-BALANCE_EVERY = 16
+BALANCE_EVERY = knobs.default("sched.credit2.balance_every")
 #: Load divergence (EWMA runnable contexts) that justifies migration.
-BALANCE_THRESHOLD = 1.0
+BALANCE_THRESHOLD = knobs.default("sched.credit2.balance_threshold")
 #: EWMA decay for runqueue load (newer samples weigh 1/8).
-LOAD_ALPHA = 0.125
+LOAD_ALPHA = knobs.default("sched.credit2.load_alpha")
 
-DEFAULT_WEIGHT = 256
+DEFAULT_WEIGHT = knobs.default("sched.credit2.default_weight")
 
 
 @dataclasses.dataclass

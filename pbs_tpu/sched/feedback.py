@@ -42,23 +42,26 @@ from typing import TYPE_CHECKING
 
 import numpy as np
 
+from pbs_tpu import knobs
 from pbs_tpu.telemetry.counters import NUM_COUNTERS, Counter
-from pbs_tpu.utils.clock import MS, US
 
 if TYPE_CHECKING:
     from pbs_tpu.runtime.job import Job
     from pbs_tpu.runtime.partition import Partition
 
-# Constants from the reference (BASELINE.md).
-METRIC_TICK_PERIOD_NS = 1 * MS  # CSCHED_METRIC_TICK_PERIOD (s_c.c:55)
-WINDOW = 5  # event filter window (s_c.c:114)
-STABLE_LO = 0.70  # stability band (s_c.c:354-357)
-STABLE_HI = 1.30
-STALL_RATE_THRESHOLD = 100.0  # phase threshold (s_c.c:360-369)
-TSLICE_MIN_US = 100  # floor (s_c.c:286-300)
-TSLICE_MAX_US = 1_100  # cap of built variant
-GROW_STEP_US = 100
-SHRINK_SUB_US = 200
+# Constants from the reference (BASELINE.md), declared in the knob
+# registry (knobs/registry.py) — the defaults ARE the reference
+# values, so an unconfigured policy is bit-identical to the pre-knob
+# one; `pbst knobs` can retune a live policy through `apply_knobs`.
+METRIC_TICK_PERIOD_NS = knobs.default("sched.feedback.metric_tick_period_ns")
+WINDOW = knobs.default("sched.feedback.window")
+STABLE_LO = knobs.default("sched.feedback.stable_lo")
+STABLE_HI = knobs.default("sched.feedback.stable_hi")
+STALL_RATE_THRESHOLD = knobs.default("sched.feedback.stall_threshold")
+TSLICE_MIN_US = knobs.default("sched.feedback.tslice_min_us")
+TSLICE_MAX_US = knobs.default("sched.feedback.tslice_max_us")
+GROW_STEP_US = knobs.default("sched.feedback.grow_step_us")
+SHRINK_SUB_US = knobs.default("sched.feedback.shrink_sub_us")
 
 LOW_PHASE = "low"  # SPIN_LOW_PHASE: grow
 HIGH_PHASE = "high"  # SPIN_HIGH_PHASE: shrink
@@ -66,11 +69,11 @@ HIGH_PHASE = "high"  # SPIN_HIGH_PHASE: shrink
 # Gateway queue-delay feedback (docs/GATEWAY.md): an interactive
 # request waiting longer than this per event at the front door means
 # the serving tier is falling behind its SLO class.
-GW_QDELAY_THRESHOLD_NS = 2 * MS
+GW_QDELAY_THRESHOLD_NS = knobs.default("sched.feedback.qdelay_threshold_ns")
 # Consecutive over-threshold reports before the policy reacts —
 # sustained pressure, not one burst (the window-stability idea applied
 # to the serving-tier signal).
-GW_HOT_AFTER = 3
+GW_HOT_AFTER = knobs.default("sched.feedback.gw_hot_after")
 
 
 @dataclasses.dataclass
@@ -172,6 +175,10 @@ class FeedbackPolicy:
         "gw_hot_after",
     )
 
+    #: Registry policy key (knobs/profile.py PARAM_KNOBS): which knob
+    #: family maps onto this policy's constructor params.
+    KNOB_POLICY = "feedback"
+
     @classmethod
     def from_profile(cls, partition: "Partition",
                      profile: dict) -> "FeedbackPolicy":
@@ -186,6 +193,70 @@ class FeedbackPolicy:
                 f"profile carries unknown policy params "
                 f"{sorted(unknown)}; tunable: {list(cls.TUNABLE_PARAMS)}")
         return cls(partition, **params)
+
+    @classmethod
+    def from_knobs(cls, partition: "Partition",
+                   values: dict) -> "FeedbackPolicy":
+        """Build a policy from registry-named knob values (the knob
+        channel's snapshot surface, docs/KNOBS.md) — the load path a
+        tuned-profile-as-knob-file takes."""
+        from pbs_tpu.knobs import profile as knob_profile
+
+        return cls(partition,
+                   **knob_profile.knobs_to_params(cls.KNOB_POLICY,
+                                                  values))
+
+    def apply_knobs(self, values: dict) -> dict:
+        """Atomic live reconfiguration from a knob push (KnobWatcher
+        applier shape is ``lambda changed, _vals:
+        policy.apply_knobs(changed)``). ``values`` is keyed by registry
+        knob name; knobs outside this policy's mapping are ignored.
+
+        Validate-then-apply: the whole update is checked (the channel
+        already range-checked it; the band sanity re-check here guards
+        direct callers), then every field lands — and every live job's
+        slice plus the stale-fallback value are re-clamped into the
+        new band immediately, so "tslice within the armed band" stays
+        an invariant ACROSS a reconfiguration, not just between them.
+        Returns the constructor-param view of what changed."""
+        from pbs_tpu.knobs import profile as knob_profile
+        from pbs_tpu.knobs.registry import KnobError
+
+        params = knob_profile.knobs_to_params(self.KNOB_POLICY,
+                                              values)
+        params = {p: v for p, v in params.items()
+                  if p in self.TUNABLE_PARAMS}
+        if not params:
+            return {}
+        new_min = int(params.get("min_us", self.min_us))
+        new_max = int(params.get("max_us", self.max_us))
+        new_window = int(params.get("window", self.window_len))
+        if new_min > new_max:
+            raise KnobError(
+                [f"tslice band inverted: min {new_min} > max "
+                 f"{new_max} (push rejected, policy untouched)"])
+        if new_window < 1:
+            raise KnobError([f"window {new_window} < 1"])
+        self.min_us, self.max_us = new_min, new_max
+        # window_len moving resets each job's filter lazily: the next
+        # _submilli_update sees the length mismatch, reallocates, and
+        # restarts the fill — a band change never steers on a window
+        # sampled under the old config's phase semantics.
+        self.window_len = new_window
+        if "stall_threshold" in params:
+            self.stall_threshold = float(params["stall_threshold"])
+        if "grow_step_us" in params:
+            self.grow_step_us = int(params["grow_step_us"])
+        if "shrink_sub_us" in params:
+            self.shrink_sub_us = int(params["shrink_sub_us"])
+        if "qdelay_threshold_ns" in params:
+            self.qdelay_threshold_ns = int(params["qdelay_threshold_ns"])
+        if "gw_hot_after" in params:
+            self.gw_hot_after = int(params["gw_hot_after"])
+        self.fallback_us = self._clamp(self.fallback_us)
+        for job in self.partition.jobs:
+            job.params.tslice_us = self._clamp(job.params.tslice_us)
+        return params
 
     def state_of(self, job: "Job") -> JobMetricState:
         st = self.states.get(job.name)

@@ -50,16 +50,17 @@ from __future__ import annotations
 
 import dataclasses
 
+from pbs_tpu import knobs
 from pbs_tpu.sched.base import Decision, Scheduler, register_scheduler
 from pbs_tpu.utils.clock import MS, US
 
-# sched_sedf.c:37-43
-EXTRA_QUANTUM_NS = 500 * US
-WEIGHT_PERIOD_US = 100_000   # MILLISECS(100)
-WEIGHT_SAFETY_US = 5_000     # MILLISECS(5)
-PERIOD_MAX_US = 10_000_000
-PERIOD_MIN_US = 10
-SLICE_MIN_US = 5
+# sched_sedf.c:37-43, declared in the knob registry (sched.sedf.*).
+EXTRA_QUANTUM_NS = knobs.default("sched.sedf.extra_quantum_ns")
+WEIGHT_PERIOD_US = knobs.default("sched.sedf.weight_period_us")
+WEIGHT_SAFETY_US = knobs.default("sched.sedf.weight_safety_us")
+PERIOD_MAX_US = knobs.default("sched.sedf.period_max_us")
+PERIOD_MIN_US = knobs.default("sched.sedf.period_min_us")
+SLICE_MIN_US = knobs.default("sched.sedf.slice_min_us")
 
 # Run classes for the last dispatch (get_run_type, sched_sedf.c:1022-1037).
 RUN_EDF = "edf"

@@ -300,7 +300,16 @@ def check_profile(workload: str, tuned_dir: str | None = None,
     """
     prof = load_profile(workload, tuned_dir)
     chk = prof["check"]
-    got = check_block(workload, prof["policy"], prof["params"],
+    # THE knob-file load path (knobs/profile.py): map the params onto
+    # the registry (validating safe ranges + band pairs), map back,
+    # and replay the grid on the round-tripped values. Digest equality
+    # therefore ALSO witnesses that loading a profile as a knob file
+    # is lossless — a profile outside the declared safe ranges fails
+    # here, loudly, before it can reach a live system.
+    from pbs_tpu.knobs.profile import roundtrip_params
+
+    params = roundtrip_params(prof["policy"], dict(prof["params"]))
+    got = check_block(workload, prof["policy"], params,
                       base_seed=chk["base_seed"], workers=workers,
                       horizon_ns=chk["horizon_ns"],
                       n_reps=chk["n_reps"],
@@ -325,10 +334,16 @@ def check_profile(workload: str, tuned_dir: str | None = None,
 def policy_from_profile(partition, workload: str,
                         tuned_dir: str | None = None):
     """Arm the tuned policy for a workload class on a partition — the
-    load path a deployment uses (docs/TUNE.md "Loading")."""
+    load path a deployment uses (docs/TUNE.md "Loading"). Routes
+    through the knob registry (knobs/profile.py): the profile's params
+    are validated against the declared safe ranges exactly like a
+    ``pbst knobs`` push, so a hand-edited profile outside the bands
+    fails at load, not at 3 a.m."""
+    from pbs_tpu.knobs.profile import roundtrip_params
     from pbs_tpu.sched.atc import AtcFeedbackPolicy
     from pbs_tpu.sched.feedback import FeedbackPolicy
 
     prof = load_profile(workload, tuned_dir)
     cls = AtcFeedbackPolicy if prof["policy"] == "atc" else FeedbackPolicy
-    return cls.from_profile(partition, prof)
+    params = roundtrip_params(prof["policy"], dict(prof["params"]))
+    return cls.from_profile(partition, {"params": params})

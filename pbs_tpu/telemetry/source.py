@@ -27,15 +27,17 @@ from typing import Any, Callable, Protocol
 
 import numpy as np
 
+from pbs_tpu import knobs
 from pbs_tpu.faults import injector as _faults
 from pbs_tpu.telemetry.counters import NUM_COUNTERS, Counter
 from pbs_tpu.utils.clock import Clock, MonotonicClock, VirtualClock
 
 # Per-chip peaks used by the roofline stall estimator. Defaults are TPU
-# v5e-class; override per deployment. (The reference equivalently bakes
-# in per-family PMU capabilities, asm-x86/perfctr.h:40-65.)
-DEFAULT_PEAK_FLOPS = 197e12  # bf16 FLOP/s
-DEFAULT_PEAK_HBM_BW = 819e9  # bytes/s
+# v5e-class; override per deployment via the knob registry
+# (telemetry.source.*). (The reference equivalently bakes in per-family
+# PMU capabilities, asm-x86/perfctr.h:40-65.)
+DEFAULT_PEAK_FLOPS = knobs.default("telemetry.source.peak_flops")
+DEFAULT_PEAK_HBM_BW = knobs.default("telemetry.source.peak_hbm_bw")
 
 
 #: Channels a ``telemetry.counters`` 'stall' fault freezes: the

@@ -1,0 +1,13 @@
+"""Fixture twin of the policy module: TUNABLE_PARAMS drift seed.
+
+``bogus_step_us`` is tunable here but has no PARAM_KNOBS mapping —
+the registry cannot see it (knob-native-drift).
+"""
+
+
+class FeedbackPolicy:
+    TUNABLE_PARAMS = (
+        "min_us", "max_us", "window", "stall_threshold",
+        "grow_step_us", "shrink_sub_us", "qdelay_threshold_ns",
+        "gw_hot_after", "bogus_step_us",
+    )

@@ -1,0 +1,31 @@
+"""Seeded knob-discipline violations (docs/ANALYSIS.md)."""
+
+MS = 1_000_000
+
+# Tunable-shaped module constants defined as bare literals: invisible
+# to the registry. Flagged only when a hot-path body consumes them.
+SHED_WINDOW_THRESHOLD_NS = 2 * MS
+RETRY_PERIOD_NS = 40 * MS
+
+# Routed through a knob the registry does not declare.
+BOGUS_FLOOR_US = knobs.default("sched.nosuch.floor_us")
+
+# Routed, but the constant's suffix disagrees with the declared unit
+# (sched.feedback.tslice_min_us is declared in us).
+FLOOR_LIMIT_MS = knobs.default("sched.feedback.tslice_min_us")
+
+
+class MiniPolicy:
+    def _metric_tick(self, now_ns):
+        # knob-unrouted: a literal-defined tunable read on a hot path.
+        if now_ns > SHED_WINDOW_THRESHOLD_NS:
+            return RETRY_PERIOD_NS
+        return 0
+
+    def admit(self, cost, now_ns):
+        # knob-inline-tunable: an inline magic duration.
+        return 50 * MS if cost else 0
+
+    def cold_path_report(self):
+        # NOT flagged: same constants outside a hot body.
+        return SHED_WINDOW_THRESHOLD_NS + RETRY_PERIOD_NS

@@ -1,0 +1,24 @@
+"""Fixture twin of the C-ABI marshaller: mirror-drift seeds.
+
+Relative to the registry's ``native=`` declarations: the shrink knob
+is never marshalled, the grow knob lands in the WRONG word, and a
+py-only knob (qdelay) is marshalled as if the C core modeled it — all
+three are knob-native-drift findings. (No ``native/pbst_runtime.cc``
+exists under the fixture tree, so the .cc token check stays silent.)
+"""
+
+GS_MIN_US, GS_MAX_US, GS_GROW_STEP_US, GS_SHRINK_SUB_US = range(4)
+GS_WINDOW_LEN, GS_QDELAY, GF_STALL_THRESHOLD = 4, 5, 0
+
+
+def marshal(gs, gf, fb):
+    wlen = fb.window_len if fb is not None else 1
+    gs[GS_WINDOW_LEN] = wlen
+    gs[GS_MIN_US] = fb.min_us
+    gs[GS_MAX_US] = fb.max_us
+    # DRIFT: grow marshalled into the shrink word.
+    gs[GS_SHRINK_SUB_US] = fb.grow_step_us
+    # DRIFT: shrink_sub_us never marshalled at all.
+    # DRIFT: qdelay is declared native=None (py-only) yet marshalled.
+    gs[GS_QDELAY] = fb.qdelay_threshold_ns
+    gf[GF_STALL_THRESHOLD] = fb.stall_threshold

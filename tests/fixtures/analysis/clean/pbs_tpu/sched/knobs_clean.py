@@ -1,0 +1,22 @@
+"""Behavior twin of knobs_bad.py that follows the convention."""
+
+from pbs_tpu import knobs
+
+# Registry-routed tunables with suffixes matching the declared units.
+SHED_WINDOW_THRESHOLD_NS = knobs.default(
+    "sched.feedback.qdelay_threshold_ns")
+RETRY_PERIOD_NS = knobs.default("gateway.admission.shed_retry_ns")
+FLOOR_LIMIT_US = knobs.default("sched.feedback.tslice_min_us")
+
+
+class MiniPolicy:
+    def _metric_tick(self, now_ns):
+        # Routed constants are legal on the hot path — the registry
+        # knows them, `pbst knobs` can retune them.
+        if now_ns > SHED_WINDOW_THRESHOLD_NS:
+            return RETRY_PERIOD_NS
+        return 0
+
+    def admit(self, cost, now_ns):
+        # The inline 50*MS became a declared, routed knob.
+        return RETRY_PERIOD_NS if cost else 0

@@ -52,6 +52,8 @@ def test_all_rules_fire_on_bad_tree():
         "perf-rec-loop", "perf-emit-in-loop", "perf-dispatch-alloc",
         "perf-native-unchecked", "perf-native-sim-unguarded",
         "obs-unclosed-span", "obs-span-emit-in-loop", "obs-hist-scan",
+        "knob-unrouted", "knob-inline-tunable", "knob-unknown",
+        "knob-unit-drift", "knob-native-drift",
     }
 
 
@@ -113,7 +115,7 @@ def test_cli_list_passes(capsys):
     out = capsys.readouterr().out
     for pid in ("lock-discipline", "time-units", "sched-ops",
                 "counter-api", "gateway-discipline", "perf-discipline",
-                "obs-discipline"):
+                "obs-discipline", "knob-discipline"):
         assert pid in out
 
 

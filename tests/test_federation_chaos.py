@@ -76,6 +76,77 @@ def test_federation_chaos_deterministic_books():
     assert c["trace_digest"] != a["trace_digest"]
 
 
+#: Golden digests for the knob hot-reload scenario: SMOKE_KW plus
+#: KNOB_PLAN below. Regenerate with the snippet above passing
+#: ``knob_plan=KNOB_PLAN`` after an intentional change (the plain-run
+#: goldens must NOT move when only knob machinery changes — knob_plan
+#: =None keeps the digest payload byte-identical to the pre-knob one).
+GOLDEN_KNOB_TRACE_DIGEST = (
+    "6008549776c588ab5793c48e1943e6b4f8bf855177e613b3056a0413c3a3f479")
+GOLDEN_KNOB_REPORT_DIGEST = (
+    "70a4e8e2e16ac7308e0b54cc2f3fcc6b1fa5df543a808140eed8836e69f9fd5d")
+
+#: Mid-run hot-reloads: a tslice-band push, a rate throttle, an
+#: out-of-range and a malformed push (both must reject atomically —
+#: tick 160 is a renewal tick: renew_period is 4 ticks, so the
+#: rejected push lands racing a renewal round), then a rate restore.
+KNOB_PLAN = [
+    {"tick": 80, "set": {"sched.feedback.tslice_min_us": 200,
+                         "sched.feedback.tslice_max_us": 2000}},
+    {"tick": 120, "set": {"gateway.admission.rate_scale": 0.5}},
+    {"tick": 160, "set": {"gateway.admission.rate_scale": 1e9},
+     "expect": "rejected"},
+    {"tick": 164, "set": {"sched.feedback.window": "banana"},
+     "expect": "rejected"},
+    {"tick": 200, "set": {"gateway.admission.rate_scale": 2.0}},
+]
+
+
+def test_federation_chaos_knob_hot_reload_invariants_and_goldens():
+    """ISSUE 12 chaos gate: mid-run knob pushes over the file-backed
+    channel — band + bucket-rate reconfiguration plus atomically
+    rejected bad pushes — cannot violate no-job-lost or the (piecewise
+    scale-integrated) no-rate-inflation bound, and the whole response
+    replays to golden digests."""
+    r = run_federation_chaos(**SMOKE_KW, knob_plan=KNOB_PLAN)
+    assert r["problems"] == []
+    assert r["ok"] is True
+    applied = [e for e in r["knob_events"] if e["applied"]]
+    rejected = [e for e in r["knob_events"] if not e["applied"]]
+    assert [e["tick"] for e in applied] == [80, 120, 200]
+    assert [e["tick"] for e in rejected] == [160, 164]
+    assert all(e["errors"] for e in rejected)  # problems were reported
+    # The federation ADOPTED the applied pushes (digest-covered).
+    assert r["applied_knobs"]["gateway.admission.rate_scale"] == 2.0
+    assert r["applied_knobs"]["sched.feedback.tslice_max_us"] == 2000.0
+    knob_evs = [e for e in r["events"] if e["event"] == "knobs"]
+    assert len(knob_evs) == 3
+    st = r["stats"]
+    assert st["admitted"] == st["completed"] > 0  # no job lost
+    assert r["trace_digest"] == GOLDEN_KNOB_TRACE_DIGEST
+    assert r["report_digest"] == GOLDEN_KNOB_REPORT_DIGEST
+    # Digest determinism across a second run in the same session.
+    again = run_federation_chaos(**SMOKE_KW, knob_plan=KNOB_PLAN)
+    assert again["trace_digest"] == r["trace_digest"]
+    assert again["report_digest"] == r["report_digest"]
+    assert again["knob_events"] == r["knob_events"]
+
+
+def test_federation_chaos_throttle_actually_bites():
+    """The 0.5× rate window must show up in the books: the throttled
+    run mints measurably fewer tokens than the plain run (the push is
+    a real control input, not a logged no-op)."""
+    plain = run_federation_chaos(**SMOKE_KW)
+    throttled = run_federation_chaos(
+        **SMOKE_KW,
+        knob_plan=[{"tick": 80,
+                    "set": {"gateway.admission.rate_scale": 0.5}}])
+    minted = lambda r: sum(a["minted"]  # noqa: E731
+                           for a in r["lease_audit"].values())
+    assert throttled["ok"] and plain["ok"]
+    assert minted(throttled) < minted(plain)
+
+
 def test_federation_chaos_no_rate_inflation_books():
     """The audit identities the harness gates on, re-derived here so a
     report format drift cannot silently weaken the invariant."""

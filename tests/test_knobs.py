@@ -1,0 +1,456 @@
+"""pbs_tpu.knobs: typed registry, atomic hot-reload channel, profile
+mapping, and live policy reconfiguration (docs/KNOBS.md).
+
+The contracts under test:
+
+- the registry defaults ARE the former module literals (a spot-check
+  pins a few; bit-identical goldens elsewhere are the real witness);
+- a push is all-or-nothing: any malformed/out-of-range/band-inverted
+  value rejects the WHOLE batch with every problem listed, and the
+  channel file stays byte-identical (generation unmoved);
+- readers snapshot torn-free and watch() sees every generation;
+- tuned profiles round-trip the registry losslessly;
+- ``FeedbackPolicy.apply_knobs`` re-clamps live jobs into a new band
+  atomically, mid-run, under the virtual clock.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from pbs_tpu import knobs
+from pbs_tpu.knobs.channel import KnobChannel, KnobWatcher
+from pbs_tpu.knobs.profile import (
+    PARAM_KNOBS,
+    params_to_knobs,
+    roundtrip_params,
+)
+from pbs_tpu.knobs.registry import KnobError
+
+
+@pytest.fixture(autouse=True)
+def _clean_overlay():
+    knobs.reset_local()
+    yield
+    knobs.reset_local()
+
+
+# -- registry ----------------------------------------------------------------
+
+
+def test_defaults_are_the_former_literals():
+    assert knobs.default("sched.feedback.tslice_min_us") == 100
+    assert knobs.default("sched.feedback.tslice_max_us") == 1_100
+    assert knobs.default("sched.feedback.window") == 5
+    assert knobs.default("sched.atc.tslice_max_us") == 30_000
+    assert knobs.default("gateway.admission.default_rate") == 100.0
+    assert knobs.default("gateway.federation.renew_period_ns") == 4_000_000
+    assert knobs.default("runtime.doorbell.poll_ns") == 500_000
+    assert knobs.default("obs.trace.emit_batch_capacity") == 256
+    assert knobs.default("dist.rpc.max_retries") == 3
+
+
+def test_every_declaration_is_self_consistent():
+    for k in knobs.all_knobs():
+        assert k.lo <= k.default <= k.hi, k.name
+        assert k.subsystem == k.name.split(".", 1)[0]
+        # Name suffix vs declared unit (the registry's own convention).
+        leaf = k.name.rsplit(".", 1)[-1]
+        for suf in ("ns", "us", "ms"):
+            if leaf.endswith("_" + suf):
+                assert k.unit == suf, k.name
+
+
+def test_unknown_and_malformed_and_out_of_range():
+    with pytest.raises(KnobError):
+        knobs.knob("no.such.knob")
+    with pytest.raises(KnobError) as e:
+        knobs.validate_set({
+            "sched.feedback.window": "banana",       # malformed
+            "sched.feedback.tslice_min_us": 5,       # below safe lo
+            "no.such.knob": 1,                       # unknown
+        })
+    text = str(e.value)
+    assert "banana" in text and "outside safe range" in text \
+        and "no.such.knob" in text  # ALL problems, one report
+
+
+def test_band_pair_rejection_and_set_local_atomicity():
+    with pytest.raises(KnobError, match="band inverted"):
+        knobs.validate_set({"sched.feedback.tslice_min_us": 5_000})
+    # Atomic: the failing batch applies nothing, even its valid half.
+    with pytest.raises(KnobError):
+        knobs.set_local({"sched.feedback.grow_step_us": 50,
+                         "sched.feedback.window": 10**9})
+    assert knobs.get("sched.feedback.grow_step_us") == 100
+    knobs.set_local({"sched.feedback.grow_step_us": 50})
+    assert knobs.get("sched.feedback.grow_step_us") == 50
+    assert knobs.default("sched.feedback.grow_step_us") == 100
+
+
+def test_int_knob_rejects_fractions_and_bools():
+    assert knobs.check_value(knobs.knob("sched.feedback.window"), 1.5)
+    assert knobs.check_value(knobs.knob("sched.feedback.window"), True)
+    assert knobs.check_value(knobs.knob("sched.feedback.window"),
+                             float("nan"))
+    assert not knobs.check_value(knobs.knob("sched.feedback.window"), 6.0)
+
+
+# -- channel -----------------------------------------------------------------
+
+
+def _channel(tmp_path):
+    return KnobChannel.create(str(tmp_path / "knobs.led"))
+
+
+def test_channel_roundtrip_and_generation(tmp_path):
+    ch = _channel(tmp_path)
+    gen0, vals = ch.snapshot()
+    assert gen0 == 0
+    assert vals == knobs.snapshot()  # created from the declarations
+    assert ch.push({"sched.feedback.tslice_min_us": 200}) == 1
+    ro = KnobChannel.attach(str(tmp_path / "knobs.led"))
+    gen, vals = ro.snapshot()
+    assert gen == 1
+    assert vals["sched.feedback.tslice_min_us"] == 200
+    assert isinstance(vals["sched.feedback.tslice_min_us"], int)
+    assert isinstance(vals["gateway.admission.rate_scale"], float)
+
+
+def test_rejected_push_is_atomic_bytes_identical(tmp_path):
+    path = str(tmp_path / "knobs.led")
+    ch = KnobChannel.create(path)
+    ch.push({"sched.feedback.grow_step_us": 150})
+    before = open(path, "rb").read()
+    for bad in (
+        {"sched.feedback.window": "banana"},
+        {"gateway.admission.rate_scale": 1e9},
+        {"sched.feedback.grow_step_us": 50, "no.such.knob": 1},
+        {"sched.feedback.tslice_min_us": 5_000},  # band inversion
+        {},
+    ):
+        with pytest.raises(KnobError):
+            ch.push(bad)
+    assert open(path, "rb").read() == before  # byte-identical file
+    assert ch.generation == 1
+
+
+def test_wedged_channel_refuses_push_and_init_recovers(tmp_path):
+    """A writer crash mid-push leaves the seqlock version odd. The
+    next push must refuse loudly (writing on top would mark an
+    in-progress write as stable and let readers accept torn
+    snapshots); `pbst knobs init` recreates the channel clean."""
+    from pbs_tpu.cli.pbst import main
+
+    path = str(tmp_path / "knobs.led")
+    ch = KnobChannel.create(path)
+    ch.push({"sched.feedback.grow_step_us": 150})
+    # Simulate the crash: flip the version word odd.
+    ch._store(2, ch._words(2, 1)[0] + 1)
+    with pytest.raises(KnobError, match="wedged"):
+        ch.push({"sched.feedback.grow_step_us": 50})
+    with pytest.raises(KnobError, match="retries exhausted"):
+        KnobChannel.attach(path).snapshot(max_retries=4)
+    assert main(["knobs", "init", "--channel", path]) == 0  # recovery
+    gen, vals = KnobChannel.attach(path).snapshot()
+    assert gen == 0 and vals == knobs.snapshot()
+
+
+def test_reader_attach_cannot_push(tmp_path):
+    path = str(tmp_path / "knobs.led")
+    KnobChannel.create(path)
+    ro = KnobChannel.attach(path)
+    with pytest.raises(KnobError, match="read-only"):
+        ro.push({"sched.feedback.grow_step_us": 50})
+
+
+def test_channel_poll_and_watcher_applies_changes(tmp_path):
+    path = str(tmp_path / "knobs.led")
+    w = KnobChannel.create(path)
+    watcher = KnobWatcher(KnobChannel.attach(path))
+    seen: list[dict] = []
+    watcher.add(lambda changed, values: seen.append(dict(changed)))
+    assert watcher.poll() is None
+    w.push({"gateway.admission.rate_scale": 0.5})
+    w.push({"sched.feedback.window": 3})
+    # One poll coalesces both generations into the latest view.
+    changed = watcher.poll()
+    assert changed == {"gateway.admission.rate_scale": 0.5,
+                       "sched.feedback.window": 3}
+    assert seen == [changed]
+    assert watcher.poll() is None
+
+
+def test_watch_loop_bounded(tmp_path):
+    path = str(tmp_path / "knobs.led")
+    w = KnobChannel.create(path)
+    ro = KnobChannel.attach(path)
+    w.push({"sched.feedback.window": 4})
+    events = []
+    n = ro.watch(lambda gen, vals: events.append(gen),
+                 timeout_s=1.0, poll_interval_s=0.01, max_events=1)
+    assert n == 1 and events == [1]  # initial snapshot = current truth
+    # Nothing new: without the initial emission, timeout returns clean.
+    assert ro.watch(lambda *_: None, timeout_s=0.05,
+                    poll_interval_s=0.01, initial=False) == 0
+
+
+def test_channel_meta_sidecar_guards_attach(tmp_path):
+    path = str(tmp_path / "knobs.led")
+    KnobChannel.create(path)
+    meta_path = path + ".meta.json"
+    meta = json.load(open(meta_path))
+    meta["knobs"].append({"name": "not.a.knob", "kind": "int",
+                          "unit": ""})
+    json.dump(meta, open(meta_path, "w"))
+    with pytest.raises(KnobError, match="does not declare"):
+        KnobChannel.attach(path)
+    os.remove(meta_path)
+    with pytest.raises(KnobError, match="sidecar"):
+        KnobChannel.attach(path)
+
+
+# -- tuned profiles as knob files -------------------------------------------
+
+
+def test_every_checked_in_profile_roundtrips():
+    from pbs_tpu.sched import tune
+
+    for wl in tune.tuned_workloads():
+        prof = tune.load_profile(wl)
+        params = dict(prof["params"])
+        assert roundtrip_params(prof["policy"], params) == params
+
+
+def test_param_mapping_covers_tunable_params_exactly():
+    from pbs_tpu.sched.atc import AtcFeedbackPolicy
+    from pbs_tpu.sched.feedback import FeedbackPolicy
+
+    assert set(PARAM_KNOBS["feedback"]) == set(
+        FeedbackPolicy.TUNABLE_PARAMS)
+    assert set(PARAM_KNOBS["atc"]) == set(AtcFeedbackPolicy.TUNABLE_PARAMS)
+    for policy, mapping in PARAM_KNOBS.items():
+        for knob_name in mapping.values():
+            assert knobs.exists(knob_name), (policy, knob_name)
+
+
+def test_out_of_range_profile_fails_loudly():
+    with pytest.raises(KnobError, match="outside safe range"):
+        params_to_knobs("feedback", {"min_us": 1})  # below declared lo
+    with pytest.raises(KnobError, match="no declared knob"):
+        params_to_knobs("feedback", {"warp_factor": 9})
+
+
+def test_registry_native_symbols_exist_in_both_sources():
+    """The C-ABI mirror the knob-discipline pass enforces statically,
+    re-checked here against the real files."""
+    core = open("pbs_tpu/sim/native_core.py").read()
+    cc = open("native/pbst_runtime.cc").read()
+    declared = [k for k in knobs.all_knobs() if k.native]
+    assert declared, "registry declares no native symbols?"
+    for k in declared:
+        assert k.native in core, k.name
+        assert k.native in cc, k.name
+
+
+# -- live policy reconfiguration --------------------------------------------
+
+
+def _policy_setup(tslice_us=500):
+    from pbs_tpu.runtime import Job, Partition, SchedParams
+    from pbs_tpu.sched.feedback import FeedbackPolicy
+    from pbs_tpu.telemetry import SimBackend, SimProfile
+
+    be = SimBackend()
+    part = Partition("t", source=be, scheduler="credit")
+    fb = FeedbackPolicy(part)
+    prof = SimProfile.steady(step_time_ns=100_000, stall_frac=0.5,
+                             collective_wait_ns=1_000)
+    be.register("w", prof)
+    job = Job("w", params=SchedParams(tslice_us=tslice_us),
+              max_steps=10_000_000)
+    job.contexts[0].avg_step_ns = 100_000
+    part.add_job(job)
+    return part, fb, job
+
+
+def test_apply_knobs_reclamps_live_jobs_and_rejects_inverted_band():
+    part, fb, job = _policy_setup(tslice_us=900)
+    applied = fb.apply_knobs({"sched.feedback.tslice_min_us": 200,
+                              "sched.feedback.tslice_max_us": 400,
+                              "sched.feedback.window": 3})
+    assert applied == {"min_us": 200, "max_us": 400, "window": 3}
+    assert (fb.min_us, fb.max_us, fb.window_len) == (200, 400, 3)
+    assert job.params.tslice_us == 400  # re-clamped immediately
+    before = (fb.min_us, fb.max_us)
+    with pytest.raises(KnobError, match="band inverted"):
+        fb.apply_knobs({"sched.feedback.tslice_min_us": 500})
+    assert (fb.min_us, fb.max_us) == before  # rejected atomically
+    # Knobs outside this policy's mapping are ignored, not errors.
+    assert fb.apply_knobs({"gateway.admission.rate_scale": 0.5}) == {}
+
+
+def test_live_band_push_steers_a_running_policy(tmp_path):
+    """Mid-run hot-reload under the virtual clock: a memory-bound job
+    grows to the OLD cap, the band push lands over the channel through
+    a partition-timer KnobWatcher poll, and the slice follows into the
+    NEW band without a restart — the adopt-tuned-profiles-live story
+    (ROADMAP 3)."""
+    part, fb, job = _policy_setup(tslice_us=200)
+    path = str(tmp_path / "knobs.led")
+    writer = KnobChannel.create(path)
+    watcher = KnobWatcher(KnobChannel.attach(path))
+    watcher.add(lambda changed, _vals: fb.apply_knobs(changed))
+    part.timers.arm(1_000_000, lambda now: watcher.poll(),
+                    period_ns=1_000_000, name="knob_watch")
+    part.run(until_ns=200_000_000)
+    assert job.params.tslice_us == 1_100  # grown to the default cap
+    writer.push({"sched.feedback.tslice_min_us": 200,
+                 "sched.feedback.tslice_max_us": 2_000})
+    part.run(until_ns=400_000_000)
+    assert fb.max_us == 2_000
+    assert job.params.tslice_us == 2_000  # kept growing into new band
+    assert watcher.applied >= 1
+
+
+def test_from_knobs_builds_policy_from_channel_surface():
+    from pbs_tpu.runtime import Partition
+    from pbs_tpu.sched.feedback import FeedbackPolicy
+    from pbs_tpu.telemetry import SimBackend
+
+    part = Partition("t2", source=SimBackend(), scheduler="credit")
+    fb = FeedbackPolicy.from_knobs(part, {
+        "sched.feedback.tslice_min_us": 200,
+        "sched.feedback.tslice_max_us": 2_000,
+        "sched.feedback.window": 3,
+    })
+    assert (fb.min_us, fb.max_us, fb.window_len) == (200, 2_000, 3)
+
+
+# -- broker rate scale -------------------------------------------------------
+
+
+def test_lease_broker_rate_scale_settles_then_switches():
+    from pbs_tpu.gateway.admission import TenantQuota
+    from pbs_tpu.gateway.federation import LeaseBroker
+
+    SEC = 1_000_000_000
+    b = LeaseBroker()
+    b.register("t", TenantQuota(rate=100.0, burst=50.0), now_ns=0)
+    # Drain the initial burst so minting becomes observable.
+    assert b.grant("t", "gw", 50.0, 0, SEC).tokens == 50.0
+    # 1 s at scale 1.0 -> 50 tokens (burst-capped): minted 50+50.
+    b.set_rate_scale(0.5, 1 * SEC)
+    bank = b.banks["t"]
+    assert bank.minted == pytest.approx(100.0)
+    assert bank.rate == 50.0
+    # 1 s at scale 0.5 -> 50 more capacity but only 50 headroom left
+    # after the grant below empties it again.
+    assert b.grant("t", "gw", 100.0, 1 * SEC, SEC).tokens == \
+        pytest.approx(50.0)
+    b.set_rate_scale(1.0, 2 * SEC)
+    assert bank.minted == pytest.approx(100.0 + 50.0)
+    # Registration AFTER a scale push rides the live scale.
+    b.register("u", TenantQuota(rate=100.0, burst=10.0), now_ns=2 * SEC)
+    assert b.banks["u"].rate == 100.0
+    with pytest.raises(KnobError):
+        b.set_rate_scale(0.0, 2 * SEC)
+
+
+@pytest.mark.slow
+def test_channel_snapshot_never_tears_under_live_writer(tmp_path):
+    """Soak: a writer pushing band updates as fast as it can while a
+    reader snapshots continuously. Every snapshot must be one of the
+    pushed states (min, max always from the same push — the seqlock
+    contract), and the generation must be monotone."""
+    import threading
+
+    path = str(tmp_path / "knobs.led")
+    w = KnobChannel.create(path)
+    ro = KnobChannel.attach(path)
+    pairs = [(100 + i, 1_100 + i) for i in range(400)]
+    stop = threading.Event()
+
+    def writer():
+        for lo, hi in pairs:
+            w.push({"sched.feedback.tslice_min_us": lo,
+                    "sched.feedback.tslice_max_us": hi})
+        stop.set()
+
+    legal = {(100, 1_100), *pairs}
+    torn = []
+    last_gen = -1
+    t = threading.Thread(target=writer)
+    t.start()
+    while not stop.is_set():
+        gen, vals = ro.snapshot()
+        pair = (vals["sched.feedback.tslice_min_us"],
+                vals["sched.feedback.tslice_max_us"])
+        if pair not in legal:
+            torn.append((gen, pair))
+        assert gen >= last_gen
+        last_gen = gen
+    t.join()
+    assert torn == []
+    assert ro.generation == len(pairs)
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def test_cli_knobs_get_set_watch_roundtrip(tmp_path, capsys):
+    """The tier-1 smoke the ISSUE pins: list, init, set (applied +
+    atomically rejected), get, and a bounded watch — all over one
+    channel file, well under the 5 s budget."""
+    from pbs_tpu.cli.pbst import main
+
+    ch = str(tmp_path / "knobs.led")
+    assert main(["knobs", "list"]) == 0
+    out = capsys.readouterr().out
+    assert "sched.feedback.tslice_min_us" in out
+    assert main(["knobs", "init", "--channel", ch]) == 0
+    capsys.readouterr()
+    assert main(["knobs", "set", "sched.feedback.tslice_min_us=200",
+                 "sched.feedback.tslice_max_us=2000",
+                 "--channel", ch]) == 0
+    assert "generation 1" in capsys.readouterr().out
+    # Malformed + out-of-range pushes exit 1, apply nothing.
+    assert main(["knobs", "set", "sched.feedback.window=banana",
+                 "--channel", ch]) == 1
+    assert main(["knobs", "set", "gateway.admission.rate_scale=1e9",
+                 "--channel", ch]) == 1
+    capsys.readouterr()
+    assert main(["knobs", "get", "sched.feedback.tslice_min_us",
+                 "--channel", ch, "--json"]) == 0
+    got = json.loads(capsys.readouterr().out)
+    assert got == {"sched.feedback.tslice_min_us": 200}
+    # watch sees the already-pending generation, then times out clean.
+    assert main(["knobs", "watch", "--channel", ch, "--timeout", "0.2",
+                 "--max-events", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "generation 1" in out and "tslice_min_us=200" in out
+    # unknown name is a usage error, not a silent empty answer
+    assert main(["knobs", "get", "no.such.knob"]) == 2
+
+
+def test_cli_knobs_load_profile_dry_and_push(tmp_path, capsys):
+    from pbs_tpu.cli.pbst import main
+
+    assert main(["knobs", "load-profile", "contended"]) == 0
+    dry = capsys.readouterr().out
+    assert "sched.feedback.tslice_min_us=" in dry
+    ch = str(tmp_path / "knobs.led")
+    assert main(["knobs", "load-profile", "contended",
+                 "--channel", ch]) == 0
+    capsys.readouterr()
+    assert main(["knobs", "get", "sched.feedback.window",
+                 "--channel", ch]) == 0
+    # The contended profile's tuned window rides the channel now.
+    from pbs_tpu.sched import tune
+
+    prof = tune.load_profile("contended")
+    assert capsys.readouterr().out.strip() == \
+        f"sched.feedback.window={prof['params']['window']}"
