@@ -25,6 +25,7 @@ from pbs_tpu.analysis.locks import LockDisciplinePass
 from pbs_tpu.analysis.netdiscipline import NetDisciplinePass
 from pbs_tpu.analysis.obspass import ObsDisciplinePass
 from pbs_tpu.analysis.perfpass import PerfDisciplinePass
+from pbs_tpu.analysis.rolloutpass import RolloutDisciplinePass
 from pbs_tpu.analysis.schedops import SchedOpsPass
 from pbs_tpu.analysis.units import TimeUnitPass
 
@@ -39,6 +40,7 @@ ALL_PASSES: tuple[type[Pass], ...] = (
     PerfDisciplinePass,
     ObsDisciplinePass,
     KnobDisciplinePass,
+    RolloutDisciplinePass,
 )
 
 

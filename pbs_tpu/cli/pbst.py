@@ -1355,6 +1355,123 @@ def cmd_gateway(args) -> int:
     return 0 if report["ok"] else 1
 
 
+def _autopilot_history_lines(history: list) -> list[str]:
+    out = []
+    for e in history:
+        t_ms = e.get("t_ns", 0) / 1e6
+        line = f"  t={t_ms:>8.1f}ms {e['event']:<9}"
+        if e["event"] == "propose":
+            line += (f" workload={e.get('workload')} "
+                     f"margin_x1e6={e.get('margin_x1e6')}"
+                     + (" INJECTED" if e.get("injected") else ""))
+        elif e["event"] == "canary":
+            line += f" members={','.join(e.get('members', []))}"
+        elif e["event"] in ("promote", "rollback"):
+            burns = e.get("burns") or {}
+            worst = max(burns.values(), default=0.0)
+            line += f" members={','.join(e.get('members', []))}"
+            if e["event"] == "rollback":
+                line += f" reason={e.get('reason')}"
+            line += f" worst_burn={worst}"
+        elif e["event"] == "hold":
+            if "reason" in e:
+                line += f" reason={e['reason']}"
+            if e.get("margin_x1e6") is not None:
+                line += f" margin_x1e6={e['margin_x1e6']}"
+        out.append(line)
+    return out
+
+
+def cmd_autopilot(args) -> int:
+    """Shadow-replay self-tuning loop (docs/AUTOPILOT.md).
+
+    ``run --demo`` drives one seeded end-to-end loop on a virtual
+    clock (3-member federation, catalog arrivals, quick shadow search,
+    canary, promote/rollback) and prints — or writes with ``--out`` —
+    the decision report; ``--pathological`` injects the adversarially
+    bad candidate and therefore demonstrates the guarded rollback.
+    ``status``/``history`` render a written report. Exit 0 = loop ran
+    to completion and the federation drained."""
+    if args.action == "run":
+        if not args.demo:
+            print("pbst: only `autopilot run --demo` is wired to a "
+                  "self-contained loop; a live deployment embeds "
+                  "pbs_tpu.autopilot.Autopilot in its own pump "
+                  "(docs/AUTOPILOT.md)", file=sys.stderr)
+            return 2
+        from pbs_tpu.autopilot import run_autopilot_demo
+
+        report = run_autopilot_demo(seed=args.seed, ticks=args.ticks,
+                                    pathological=args.pathological)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1, sort_keys=True)
+                f.write("\n")
+        if args.json:
+            print(json.dumps(report, indent=1, sort_keys=True))
+        else:
+            st = report["status"]
+            print(f"autopilot demo seed={report['seed']} "
+                  f"ticks={report['ticks']} "
+                  f"pathological={report['pathological']}")
+            print(f"state={st['state']} rounds={st['rounds']} "
+                  f"recorded={st['recorded_arrivals']} "
+                  f"adoptions={st['adoptions']}")
+            for line in _autopilot_history_lines(report["history"]):
+                print(line)
+            s = report["stats"]
+            print(f"admitted={s['admitted']} "
+                  f"completed={s['completed']} "
+                  f"drained={s['drained']}")
+        ok = report["stats"]["drained"] and \
+            report["status"]["state"] == "done"
+        return 0 if ok else 1
+
+    # status / history read a written report artifact.
+    if not args.state:
+        print("pbst: autopilot status/history need --state FILE "
+              "(written by `autopilot run --demo --out FILE`)",
+              file=sys.stderr)
+        return 2
+    try:
+        with open(args.state) as f:
+            report = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"pbst: bad --state {args.state!r}: {e}", file=sys.stderr)
+        return 2
+    if args.action == "status":
+        if args.json:
+            print(json.dumps(report.get("status", {}), indent=1,
+                             sort_keys=True))
+        else:
+            st = report.get("status", {})
+            print(f"state={st.get('state')} rounds={st.get('rounds')} "
+                  f"decisions={','.join(st.get('decisions', []))}")
+            print(f"recorded={st.get('recorded_arrivals')} "
+                  f"dropped={st.get('dropped_arrivals')} "
+                  f"adoptions={st.get('adoptions')}")
+            for k, v in sorted(st.get("reference", {}).items()):
+                print(f"  reference {k}={v}")
+        return 0
+    if args.action == "history":
+        history = report.get("history", [])
+        if args.json:
+            print(json.dumps(history, indent=1, sort_keys=True))
+        else:
+            for line in _autopilot_history_lines(history):
+                print(line)
+            print(f"{len(history)} decision event(s)")
+        return 0
+    print(f"pbst: unknown autopilot action {args.action!r}",
+          file=sys.stderr)
+    return 2
+
+
+def autopilot_entry() -> None:
+    """Console entry ``pbst-autopilot``."""
+    sys.exit(main(["autopilot", *sys.argv[1:]]))
+
+
 def cmd_tune(args) -> int:
     """Simulation-driven policy autotuning (pbs_tpu.sched.tune;
     docs/TUNE.md). Default: run the successive-halving search for the
@@ -1769,6 +1886,26 @@ def main(argv=None) -> int:
     g.add_argument("--file", help="obs dump JSON; default: this process")
     g.add_argument("--cmdline", help="apply a 'k=v k2 no-k3' string first")
     sp.set_defaults(fn=cmd_params)
+
+    sp = sub.add_parser(
+        "autopilot", help="shadow-replay self-tuning loop "
+                          "(docs/AUTOPILOT.md)")
+    sp.add_argument("action", choices=["run", "status", "history"])
+    sp.add_argument("--demo", action="store_true",
+                    help="run: the self-contained seeded demo loop "
+                         "(virtual clock, ≤5 s)")
+    sp.add_argument("--pathological", action="store_true",
+                    help="run --demo: inject the adversarially bad "
+                         "candidate (demonstrates guarded rollback)")
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--ticks", type=int, default=260)
+    sp.add_argument("--out", metavar="FILE",
+                    help="run: also write the report JSON here")
+    sp.add_argument("--state", metavar="FILE",
+                    help="status/history: report written by "
+                         "`autopilot run --demo --out FILE`")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_autopilot)
 
     sp = sub.add_parser(
         "knobs", help="typed knob registry + atomic hot-reload "

@@ -28,6 +28,7 @@ point                 fault kinds                                 seam
 ``gateway.death``     kill                                        gateway/federation.py
 ``gateway.partition``  partition                                  gateway/federation.py
 ``lease.expire``      expire                                      gateway/federation.py
+``autopilot.candidate``  pathological                             autopilot/pilot.py
 ====================  ==========================================  ==============
 """
 
@@ -51,6 +52,7 @@ POINTS: dict[str, tuple[str, ...]] = {
     "gateway.death": ("kill",),
     "gateway.partition": ("partition",),
     "lease.expire": ("expire",),
+    "autopilot.candidate": ("pathological",),
 }
 
 
@@ -216,4 +218,22 @@ class FaultPlan:
             FaultSpec("gateway.admit", "shed", p=0.01,
                       args={"retry_after_ns": 10_000_000}),
             FaultSpec("gateway.route", "misroute", p=0.05),
+        )).validate()
+
+    @classmethod
+    def autopilot(cls, seed: int = 0) -> "FaultPlan":
+        """The autopilot chaos plan (docs/AUTOPILOT.md): the full
+        federation attack PLUS an adversarially bad candidate injected
+        at the ``autopilot.candidate`` seam — deterministically, on
+        the first proposal (p=1, once). Every pathological value is
+        inside the registry's declared safe ranges, so nothing but the
+        SLO-burn canary guard stands between it and the fleet; the
+        invariant the chaos gate pins is that the guard ROLLS IT BACK
+        to the reference profile within the guard window while
+        no-job-lost and the piecewise mint bound keep holding."""
+        base = cls.federation(seed)
+        return cls(seed=seed, specs=(
+            FaultSpec("autopilot.candidate", "pathological", p=1.0,
+                      times=1),
+            *base.specs,
         )).validate()

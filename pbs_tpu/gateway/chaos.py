@@ -281,7 +281,8 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
                          trace_path: str | None = None,
                          drain_rejoin: bool = True,
                          obs_dir: str | None = None,
-                         knob_plan: list[dict] | None = None) -> dict:
+                         knob_plan: list[dict] | None = None,
+                         autopilot: "bool | dict | None" = None) -> dict:
     """One seeded federated-gateway chaos scenario; returns the report
     dict (``ok`` = every invariant held). Gateway deaths, partitions,
     and lease expiries come from the armed plan; a drain of a seeded
@@ -300,12 +301,41 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
     invariants must hold across every push; the mint bound integrates
     the rate-scale timeline piecewise. With ``knob_plan=None`` the
     run — and both digests — are byte-identical to the pre-knob
-    harness."""
-    plan = plan if plan is not None else FaultPlan.federation(seed)
+    harness.
+
+    ``autopilot`` (True, or an ``AutopilotConfig`` kwargs dict) arms
+    the FULL closed loop (docs/AUTOPILOT.md): shadow capture at the
+    submit surface, a quick shadow search, and an SLO-burn-guarded
+    canary rollout over a real knob channel — under the
+    ``FaultPlan.autopilot`` plan by default, whose deterministic
+    ``autopilot.candidate`` injection replaces the first proposal with
+    an adversarially bad (in-range!) profile. The gate this proves:
+    the pathological candidate ROLLS BACK to the reference profile
+    within the guard window, every member ends on the reference
+    values, and no-job-lost + the piecewise mint bound hold
+    throughout; the loop's every decision and member adoption is
+    keyed into the report digest. ``autopilot=None`` keeps the digest
+    payload byte-identical to the pre-autopilot harness."""
+    # Armed on any non-None, non-False value: autopilot={} means "the
+    # default-configured loop", not "off" (truthiness would silently
+    # disarm it).
+    ap_armed = autopilot is not None and autopilot is not False
+    if knob_plan and ap_armed:
+        # Each arms its own knob channel and the federation holds
+        # exactly one (attach_knobs refuses a second — a silently
+        # orphaned channel would validate pushes nobody adopts).
+        raise ValueError(
+            "knob_plan and autopilot are mutually exclusive: both "
+            "own the federation's knob channel")
+    if plan is None:
+        plan = (FaultPlan.autopilot(seed) if ap_armed
+                else FaultPlan.federation(seed))
     inj = faults_mod.install(plan, trace_path=trace_path)
     problems: list[str] = []
     knob_events: list[dict] = []
     knob_dir = None
+    ap_dir = None
+    pilot = None
     try:
         clock = VirtualClock()
         members = [
@@ -348,12 +378,34 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
                 pushes_by_tick.setdefault(int(entry["tick"]),
                                           []).append(entry)
 
+        if ap_armed:
+            import tempfile
+
+            from pbs_tpu.autopilot import Autopilot, AutopilotConfig
+            from pbs_tpu.knobs.channel import KnobChannel
+
+            ap_dir = tempfile.mkdtemp(prefix="pbst-autopilot-")
+            ap_writer = KnobChannel.create(f"{ap_dir}/knobs.led")
+            overrides = dict(autopilot) if isinstance(autopilot, dict) \
+                else {}
+            # Loop cadence sized to the run: record a third, guard a
+            # third — the guard must exceed the tightest SLO target
+            # (50 ms interactive) with real margin, or in-window
+            # requests cannot age past it and every verdict collapses
+            # to no-evidence; the whole decision still lands well
+            # inside the horizon, rollback included.
+            overrides.setdefault("min_record_ns", (ticks // 3) * tick_ns)
+            overrides.setdefault("guard_window_ns",
+                                 (ticks // 3) * tick_ns)
+            pilot = Autopilot(fed, ap_writer,
+                              config=AutopilotConfig(**overrides))
+
         def _push_knobs(tick: int) -> None:
             for entry in pushes_by_tick.get(tick, ()):
                 expect_reject = entry.get("expect") == "rejected"
                 gen_before = knob_writer.generation
                 try:
-                    gen = knob_writer.push(dict(entry["set"]))
+                    gen = knob_writer.push(dict(entry["set"]))  # pbst: ignore[rollout-push] -- chaos harness IS the adversary: the knob plan injects raw mid-run pushes to prove the consumers survive them; production writers go through autopilot/canary.py
                     applied, errors = True, []
                 except KnobError as e:
                     applied, errors = False, list(e.problems)
@@ -428,6 +480,8 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
                             f"shed of {t.name} at tick {tick} carries "
                             f"no retry-after ({r.reason})")
             completions.extend(fed.tick())
+            if pilot is not None:
+                pilot.tick()
             if tick % 50 == 0:
                 _check_books(f"tick {tick}")
             clock.advance(tick_ns)
@@ -438,6 +492,8 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
             if not fed.busy():
                 break
             completions.extend(fed.tick())
+            if pilot is not None:
+                pilot.tick()
             clock.advance(tick_ns)
 
         _check_books("end")
@@ -515,6 +571,49 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
             problems.append(
                 f"shed accounting drift: {shed_results} shed results, "
                 f"{shed_books} in the books")
+
+        if pilot is not None:
+            # THE autopilot gate: a pathological (injected) candidate
+            # must degrade to the reference profile inside the guard
+            # window — never ride out the run, never cause an outage
+            # (the no-job-lost check above already covers "outage").
+            injected = [e for e in pilot.history
+                        if e["event"] == "propose" and e.get("injected")]
+            rollbacks = [e for e in pilot.history
+                         if e["event"] == "rollback"]
+            canaries = [e for e in pilot.history
+                        if e["event"] == "canary"]
+            if injected and not rollbacks:
+                problems.append(
+                    "autopilot: injected pathological candidate was "
+                    f"never rolled back (history: "
+                    f"{[e['event'] for e in pilot.history]})")
+            if injected and rollbacks and canaries:
+                window = pilot.config.guard_window_ns + 2 * tick_ns
+                if rollbacks[0]["t_ns"] - canaries[0]["t_ns"] > window:
+                    problems.append(
+                        "autopilot: rollback landed "
+                        f"{rollbacks[0]['t_ns'] - canaries[0]['t_ns']}"
+                        f" ns after the canary — outside the guard "
+                        f"window ({window} ns)")
+            promoted_after = [e for e in pilot.history
+                              if e["event"] == "promote"
+                              and rollbacks
+                              and e["t_ns"] > rollbacks[-1]["t_ns"]]
+            if rollbacks and not promoted_after:
+                # Degraded-to-reference means every member's adopted
+                # profile IS the reference again.
+                ref = pilot.canary.reference
+                for name in sorted(fed.members):
+                    adopted = fed.members[name].applied_knobs
+                    drift = {k: (adopted.get(k), v)
+                             for k, v in ref.items()
+                             if adopted.get(k) != v}
+                    if drift:
+                        problems.append(
+                            f"autopilot: member {name} not on the "
+                            f"reference profile after rollback: "
+                            f"{drift}")
         # THE federation span invariant: one continuous, gap-free
         # chain per admitted rid even across gateway.death /
         # gateway.partition / drain+rejoin — custody transfers stitch,
@@ -526,10 +625,12 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
         })
     finally:
         faults_mod.uninstall()
-        if knob_dir is not None:
+        if knob_dir is not None or ap_dir is not None:
             import shutil
 
-            shutil.rmtree(knob_dir, ignore_errors=True)
+            for d in (knob_dir, ap_dir):
+                if d is not None:
+                    shutil.rmtree(d, ignore_errors=True)
 
     fault_counts: dict[str, int] = {}
     for rec in inj.records:
@@ -558,6 +659,22 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
         digest_payload["applied_knobs"] = {
             k: round(float(v), 6)
             for k, v in sorted(fed.applied_knobs.items())}
+    if pilot is not None:
+        # Autopilot-armed runs witness the LOOP'S RESPONSE: every
+        # decision (candidate, scores, margin, guard verdict) and
+        # every member adoption — same-seed-same-digest therefore
+        # pins the rollback itself. Keyed in only when armed, so
+        # plain runs keep their pre-autopilot digests byte-identical.
+        digest_payload["autopilot_events"] = [
+            {k: (dict(sorted(v.items()))
+                 if isinstance(v, dict) else v)
+             for k, v in sorted(e.items())}
+            for e in pilot.history]
+        digest_payload["knob_adoptions"] = [
+            {"now_ns": a["now_ns"], "member": a["member"],
+             "knobs": {k: round(float(v), 6)
+                       for k, v in sorted(a["knobs"].items())}}
+            for a in fed.knob_adoptions]
     digest_src = json.dumps(digest_payload, sort_keys=True,
                             separators=(",", ":"))
     report: dict[str, Any] = {
@@ -580,4 +697,6 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
         report["applied_knobs"] = {
             k: round(float(v), 6)
             for k, v in sorted(fed.applied_knobs.items())}
+    if pilot is not None:
+        report["autopilot"] = pilot.report()
     return report

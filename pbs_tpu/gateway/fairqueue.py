@@ -168,6 +168,16 @@ class DeficitRoundRobin:
         deterministic iteration order handoff loops rely on)."""
         return sorted(t for t, f in self._fifos[cls].items() if f)
 
+    def pending(self, cls: str | None = None):
+        """Iterate every queued request (one class, or all), in
+        deterministic (class, tenant, FIFO) order. Read-only observer
+        surface: the autopilot canary guard ages stuck requests
+        against their SLO target with it (docs/AUTOPILOT.md)."""
+        for c in ((cls,) if cls is not None else SLO_CLASSES):
+            fifos = self._fifos[c]
+            for tenant in sorted(fifos):
+                yield from fifos[tenant]
+
     # -- dispatch order --------------------------------------------------
 
     def _quantum_for(self, tenant: str) -> float:

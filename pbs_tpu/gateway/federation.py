@@ -455,6 +455,17 @@ class FederatedGateway:
         #: federation's own timeline.
         self._knob_watcher = None
         self.applied_knobs: dict[str, float | int] = {}
+        #: Per-member knob bridges (attach_knobs(per_member=True), the
+        #: autopilot canary path): one member-keyed watcher each, so a
+        #: scoped push reaches exactly its canary set. Adoptions are
+        #: recorded in ``knob_adoptions`` (digest-covered when the
+        #: autopilot chaos harness is armed).
+        self._knob_channel = None
+        self._member_watchers: dict[str, object] = {}
+        self.knob_adoptions: list[dict] = []
+        #: Shadow-trace capture (pbs_tpu/autopilot): arrivals recorded
+        #: at the federation's submit surface. None = zero cost.
+        self.shadow = None
         self._last_renew_ns: int | None = None
         self._health_cache: tuple[int, dict] = (-1, {})
         for gw in members:
@@ -483,6 +494,13 @@ class FederatedGateway:
         gw.admission.bucket_factory = self._bucket_factory(gw.name)
         if self.spans is not None:
             gw.attach_spans(self.spans)
+        if self._knob_channel is not None:
+            # Per-member adoption armed: a late joiner (rejoin path)
+            # gets its own member-keyed watcher, primed so it starts
+            # from the channel's current applicable state instead of a
+            # gap (a scoped canary value stays foreign to it).
+            self._member_watchers[gw.name] = \
+                self._make_member_watcher(gw.name)
         self.ring.add(gw.name)
 
     def _bucket_factory(self, gw_name: str):
@@ -573,6 +591,7 @@ class FederatedGateway:
         are fenced, and its unspent tokens are accounted ``destroyed``
         (never re-minted: death is conservative, not inflationary)."""
         gw = self.members.pop(name)  # no longer an adoption target
+        self._member_watchers.pop(name, None)
         now = self.clock.now_ns()
         self.events.append({"now_ns": now, "event": "kill",
                             "gateway": name})
@@ -642,17 +661,32 @@ class FederatedGateway:
 
     def _retire(self, name: str) -> None:
         gw = self.members.pop(name)
+        self._member_watchers.pop(name, None)
         self._draining.discard(name)
         self._partitioned.pop(name, None)
         self.ring.remove(name)
         self.broker.revoke(name)
         self._retired.append(gw)
 
+    # -- shadow capture (pbs_tpu/autopilot, docs/AUTOPILOT.md) -----------
+
+    def attach_shadow(self, recorder) -> None:
+        """Install a shadow-trace recorder at the federation's submit
+        surface: every arrival across every member is captured into
+        one bounded ring (time, tenant, class, cost) with the tenant
+        contracts needed to replay a window stand-alone. Purely an
+        observer — no randomness, no digest movement."""
+        self.shadow = recorder
+        for tenant, quota in sorted(self.quotas.items()):
+            recorder.note_tenant(tenant, quota)
+
     # -- tenants ---------------------------------------------------------
 
     def register_tenant(self, tenant: str, quota: TenantQuota) -> None:
         now = self.clock.now_ns()
         self.quotas[tenant] = quota
+        if self.shadow is not None:
+            self.shadow.note_tenant(tenant, quota)
         self.broker.register(tenant, quota, now)
         for name in sorted(self.members):
             self.members[name].register_tenant(tenant, quota, now_ns=now)
@@ -720,6 +754,11 @@ class FederatedGateway:
 
     def submit(self, tenant: str, payload, cost: int = 1,
                slo: str | None = None) -> SubmitResult:
+        if self.shadow is not None:
+            q = self.quotas.get(tenant)
+            cls = slo or (q.slo if q is not None else "batch")
+            self.shadow.on_submit(self.clock.now_ns(), tenant, cls,
+                                  max(1, int(cost)))
         target = self.route(tenant)
         if target is None:
             # Every front door is dead/partitioned: an explicit shed
@@ -735,7 +774,7 @@ class FederatedGateway:
 
     # -- live knobs (docs/KNOBS.md) --------------------------------------
 
-    def attach_knobs(self, channel) -> None:
+    def attach_knobs(self, channel, per_member: bool = False) -> None:
         """Subscribe this federation to a knob channel
         (knobs/channel.py). Pushes are adopted at the next ``tick()``
         — one poll per pump round, so mid-run reconfiguration lands at
@@ -743,12 +782,62 @@ class FederatedGateway:
         chaos runs replay bit-identically). A push the channel
         REJECTED (malformed/out-of-range) never moves the generation,
         so it is invisible here by construction — atomicity end to
-        end."""
+        end.
+
+        ``per_member=True`` (the autopilot canary path,
+        docs/AUTOPILOT.md) additionally creates one member-keyed
+        watcher per gateway: a push scoped to a member subset is
+        adopted by exactly that subset, members joining later get
+        primed watchers, and every member adoption is recorded in
+        ``knob_adoptions``. The default keeps the single federation-
+        level watcher — bit-identical to the pre-canary behavior."""
         from pbs_tpu.knobs.channel import KnobWatcher
 
+        if self._knob_watcher is not None:
+            # A second attach would silently orphan the first channel:
+            # its pushes would keep validating and moving generations
+            # while the federation adopts nothing — the worst kind of
+            # misconfiguration (looks armed, does nothing). One
+            # federation, one knob channel.
+            raise ValueError(
+                "federation already has a knob channel attached; "
+                "one control plane owns the knob surface")
         watcher = KnobWatcher(channel)
         watcher.add(self._apply_knobs)
         self._knob_watcher = watcher
+        if per_member:
+            self._knob_channel = channel
+            for name in sorted(self.members):
+                self._member_watchers[name] = \
+                    self._make_member_watcher(name)
+
+    def _make_member_watcher(self, name: str):
+        """One member-keyed watcher: scoped pushes reach exactly their
+        canary set, and what the member adopted is recorded with the
+        federation's own timestamp (the autopilot digest covers it)."""
+        from pbs_tpu.knobs.channel import KnobWatcher
+
+        gw = self.members[name]
+
+        def _adopt(changed: dict, values: dict,
+                   _gw=gw, _name=name) -> None:
+            adopted = _gw.apply_member_knobs(changed, values)
+            if adopted:
+                self.knob_adoptions.append({
+                    "now_ns": self.clock.now_ns(),
+                    "member": _name,
+                    "knobs": {k: values[k] for k in adopted},
+                })
+
+        watcher = KnobWatcher(self._knob_channel, member=name)
+        watcher.add(_adopt)
+        # Current-state-first: the member adopts the channel's present
+        # applicable truth at attach (a canary-scoped value stays
+        # foreign to it), so every member carries the same reference
+        # baseline before any canary starts — and a later rollback
+        # restores the canary member to exactly its peers' state.
+        watcher.prime()
+        return watcher
 
     def _apply_knobs(self, changed: dict, values: dict) -> None:
         now = self.clock.now_ns()
@@ -830,6 +919,13 @@ class FederatedGateway:
         now = self.clock.now_ns()
         if self._knob_watcher is not None:
             self._knob_watcher.poll()
+        if self._member_watchers:
+            # Per-member adoption, one poll per live member per tick —
+            # skipping partitioned members (a partition IS network
+            # isolation; they catch up at heal through the same poll).
+            for name in sorted(self._member_watchers):
+                if name in self.members and name not in self._partitioned:
+                    self._member_watchers[name].poll()
         for name in sorted(self.members):
             if name in self._partitioned:
                 continue

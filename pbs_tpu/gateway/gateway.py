@@ -118,6 +118,13 @@ class Gateway:
         self.admission = AdmissionController(
             max_queued_total=max_queued, default_quota=default_quota)
         self.queue = DeficitRoundRobin(quantum=drr_quantum)
+        #: Shadow-trace capture seam (pbs_tpu/autopilot/recorder.py):
+        #: when attached, every arrival (admitted OR shed — the
+        #: workload is arrivals, admission is the policy under test)
+        #: is recorded before any fault consult. None = zero cost.
+        #: Initialized before tenant registration: register_tenant
+        #: describes each tenant contract to an attached recorder.
+        self.shadow = None
         for tenant, q in (quotas or {}).items():
             self.register_tenant(tenant, q, now_ns=now)
         #: Global concurrency bound across backends; default: the sum
@@ -176,6 +183,12 @@ class Gateway:
         elif self.trace is not None:
             self.attach_spans(SpanRecorder(ring=self.trace,
                                            batch=self._trace_batch))
+        #: Member-level knob adoption (docs/AUTOPILOT.md): what this
+        #: gateway adopted from per-member (canary-scoped) pushes, and
+        #: the switch-overhead constant of the serving profile model
+        #: (0 = model off; the autopilot harness arms it).
+        self.applied_knobs: dict[str, int | float] = {}
+        self.profile_switch_cost_ns = 0
         self.feedback_sink = feedback_sink
         self.feedback_period_ns = int(feedback_period_ns)
         self._last_feedback_ns = now
@@ -212,6 +225,67 @@ class Gateway:
             self.spans.exec(now_ns, req.rid,
                             self._backend_slot(req.backend), self.name)
 
+    # -- shadow capture (pbs_tpu/autopilot, docs/AUTOPILOT.md) -----------
+
+    def attach_shadow(self, recorder) -> None:
+        """Install a shadow-trace recorder: every subsequent arrival is
+        captured (time, tenant, class, cost) into its bounded ring, and
+        the tenants registered so far are described to it so a captured
+        window is replayable stand-alone."""
+        self.shadow = recorder
+        for tenant, quota in sorted(self.admission.quotas.items()):
+            recorder.note_tenant(tenant, quota)
+
+    # -- member knob adoption (docs/AUTOPILOT.md "Canary") ---------------
+
+    def apply_member_knobs(self, changed: dict, values: dict) -> list:
+        """Adopt the member-relevant slice of a knob push delivered by
+        this member's own :class:`~pbs_tpu.knobs.channel.KnobWatcher`
+        (the federation creates one per member, keyed on the member
+        name, so canary-scoped pushes reach exactly the canary set).
+
+        Only the scheduler-profile knobs (the tuned-profile space the
+        autopilot rolls out — derived from ``knobs.profile
+        .PARAM_KNOBS``, the declared authority, so a new tunable
+        policy family is adoptable the day its mapping lands) adopt
+        here; federation-level knobs like the admission rate scale
+        stay with the federation's global watcher. When the
+        profile model is armed (``profile_switch_cost_ns > 0``), the
+        adopted band re-rates every backend exposing
+        ``set_service_scale`` by the declared first-order overhead
+        ``1 + switch_cost / band_cap`` — short slices buy latency
+        multiplexing at a context-switch cost, the paper's core
+        trade-off applied at serving granularity. Returns the adopted
+        knob names (empty = nothing member-relevant changed)."""
+        from pbs_tpu.knobs.profile import PARAM_KNOBS
+
+        adoptable = {knob_name for mapping in PARAM_KNOBS.values()
+                     for knob_name in mapping.values()}
+        adopted = sorted(k for k in changed if k in adoptable)
+        if not adopted:
+            return []
+        self.applied_knobs.update({k: changed[k] for k in adopted})
+        if self.profile_switch_cost_ns > 0:
+            # The binding band cap comes from the policy FAMILY the
+            # push steered (an atc canary pushes sched.atc.* — reading
+            # the untouched feedback cap would let a collapsed atc
+            # band sail through the guard unfelt). Both families in
+            # one push: the tighter cap binds.
+            fams = {k.rsplit(".", 1)[0] for k in adopted}
+            caps = [
+                float(values.get(f"{fam}.tslice_max_us",
+                                 knobs.default(f"{fam}.tslice_max_us")))
+                for fam in sorted(fams)
+            ]
+            cap_us = min(caps)
+            scale = 1.0 + (self.profile_switch_cost_ns
+                           / max(1.0, cap_us * 1000.0))
+            for b in self.backends:
+                setter = getattr(b, "set_service_scale", None)
+                if setter is not None:
+                    setter(scale)
+        return adopted
+
     # -- tenants ---------------------------------------------------------
 
     def register_tenant(self, tenant: str, quota: TenantQuota,
@@ -220,6 +294,8 @@ class Gateway:
             tenant, quota,
             now_ns=self.clock.now_ns() if now_ns is None else now_ns)
         self.queue.set_weight(tenant, quota.weight)
+        if self.shadow is not None:
+            self.shadow.note_tenant(tenant, quota)
 
     def _slot_of(self, tenant: str) -> int:
         slot = self._tenant_slot.get(tenant)
@@ -242,6 +318,11 @@ class Gateway:
             # or crash deep in the fair queue with a bare KeyError.
             raise ValueError(
                 f"unknown SLO class {cls!r}; known: {SLO_CLASSES}")
+        if self.shadow is not None:
+            # Before the fault consult: an injected shed is an
+            # admission outcome, the ARRIVAL still happened and must
+            # replay (the recorder consumes no randomness).
+            self.shadow.on_submit(now, tenant, cls, cost)
         penalty_ns = 0
         f = _faults.consult("gateway.admit", tenant)
         if f is not None:
@@ -360,7 +441,11 @@ class Gateway:
                 hist_rec(f"be:{b.name}", "*", "service", service_ns)
                 info = {**info, "tenant": req.tenant, "slo": cls,
                         "latency_ns": lat,
-                        "queue_delay_ns": req.queue_delay_ns}
+                        "queue_delay_ns": req.queue_delay_ns,
+                        # Admission time: lets windowed consumers (the
+                        # canary guard) judge only requests submitted
+                        # inside their window.
+                        "submit_ns": req.submit_ns}
                 out.append((req.rid, info))
                 self.completions.append((req.rid, info))
                 self._ledger_stage(cls, Counter.STEPS_RETIRED, 1)

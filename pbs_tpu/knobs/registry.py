@@ -55,7 +55,8 @@ _SEC = 1_000_000_000
 UNITS = ("ns", "us", "ms", "s", "", "per_s", "tokens", "records",
          "steps", "flop_per_s", "bytes_per_s")
 
-SUBSYSTEMS = ("sched", "gateway", "telemetry", "obs", "runtime", "dist")
+SUBSYSTEMS = ("sched", "gateway", "telemetry", "obs", "runtime", "dist",
+              "autopilot")
 
 
 class KnobError(ValueError):
@@ -378,6 +379,44 @@ _declare("dist.rpc.backoff_cap_s", "float", "s",
          0.05, 0.0001, 600.0, doc="exponential backoff cap")
 _declare("dist.rpc.timeout_s", "float", "s",
          5.0, 0.001, 3_600.0, doc="socket timeout per attempt")
+
+# -- autopilot: the shadow-replay self-tuning loop (pbs_tpu/autopilot/)
+_declare("autopilot.min_record_ns", "int", "ns",
+         80 * _MS, 1 * _MS, 3_600 * _SEC,
+         doc="shadow-trace capture horizon before the first candidate "
+             "search (docs/AUTOPILOT.md)")
+_declare("autopilot.guard_window_ns", "int", "ns",
+         60 * _MS, 1 * _MS, 3_600 * _SEC,
+         doc="canary guard window: how long SLO burn is watched "
+             "before promote-or-rollback")
+_declare("autopilot.burn_limit", "float", "",
+         2.0, 0.0, 1e6,
+         doc="per-tenant SLO burn rate at the canary members that "
+             "trips automatic rollback (1.0 = exactly the error "
+             "budget)")
+_declare("autopilot.score_margin_x1e6", "int", "",
+         5_000, 0, 1_000_000,
+         doc="minimum tuned-frontier score margin (x1e6, the tune "
+             "scale) a shadow candidate must beat the live config by "
+             "before any rollout starts")
+_declare("autopilot.canary_members", "int", "",
+         1, 1, 64,
+         doc="how many federation members receive a candidate as a "
+             "scoped canary push")
+_declare("autopilot.min_guard_samples", "int", "",
+         5, 1, 1_000_000,
+         doc="minimum completed requests per tenant at the canary "
+             "members before its burn rate counts as evidence")
+_declare("autopilot.switch_cost_ns", "int", "ns",
+         100 * _US, 0, 10 * _MS,
+         doc="first-order context-switch overhead of the serving-tier "
+             "profile model: adopting a band with cap C us inflates "
+             "member service time by 1 + switch_cost/(C us) — the "
+             "paper's short-slice overhead applied at the member "
+             "(0 = model off). At the reference band (cap 1.1 ms) "
+             "this is ~9% overhead; at the pathological collapsed "
+             "10 us band it is ~11x, which is what the canary guard "
+             "must catch")
 
 # -- telemetry.source hardware model (telemetry/source.py)
 _declare("telemetry.source.peak_flops", "float", "flop_per_s",

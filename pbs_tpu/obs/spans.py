@@ -282,6 +282,20 @@ class LatencyHistograms:
     def class_quantile(self, cls: str, stage: str, q: float) -> int:
         return hist_quantile(self.class_counts(cls, stage), q)
 
+    def over_target(self, who: str, cls: str, stage: str,
+                    target_ns: int) -> tuple[int, int]:
+        """``(over, total)`` sample counts against an SLO latency
+        target, at log2 resolution: a sample counts as over only when
+        its whole bucket sits above the target's bucket (the sample
+        provably exceeded the target; samples sharing the target's
+        bucket count as under — conservative, so a burn rate built on
+        this never cries wolf from quantization). The autopilot canary
+        guard reads this delta-style over its guard window
+        (docs/AUTOPILOT.md)."""
+        c = self.counts(who, cls, stage).astype(np.int64)
+        first_over = hist_bucket(int(target_ns)) + 1
+        return int(c[first_over:].sum()), int(c.sum())
+
     def keys(self) -> list[tuple[str, str, str]]:
         return sorted(self._slots)
 
@@ -536,6 +550,14 @@ class SpanRecorder:
         self.batch.emit(now, Ev.SPAN_HANDOFF, sid,
                         self.member_id(from_member),
                         self.member_id(to_member))
+
+    def emit_event(self, now: int, ev: int, *args: int) -> None:
+        """Non-span audit record sharing this recorder's ring (the
+        autopilot decision events, class 0x09xx): rides the same
+        EmitBatch, lands in emission order next to the chains it
+        explains. The assembler ignores non-0x08xx classes, so chain
+        validation is untouched."""
+        self.batch.emit(now, ev, *args)
 
     def flush(self) -> None:
         self.batch.flush()

@@ -126,6 +126,17 @@ class Ev(enum.IntEnum):
     #                               latency_ns, member
     SPAN_REQUEUE = 0x0807  # args: span, backend_slot, member
     SPAN_HANDOFF = 0x0808  # args: span, from_member, to_member
+    # autopilot decisions (0x09xx) — the self-tuning loop's audit trail
+    # (docs/AUTOPILOT.md; pbs_tpu.autopilot). Emitted through the
+    # shared SpanRecorder ring so every decision lands in emission
+    # order next to the request chains it affected; the assembler
+    # ignores the class, chain validation is untouched.
+    AP_PROPOSE = 0x0901  # args: cand_score_x1e6, live_score_x1e6,
+    #                            margin_x1e6 (i64 two's complement —
+    #                            scores can be negative), injected
+    AP_CANARY = 0x0902  # args: n_members, guard_window_ns
+    AP_PROMOTE = 0x0903  # args: n_members, reserved
+    AP_ROLLBACK = 0x0904  # args: reason_code, max_burn_x1000
 
 
 class TraceBuffer:

@@ -200,6 +200,30 @@ def successive_halving(
     }
 
 
+def evaluate_params(workload: str, policy: str,
+                    param_sets: Sequence[dict], base_seed: int = 0,
+                    workers: int = 1,
+                    horizon_ns: int = CHECK_HORIZON_NS,
+                    n_reps: int = CHECK_REPS,
+                    n_tenants: int = CHECK_TENANTS) -> list[float]:
+    """Paired head-to-head scoring: every param set scores on the
+    IDENTICAL workload realization (cell seeds derive from the
+    workload identity only — ``SweepCell.workload_identity``), so a
+    score difference is pure policy signal and an inert difference
+    ties exactly. Returns scores in input order. The autopilot's
+    shadow loop uses this as its live-vs-candidate margin gate
+    (docs/AUTOPILOT.md); 6-dp rounded like every tune score."""
+    cells: list[SweepCell] = []
+    spans: list[tuple[int, int]] = []
+    for params in param_sets:
+        cs = _cells_for(workload, policy, dict(params), horizon_ns,
+                        n_reps, n_tenants=n_tenants)
+        spans.append((len(cells), len(cells) + len(cs)))
+        cells.extend(cs)
+    reports = sweep(cells, base_seed=base_seed, workers=workers)
+    return [score_reports(reports[lo:hi]) for lo, hi in spans]
+
+
 # -- tuned profiles ----------------------------------------------------------
 
 

@@ -84,6 +84,16 @@ class SweepCell:
         }, sort_keys=True, separators=(",", ":"))
 
 
+def seed_from_digest(digest_hex: str, salt: int = 0) -> int:
+    """Fold an existing sha256 hex digest into the sweep seed space.
+    The autopilot's shadow search seeds from the captured window's
+    digest this way (pbs_tpu/autopilot/shadow.py), so its whole
+    candidate search is a pure function of the recorded traffic —
+    same window ⇒ same paired realization ⇒ same winner."""
+    return (int(digest_hex[:15], 16) ^ int(salt)) \
+        & ((1 << _SEED_BITS) - 1)
+
+
 def cell_seed(cell: SweepCell, base_seed: int = 0) -> int:
     """Engine seed for a cell: sha256 over (base_seed, the cell's
     workload identity). Stable across processes/platforms (sha256 and

@@ -41,8 +41,15 @@ from pbs_tpu.utils.clock import VirtualClock
 SCHEMA_VERSION = 1
 
 
-def _dumps(rec: dict) -> str:
+def dumps_canonical(rec: dict) -> str:
+    """Canonical record encoding every digest in this repo hashes:
+    sorted keys, no whitespace — one byte stream per value, on any
+    host. Shared with the autopilot shadow traces
+    (pbs_tpu/autopilot/recorder.py), which must replay byte-stably."""
     return json.dumps(rec, sort_keys=True, separators=(",", ":"))
+
+
+_dumps = dumps_canonical
 
 
 class TraceRecorder:

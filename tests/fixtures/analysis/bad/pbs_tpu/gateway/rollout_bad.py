@@ -1,0 +1,37 @@
+"""Seeded rollout-discipline violations: raw knob writes outside the
+guarded rollout path (docs/AUTOPILOT.md)."""
+
+from pbs_tpu import knobs
+from pbs_tpu.knobs import registry
+from pbs_tpu.knobs.channel import KnobChannel
+
+
+class HotReconfigurer:
+    """Pushes knobs straight at the fleet — no canary scope, no
+    SLO-burn guard, no rollback. Every write here is a finding."""
+
+    def __init__(self, path: str):
+        # Tainted through a self-attribute assignment.
+        self.channel = KnobChannel.attach(path, writable=True)
+
+    def widen_band(self, cap_us: int) -> int:
+        # rollout-push: raw channel push from production code.
+        return self.channel.push(
+            {"sched.feedback.tslice_max_us": cap_us})
+
+
+def emergency_override(path: str, window: int) -> None:
+    ch = KnobChannel.create(path)
+    # rollout-push: locally constructed writer, same bypass.
+    ch.push({"sched.feedback.window": window})
+    # rollout-push: direct construct-and-push chain.
+    KnobChannel.attach(path, writable=True).push(
+        {"sched.feedback.grow_step_us": 50})
+
+
+def fork_local_view(window: int) -> None:
+    # rollout-set-local: forks this process's knob view away from the
+    # channel every consumer watches.
+    knobs.set_local({"sched.feedback.window": window})
+    # rollout-set-local: the registry module alias spells it too.
+    registry.set_local({"sched.feedback.gw_hot_after": 5})

@@ -1,0 +1,37 @@
+"""Behavior twin of rollout_bad.py on the sanctioned path: reads are
+free, writes go through the guarded rollout (docs/AUTOPILOT.md)."""
+
+from pbs_tpu import knobs
+from pbs_tpu.knobs.channel import KnobChannel, KnobWatcher
+
+
+class GuardedReconfigurer:
+    """Same capability, through the door: candidates reach the fleet
+    via the canary controller; this module only ever reads."""
+
+    def __init__(self, path: str):
+        # Reader attach: snapshots and watches are always sanctioned.
+        self.channel = KnobChannel.attach(path)
+        self.watcher = KnobWatcher(self.channel, member="gw0")
+
+    def current_band(self) -> tuple[int, int]:
+        _, values = self.channel.snapshot()
+        return (int(values["sched.feedback.tslice_min_us"]),
+                int(values["sched.feedback.tslice_max_us"]))
+
+    def poll(self):
+        # Adoption through the member-keyed watcher — the canary
+        # scoping filter applies, nothing is written.
+        return self.watcher.poll()
+
+
+def declared_default(name: str) -> float:
+    # Registry READS are the sanctioned consumer surface.
+    return float(knobs.get(name))
+
+
+def propose_band(pilot, cap_us: int) -> None:
+    # The guarded path: hand the candidate to the canary controller
+    # (autopilot/canary.py pushes, scoped, with the SLO-burn guard).
+    pilot.canary.start({"min_us": 100, "max_us": cap_us},
+                       now_ns=pilot.fed.clock.now_ns())

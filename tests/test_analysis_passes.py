@@ -54,6 +54,7 @@ def test_all_rules_fire_on_bad_tree():
         "obs-unclosed-span", "obs-span-emit-in-loop", "obs-hist-scan",
         "knob-unrouted", "knob-inline-tunable", "knob-unknown",
         "knob-unit-drift", "knob-native-drift",
+        "rollout-push", "rollout-set-local",
     }
 
 
@@ -115,7 +116,8 @@ def test_cli_list_passes(capsys):
     out = capsys.readouterr().out
     for pid in ("lock-discipline", "time-units", "sched-ops",
                 "counter-api", "gateway-discipline", "perf-discipline",
-                "obs-discipline", "knob-discipline"):
+                "obs-discipline", "knob-discipline",
+                "rollout-discipline"):
         assert pid in out
 
 

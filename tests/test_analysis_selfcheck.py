@@ -34,11 +34,17 @@ def test_repo_tree_is_clean(tree_result):
     assert r.findings == [], "\n" + format_human(r)
     # Suppressions on the live tree must all carry justifications (the
     # parser enforces it) — surface them here so review sees the list
-    # grow. The list-based reference probe kept as the numpy probe's
-    # equivalence witness (sim/engine.py), and the native sim core's
-    # recorder replay (sim/native_core.py), which must feed the JSONL
-    # recorder per record to reproduce the witness byte stream.
+    # grow. The chaos harness's knob plan (gateway/chaos.py) pushes
+    # raw mid-run reconfigurations BECAUSE it is the adversary; the
+    # list-based reference probe kept as the numpy probe's equivalence
+    # witness (sim/engine.py); and the native sim core's recorder
+    # replay (sim/native_core.py), which must feed the JSONL recorder
+    # per record to reproduce the witness byte stream.
     assert [(fi.check, j) for fi, j in r.suppressed] == [
+        ("rollout-push",
+         "chaos harness IS the adversary: the knob plan injects raw "
+         "mid-run pushes to prove the consumers survive them; "
+         "production writers go through autopilot/canary.py"),
         ("perf-dispatch-alloc",
          "reference equivalence witness, deliberately list-based"),
         ("perf-dispatch-alloc",
@@ -56,27 +62,29 @@ def test_cli_selfcheck_json_exit_zero(capsys):
     assert d["findings"] == []
     # The justified suppressions (see test_repo_tree_is_clean).
     assert [s["check"] for s in d["suppressed"]] == \
-        ["perf-dispatch-alloc"] * 2 + ["perf-emit-in-loop"]
+        ["rollout-push"] + ["perf-dispatch-alloc"] * 2 + \
+        ["perf-emit-in-loop"]
 
 
 def test_list_suppressions_pins_the_trees_escape_hatch_count(capsys):
     """`pbst check --list-suppressions` audits every escape hatch with
     file:line + justification. The COUNT is pinned: a new suppression
     must consciously bump this test, so review sees the list grow —
-    the knob-discipline pass landed with the tree needing ZERO new
-    ones (every hot-path tunable is genuinely routed)."""
+    the rollout-discipline pass added exactly ONE (the chaos
+    harness's adversarial knob plan — see test_repo_tree_is_clean)."""
     assert main(["check", PKG, "--list-suppressions",
                  "--format", "json"]) == 0
     d = json.loads(capsys.readouterr().out)
-    assert d["count"] == 3
+    assert d["count"] == 4
     assert all(s["justification"] for s in d["suppressions"])
     paths = sorted({s["path"] for s in d["suppressions"]})
-    assert paths == ["pbs_tpu/sim/engine.py",
+    assert paths == ["pbs_tpu/gateway/chaos.py",
+                     "pbs_tpu/sim/engine.py",
                      "pbs_tpu/sim/native_core.py"]
     # Text mode renders one line per suppression plus the count.
     assert main(["check", PKG, "--list-suppressions"]) == 0
     out = capsys.readouterr().out
-    assert "3 suppression(s)" in out
+    assert "4 suppression(s)" in out
     assert "NO JUSTIFICATION" not in out
 
 

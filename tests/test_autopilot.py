@@ -1,0 +1,472 @@
+"""pbs_tpu.autopilot: shadow capture, replay fidelity, candidate
+search, and the SLO-guarded canary (docs/AUTOPILOT.md).
+
+The chaos-gated closed loop lives in tests/test_autopilot_chaos.py;
+here are the unit contracts: a captured gateway window re-scheduled in
+sim reproduces admission/completion counts byte-stably under paired
+seeds (the record→replay roundtrip satellite), the scoped canary
+rollout adopts at exactly the canary subset, the guard trips on burn /
+missing members / missing evidence, and the ``pbst autopilot`` demo
+smoke stays inside the tier-1 budget.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from pbs_tpu.autopilot import (
+    PATHOLOGICAL_PARAMS,
+    CanaryRollout,
+    ShadowRecorder,
+    ShadowWindow,
+    classify_window,
+    reference_params,
+    replay_window,
+    shadow_search,
+    window_seed,
+)
+from pbs_tpu.gateway.admission import TenantQuota
+from pbs_tpu.gateway.backends import SimServeBackend
+from pbs_tpu.gateway.chaos import quota_for
+from pbs_tpu.gateway.gateway import Gateway
+from pbs_tpu.utils.clock import MS, VirtualClock
+
+import numpy as np
+
+
+def _quotas():
+    return {
+        "inter0": TenantQuota(rate=600.0, burst=60.0, weight=256,
+                              slo="interactive", max_queued=64),
+        "batch0": TenantQuota(rate=300.0, burst=120.0, weight=256,
+                              slo="batch", max_queued=128),
+    }
+
+
+def _drive_live_gateway(seed=0, ticks=120, tick_ns=1 * MS):
+    """A live single-member gateway shaped EXACTLY like
+    ``replay_window``'s reconstruction (backend names/seeds/service,
+    queue bounds), with a shadow recorder attached — the capture the
+    fidelity test replays."""
+    clock = VirtualClock()
+    backends = [
+        SimServeBackend(f"sb{i}", n_slots=2,
+                        service_ns_per_cost=3 * MS,
+                        seed=seed * 1009 + i)
+        for i in range(2)
+    ]
+    quotas = _quotas()
+    gw = Gateway(backends, clock=clock, max_queued=64 * len(quotas),
+                 name="live")
+    for tenant, q in sorted(quotas.items()):
+        gw.register_tenant(tenant, q, now_ns=0)
+    rec = ShadowRecorder(capacity=4096)
+    gw.attach_shadow(rec)
+    rng = np.random.default_rng([seed, 23])
+    for tick in range(ticks):
+        for tenant, q in sorted(quotas.items()):
+            u = float(rng.random())
+            if q.slo == "interactive":
+                fire, cost = u < 0.4, 1 + int(rng.integers(0, 3))
+            else:
+                fire, cost = u < 0.15, 4 + int(rng.integers(0, 9))
+            if fire:
+                gw.submit(tenant, None, cost=cost)
+        gw.tick()
+        clock.advance(tick_ns)
+    drained = 0
+    for _ in range(ticks * 8):
+        if not gw.busy():
+            break
+        gw.tick()
+        clock.advance(tick_ns)
+        drained += 1
+    return gw, rec
+
+
+# -- recorder ----------------------------------------------------------------
+
+
+def test_recorder_captures_arrivals_and_contracts():
+    gw, rec = _drive_live_gateway()
+    assert rec.recorded > 0 and rec.dropped == 0
+    win = rec.window(t0_ns=0)
+    assert len(win.arrivals) == rec.recorded
+    assert set(win.tenants) == {"inter0", "batch0"}
+    assert win.tenants["inter0"]["slo"] == "interactive"
+    # Shed arrivals are still arrivals: capture count >= admissions.
+    assert rec.recorded >= gw.admitted
+
+
+def test_recorder_ring_is_bounded():
+    rec = ShadowRecorder(capacity=8)
+    for i in range(20):
+        rec.on_submit(i * 100, "t", "batch", 1)
+    assert rec.recorded == 20 and rec.dropped == 12
+    win = rec.window()
+    assert len(win.arrivals) == 8
+    # Oldest retained arrival first, capture order preserved.
+    assert [t for t, *_ in win.arrivals] == \
+        [i * 100 - win.t0_ns for i in range(12, 20)]
+
+
+def test_window_save_load_digest_roundtrip(tmp_path):
+    _, rec = _drive_live_gateway(ticks=40)
+    win = rec.window(t0_ns=0)
+    p = str(tmp_path / "win.jsonl")
+    win.save(p)
+    back = ShadowWindow.load(p)
+    assert back.digest() == win.digest()
+    assert back.arrivals == win.arrivals
+    assert back.tenants == win.tenants
+
+
+# -- replay ------------------------------------------------------------------
+
+
+def test_record_replay_roundtrip_reproduces_counts_byte_stably():
+    """THE roundtrip satellite: a captured live-gateway window
+    re-scheduled in sim reproduces the live run's admission /
+    completion / shed counts EXACTLY (same quotas, same paired backend
+    seeds ⇒ same jitter stream ⇒ same decisions), and replaying twice
+    is byte-identical."""
+    gw, rec = _drive_live_gateway(seed=0)
+    win = rec.window(t0_ns=0)
+    rep = replay_window(win, seed=0)
+    assert rep["drained"] is True
+    assert rep["admitted"] == gw.admitted
+    assert rep["completed"] == gw.completed
+    assert rep["shed"] == sum(gw.admission.sheds.values())
+    rep2 = replay_window(win, seed=0)
+    assert json.dumps(rep, sort_keys=True) == \
+        json.dumps(rep2, sort_keys=True)
+    # A different paired seed is a different realization (the jitter
+    # stream is live, not a constant)...
+    rep3 = replay_window(win, seed=7)
+    assert json.dumps(rep3, sort_keys=True) != \
+        json.dumps(rep, sort_keys=True)
+    # ...but admission counts are jitter-independent here (same
+    # arrivals, same quotas): only latencies move.
+    assert rep3["admitted"] == rep["admitted"]
+
+
+def test_replay_what_if_under_pathological_knobs_degrades():
+    """The candidate what-if: the same window under the collapsed-band
+    profile (11x switch overhead) completes with visibly worse
+    interactive latency — the signal the canary guard keys on."""
+    from pbs_tpu.knobs.profile import params_to_knobs
+
+    _, rec = _drive_live_gateway(seed=0)
+    win = rec.window(t0_ns=0)
+    base = replay_window(win, seed=0)
+    bad = replay_window(
+        win, seed=0,
+        knob_values=params_to_knobs("feedback", PATHOLOGICAL_PARAMS),
+        switch_cost_ns=100_000)
+    assert bad["tenants"]["inter0"]["e2e_p99_ns"] > \
+        base["tenants"]["inter0"]["e2e_p99_ns"]
+
+
+# -- classification + search -------------------------------------------------
+
+
+def test_classify_window_first_order_mapping():
+    def win(arrivals):
+        return ShadowWindow(t0_ns=0, t1_ns=1000 * MS,
+                            arrivals=tuple(arrivals), tenants={})
+
+    assert classify_window(win([])) == "mixed"
+    steady_inter = [(i * MS, "t", "interactive", 1) for i in range(50)]
+    assert classify_window(win(steady_inter)) == "stable"
+    bursty = [(int((i // 10) * 40 * MS + (i % 10)), "t",
+               "interactive", 1) for i in range(50)]
+    assert classify_window(win(bursty)) == "serving"
+    batch = [(i * MS, "t", "batch", 8) for i in range(50)]
+    assert classify_window(win(batch)) == "contended"
+    half = [(i * MS, "t", "interactive" if i % 2 else "batch", 1)
+            for i in range(50)]
+    assert classify_window(win(half)) == "mixed"
+
+
+def test_shadow_search_is_a_pure_function_of_the_window():
+    _, rec = _drive_live_gateway(seed=0, ticks=60)
+    win = rec.window(t0_ns=0)
+    a = shadow_search(win, quick=True)
+    b = shadow_search(win, quick=True)
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    assert a["base_seed"] == window_seed(win)
+    assert a["live"] == reference_params("feedback")
+    # The margin is candidate-minus-live on the same paired cells.
+    assert a["margin_x1e6"] == \
+        a["candidate_score_x1e6"] - a["live_score_x1e6"]
+
+
+# -- the canary guard --------------------------------------------------------
+
+
+def _tiny_federation(seed=0, tick_ns=1 * MS, n_members=3):
+    from pbs_tpu.gateway.chaos import _federation_member
+    from pbs_tpu.gateway.federation import FederatedGateway
+
+    clock = VirtualClock()
+    members = [_federation_member(f"gw{i}", i, clock, tick_ns, seed,
+                                  n_backends=2, n_tenants=2)
+               for i in range(n_members)]
+    fed = FederatedGateway(members, clock=clock,
+                           renew_period_ns=4 * tick_ns,
+                           lease_ttl_ns=6 * tick_ns)
+    for name, q in sorted(_quotas().items()):
+        fed.register_tenant(name, q)
+    return fed, clock
+
+
+def _pump(fed, clock, arrivals_rng, ticks, tick_ns=1 * MS,
+          canary=None):
+    quotas = _quotas()
+    for _ in range(ticks):
+        for tenant, q in sorted(quotas.items()):
+            u = float(arrivals_rng.random())
+            cost = (1 + int(arrivals_rng.integers(0, 3))
+                    if q.slo == "interactive"
+                    else 4 + int(arrivals_rng.integers(0, 9)))
+            if u < (0.5 if q.slo == "interactive" else 0.15):
+                fed.submit(tenant, None, cost=cost)
+        fed.tick()
+        if canary is not None:
+            decision = canary.poll(fed.clock.now_ns())
+            if decision is not None:
+                return decision
+        clock.advance(tick_ns)
+    return None
+
+
+def _armed_canary(fed, tmp_path, **kw):
+    from pbs_tpu.knobs.channel import KnobChannel
+
+    writer = KnobChannel.create(str(tmp_path / "knobs.led"))
+    fed.attach_knobs(KnobChannel.attach(str(tmp_path / "knobs.led")),
+                     per_member=True)
+    for gw in fed.members.values():
+        gw.profile_switch_cost_ns = 100_000
+    return CanaryRollout(fed, writer, **kw)
+
+
+def test_canary_burn_guard_rolls_back_pathological(tmp_path):
+    """The burn path end to end: pathological candidate adopted at ONE
+    member, that member's interactive latency burns past the limit,
+    rollback restores the reference at the canary member and nowhere
+    else was ever touched."""
+    fed, clock = _tiny_federation()
+    canary = _armed_canary(fed, tmp_path, guard_window_ns=60 * MS,
+                           min_guard_samples=3)
+    rng = np.random.default_rng([5, 7])
+    _pump(fed, clock, rng, 30)  # warm traffic
+    ev = canary.start(dict(PATHOLOGICAL_PARAMS), clock.now_ns())
+    # Evidence-aware placement: the canary sits where the ring homes
+    # the interactive tenant (a batch-only member could never show a
+    # tight-target violation inside the guard window).
+    assert len(ev["members"]) == 1
+    cm = ev["members"][0]
+    others = [n for n in fed.members if n != cm]
+    fed.tick()  # adoption lands on the members' next pump round
+    assert fed.members[cm].applied_knobs[
+        "sched.feedback.tslice_max_us"] == 10
+    for name in others:
+        assert fed.members[name].applied_knobs.get(
+            "sched.feedback.tslice_max_us") != 10
+    assert fed.members[cm].backends[0].service_scale > 10
+    decision = _pump(fed, clock, rng, 120, canary=canary)
+    assert decision is not None and decision["event"] == "rollback"
+    assert decision["reason"] == "burn"
+    assert max(decision["burns"].values()) > canary.burn_limit
+    fed.tick()  # rollback adoption
+    ref_max = canary.reference["sched.feedback.tslice_max_us"]
+    assert fed.members[cm].applied_knobs[
+        "sched.feedback.tslice_max_us"] == ref_max
+    assert abs(fed.members[cm].backends[0].service_scale
+               - (1.0 + 100_000 / (ref_max * 1000.0))) < 1e-9
+
+
+def test_canary_promotes_healthy_candidate_everywhere(tmp_path):
+    fed, clock = _tiny_federation()
+    canary = _armed_canary(fed, tmp_path, guard_window_ns=60 * MS,
+                           min_guard_samples=3)
+    rng = np.random.default_rng([5, 7])
+    _pump(fed, clock, rng, 30)
+    healthy = {"min_us": 100, "max_us": 2000, "window": 5}
+    canary.start(dict(healthy), clock.now_ns())
+    decision = _pump(fed, clock, rng, 120, canary=canary)
+    assert decision is not None and decision["event"] == "promote", \
+        decision
+    fed.tick()  # global adoption lands
+    for name, gw in fed.members.items():
+        assert gw.applied_knobs["sched.feedback.tslice_max_us"] == \
+            2000, name
+
+
+def test_canary_member_lost_mid_guard_rolls_back(tmp_path):
+    fed, clock = _tiny_federation()
+    canary = _armed_canary(fed, tmp_path, guard_window_ns=300 * MS)
+    rng = np.random.default_rng([5, 7])
+    _pump(fed, clock, rng, 10)
+    ev = canary.start(dict(PATHOLOGICAL_PARAMS), clock.now_ns())
+    fed.kill(ev["members"][0])  # the canary box dies mid-guard
+    decision = canary.poll(clock.now_ns())
+    assert decision is not None
+    assert decision["event"] == "rollback"
+    assert decision["reason"] == "member-lost"
+
+
+def test_no_evidence_never_promotes(tmp_path):
+    """Promotion requires affirmative evidence: a guard window with no
+    qualifying completions (nothing submitted at all here) must land
+    on the reference, not on the candidate."""
+    fed, clock = _tiny_federation()
+    canary = _armed_canary(fed, tmp_path, guard_window_ns=20 * MS)
+    canary.start(dict(PATHOLOGICAL_PARAMS), clock.now_ns())
+    decision = None
+    for _ in range(40):
+        fed.tick()
+        decision = canary.poll(clock.now_ns())
+        if decision is not None:
+            break
+        clock.advance(1 * MS)
+    assert decision is not None
+    assert decision["event"] == "rollback"
+    assert decision["reason"] == "no-evidence"
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def test_cli_autopilot_demo_smoke(tmp_path, capsys):
+    """Tier-1 smoke (≤5 s budget): the demo loop runs to a decision,
+    the report round-trips through status/history, exit codes hold."""
+    from pbs_tpu.cli.pbst import main
+
+    out_path = str(tmp_path / "ap.json")
+    assert main(["autopilot", "run", "--demo", "--pathological",
+                 "--out", out_path]) == 0
+    out = capsys.readouterr().out
+    assert "rollback" in out and "INJECTED" in out
+    assert main(["autopilot", "status", "--state", out_path]) == 0
+    assert "decisions=propose,canary,rollback" in \
+        capsys.readouterr().out
+    assert main(["autopilot", "history", "--state", out_path]) == 0
+    assert "3 decision event(s)" in capsys.readouterr().out
+    # Usage errors are exit 2, not tracebacks.
+    assert main(["autopilot", "run"]) == 2
+    assert main(["autopilot", "status"]) == 2
+
+
+def test_cli_autopilot_demo_deterministic(tmp_path):
+    from pbs_tpu.autopilot import run_autopilot_demo
+
+    a = run_autopilot_demo(seed=0, ticks=260, pathological=True)
+    b = run_autopilot_demo(seed=0, ticks=260, pathological=True)
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    assert a["history"][-1]["event"] == "rollback"
+
+
+# -- review-driven regressions ----------------------------------------------
+
+
+def test_promote_updates_the_rollback_reference(tmp_path):
+    """A promoted candidate IS the new trusted profile: a later
+    round's rollback must degrade to it, never silently un-promote a
+    measured win back to the construction-time reference."""
+    fed, clock = _tiny_federation()
+    canary = _armed_canary(fed, tmp_path, guard_window_ns=60 * MS,
+                           min_guard_samples=3)
+    rng = np.random.default_rng([5, 7])
+    _pump(fed, clock, rng, 30)
+    canary.start({"min_us": 100, "max_us": 2000, "window": 5},
+                 clock.now_ns())
+    decision = _pump(fed, clock, rng, 120, canary=canary)
+    assert decision["event"] == "promote"
+    assert canary.reference["sched.feedback.tslice_max_us"] == 2000
+
+
+def test_autopilot_config_zero_values_are_respected():
+    """0 is a DECLARED-valid value for switch_cost_ns (model off) and
+    burn_limit (strictest guard); only None means 'registry
+    default'."""
+    from pbs_tpu.autopilot import AutopilotConfig
+    from pbs_tpu import knobs
+
+    cfg = AutopilotConfig(switch_cost_ns=0, burn_limit=0.0)
+    assert cfg.switch_cost_ns == 0
+    assert cfg.burn_limit == 0.0
+    assert AutopilotConfig().switch_cost_ns == \
+        knobs.default("autopilot.switch_cost_ns")
+
+
+def test_atc_band_cap_drives_the_profile_model():
+    """An atc-family push re-rates service from the ATC band cap — a
+    collapsed atc band must not sail through unfelt because the
+    untouched feedback cap was consulted."""
+    clock = VirtualClock()
+    be = SimServeBackend("b0", seed=1)
+    gw = Gateway([be], clock=clock, name="gw0")
+    gw.profile_switch_cost_ns = 100_000
+    push = {"sched.atc.tslice_min_us": 10,
+            "sched.atc.tslice_max_us": 10}
+    adopted = gw.apply_member_knobs(dict(push), dict(push))
+    assert adopted == sorted(push)
+    assert abs(be.service_scale - 11.0) < 1e-9
+
+
+def test_second_attach_knobs_is_refused(tmp_path):
+    """A silently orphaned knob channel (pushes validate, nobody
+    adopts) is the worst misconfiguration — the federation holds
+    exactly one."""
+    from pbs_tpu.knobs.channel import KnobChannel
+
+    fed, _ = _tiny_federation()
+    a = KnobChannel.create(str(tmp_path / "a.led"))
+    fed.attach_knobs(KnobChannel.attach(str(tmp_path / "a.led")))
+    KnobChannel.create(str(tmp_path / "b.led"))
+    with pytest.raises(ValueError, match="already has a knob channel"):
+        fed.attach_knobs(KnobChannel.attach(str(tmp_path / "b.led")))
+
+
+def test_chaos_rejects_knob_plan_plus_autopilot():
+    from pbs_tpu.gateway import run_federation_chaos
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        run_federation_chaos(ticks=10, autopilot=True,
+                             knob_plan=[{"tick": 1, "set": {}}])
+
+
+def test_hist_over_target_is_bucket_conservative():
+    """`LatencyHistograms.over_target` counts a sample as over only
+    when its whole log2 bucket sits above the target's bucket — the
+    always-on cheap reader next to the guard's exact path."""
+    from pbs_tpu.obs.spans import LatencyHistograms, hist_bucket
+
+    h = LatencyHistograms(num_slots=4)
+    target = 50 * MS
+    h.record("t", "interactive", "e2e", 40 * MS)   # target's bucket
+    h.record("t", "interactive", "e2e", 60 * MS)   # shares the bucket
+    h.record("t", "interactive", "e2e", 200 * MS)  # provably over
+    over, total = h.over_target("t", "interactive", "e2e", target)
+    assert total == 3
+    assert over == 1  # only the bucket fully above the target's
+    assert hist_bucket(60 * MS) == hist_bucket(target)  # the why
+
+
+def test_canary_deferred_when_no_member_can_host_it(tmp_path):
+    """Chaos can drain/partition every member at propose time: the
+    rollout defers — nothing pushed, production untouched — instead
+    of crashing on an empty scoped push."""
+    fed, clock = _tiny_federation(n_members=2)
+    canary = _armed_canary(fed, tmp_path)
+    fed.drain("gw0")
+    fed._partitioned["gw1"] = clock.now_ns() + 10_000 * MS
+    gen_before = canary.channel.generation
+    ev = canary.start(dict(PATHOLOGICAL_PARAMS), clock.now_ns())
+    assert ev is None
+    assert canary.state == "idle"
+    assert canary.channel.generation == gen_before  # nothing pushed

@@ -1,0 +1,157 @@
+"""The chaos-gated closed loop: ``run_federation_chaos(autopilot=...)``.
+
+ISSUE 13's acceptance gate. With the autopilot armed, the
+``FaultPlan.autopilot`` plan deterministically injects an adversarially
+bad candidate (collapsed 10 µs band — every value INSIDE the registry's
+safe ranges, so only the canary guard can stop it) at the
+``autopilot.candidate`` seam, on top of the full federation attack
+(gateway death, partitions, lease expiries, drain + rejoin). The
+invariants pinned here, with golden trace+report digests exactly like
+the knob-plan scenario's:
+
+- the pathological candidate ROLLS BACK to the reference profile
+  within the guard window; every member ends on the reference values;
+- no-job-lost and the piecewise mint bound hold throughout — the loop
+  degrades to the reference profile, never to an outage;
+- same seed ⇒ same digests (the report digest covers every autopilot
+  decision and member adoption, so the ROLLBACK ITSELF must replay);
+- with the autopilot disarmed, the plain federation goldens are
+  byte-identical (tests/test_federation_chaos.py pins them — the
+  autopilot keys its payload in only when armed).
+
+The cross-workload soak lives behind ``slow``.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from pbs_tpu.faults import FaultPlan
+from pbs_tpu.faults import injector as faults
+from pbs_tpu.gateway import run_federation_chaos
+from pbs_tpu.knobs.profile import params_to_knobs
+from pbs_tpu.sim.workload import workload_names
+
+#: Golden digests for (mixed, seed=0, 3 gateways, 4 tenants, 240
+#: ticks) under FaultPlan.autopilot(0) with autopilot=True.
+#: Regenerate via ``python -c "from pbs_tpu.gateway import
+#: run_federation_chaos; r = run_federation_chaos(ticks=240,
+#: autopilot=True); print(r['trace_digest']);
+#: print(r['report_digest'])"`` after an intentional loop-behavior,
+#: injection, or arrival-model change — and review WHAT moved like a
+#: golden file: this digest covers the rollback decision itself.
+GOLDEN_AP_TRACE_DIGEST = (
+    "5b3e7d637df0babef9590c9d450de41384c04e8f908298f874452b3a74b223c7")
+GOLDEN_AP_REPORT_DIGEST = (
+    "bff117e15037e45b2aebaf7cf19448fd1fa84ff8f3c15cbab49898c2db1552fe")
+
+SMOKE_KW = dict(workload="mixed", seed=0, n_gateways=3, n_tenants=4,
+                ticks=240, autopilot=True)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    faults.uninstall()
+
+
+def test_autopilot_chaos_pathological_candidate_rolls_back_golden():
+    r = run_federation_chaos(**SMOKE_KW)
+    assert r["problems"] == []
+    assert r["ok"] is True
+    # The injection actually fired (it is IN the fault trace digest).
+    assert r["faults_fired"].get("autopilot.candidate:pathological") == 1
+    history = r["autopilot"]["history"]
+    events = [e["event"] for e in history]
+    assert events == ["propose", "canary", "rollback"]
+    propose, canary, rollback = history
+    assert propose["injected"] is True
+    # The pathological claim cleared the margin gate — the guard, not
+    # the scorer, is what stopped it.
+    assert propose["margin_x1e6"] > 0
+    # Rollback landed INSIDE the guard window, with burn evidence.
+    assert rollback["reason"] == "burn"
+    assert max(rollback["burns"].values()) > 2.0
+    assert rollback["t_ns"] - canary["t_ns"] <= \
+        (SMOKE_KW["ticks"] // 3 + 2) * 1_000_000
+    # Every surviving member ended on the REFERENCE profile: the
+    # pathological band is nowhere.
+    ref = r["autopilot"]["status"]["reference"]
+    for name, adopted in r["autopilot"]["members"].items():
+        for k, v in ref.items():
+            assert adopted.get(k) == v, (name, k)
+    # No-job-lost held across the whole episode (the "never an
+    # outage" half of the gate).
+    st = r["stats"]
+    assert st["admitted"] == st["completed"] > 0
+    assert r["trace_digest"] == GOLDEN_AP_TRACE_DIGEST
+    assert r["report_digest"] == GOLDEN_AP_REPORT_DIGEST
+
+
+def test_autopilot_chaos_rollback_is_deterministic():
+    """Same seed ⇒ same digests AND the same rollback decision — the
+    canary-rollback-determinism satellite: the digest payload covers
+    the decision history, so digest equality IS decision equality;
+    asserted directly too."""
+    a = run_federation_chaos(**SMOKE_KW)
+    b = run_federation_chaos(**SMOKE_KW)
+    assert a["trace_digest"] == b["trace_digest"]
+    assert a["report_digest"] == b["report_digest"]
+    assert a["autopilot"]["history"] == b["autopilot"]["history"]
+    assert a["autopilot"]["knob_adoptions"] == \
+        b["autopilot"]["knob_adoptions"]
+    c = run_federation_chaos(**{**SMOKE_KW, "seed": 1})
+    assert c["trace_digest"] != a["trace_digest"]
+    assert c["ok"] is True  # the gate holds on other seeds too
+
+
+def test_autopilot_disarmed_is_byte_identical_to_plain_federation():
+    """The observer contract: autopilot=None consults no autopilot
+    fault stream and keys nothing into the digest payload — the plain
+    scenario's goldens (pinned in tests/test_federation_chaos.py)
+    still hold from this module's import state too."""
+    from tests.test_federation_chaos import (
+        GOLDEN_REPORT_DIGEST,
+        GOLDEN_TRACE_DIGEST,
+        SMOKE_KW as PLAIN_KW,
+    )
+
+    r = run_federation_chaos(**PLAIN_KW)
+    assert "autopilot" not in r
+    assert r["trace_digest"] == GOLDEN_TRACE_DIGEST
+    assert r["report_digest"] == GOLDEN_REPORT_DIGEST
+
+
+def test_pathological_params_are_registry_legal():
+    """The adversary is in-range BY DESIGN: if the registry could
+    reject the pathological profile, the chaos gate would be testing
+    validation, not the guard."""
+    from pbs_tpu.autopilot import PATHOLOGICAL_PARAMS
+
+    knobs = params_to_knobs("feedback", PATHOLOGICAL_PARAMS)
+    assert knobs["sched.feedback.tslice_max_us"] == 10
+
+
+def test_autopilot_plan_validates():
+    plan = FaultPlan.autopilot(0)
+    points = {s.point for s in plan.specs}
+    assert "autopilot.candidate" in points
+    assert "gateway.death" in points  # the federation attack rides along
+
+
+@pytest.mark.slow
+def test_autopilot_chaos_catalog_soak():
+    """Every workload class, two seeds: the gate (rollback of the
+    injected candidate + books + determinism) holds across the
+    catalog."""
+    for workload in workload_names():
+        for seed in (0, 1):
+            kw = dict(workload=workload, seed=seed, n_gateways=3,
+                      n_tenants=4, ticks=240, autopilot=True)
+            a = run_federation_chaos(**kw)
+            assert a["ok"] is True, (workload, seed, a["problems"])
+            events = [e["event"] for e in a["autopilot"]["history"]]
+            assert "rollback" in events, (workload, seed, events)
+            b = run_federation_chaos(**kw)
+            assert b["trace_digest"] == a["trace_digest"]
+            assert b["report_digest"] == a["report_digest"]

