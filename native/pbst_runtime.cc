@@ -373,9 +373,14 @@ uint64_t pbst_db_wait(const uint64_t* buf, uint64_t last_seq,
     uint64_t s = __atomic_load_n(&buf[2], __ATOMIC_ACQUIRE);
     if (s != last_seq) return s;
     clock_gettime(CLOCK_MONOTONIC, &now);
-    uint64_t el = (uint64_t)(now.tv_sec - start.tv_sec) * 1000000ULL +
-                  (uint64_t)(now.tv_nsec - start.tv_nsec) / 1000ULL;
-    if (el >= timeout_us) return s;
+    // Signed arithmetic: when the window crosses a whole-second
+    // boundary, tv_nsec goes BACKWARD and an unsigned delta wraps to
+    // ~2^54 us, returning the wait early — seen as the tier-1
+    // test_wait_returns_on_ring_and_timeout flake (any 0.2 s wait had
+    // a ~20% chance of straddling a second edge).
+    int64_t el = (int64_t)(now.tv_sec - start.tv_sec) * 1000000LL +
+                 ((int64_t)now.tv_nsec - (int64_t)start.tv_nsec) / 1000LL;
+    if (el >= (int64_t)timeout_us) return s;
     nanosleep(&nap, nullptr);
   }
 }

@@ -26,6 +26,7 @@ from pbs_tpu.analysis.netdiscipline import NetDisciplinePass
 from pbs_tpu.analysis.obspass import ObsDisciplinePass
 from pbs_tpu.analysis.perfpass import PerfDisciplinePass
 from pbs_tpu.analysis.rolloutpass import RolloutDisciplinePass
+from pbs_tpu.analysis.scenariopass import ScenarioDisciplinePass
 from pbs_tpu.analysis.schedops import SchedOpsPass
 from pbs_tpu.analysis.units import TimeUnitPass
 
@@ -41,6 +42,7 @@ ALL_PASSES: tuple[type[Pass], ...] = (
     ObsDisciplinePass,
     KnobDisciplinePass,
     RolloutDisciplinePass,
+    ScenarioDisciplinePass,
 )
 
 

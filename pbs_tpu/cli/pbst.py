@@ -1472,6 +1472,167 @@ def autopilot_entry() -> None:
     sys.exit(main(["autopilot", *sys.argv[1:]]))
 
 
+def cmd_scenarios(args) -> int:
+    """Coverage-guided adversarial scenario frontier
+    (pbs_tpu.scenarios; docs/SCENARIOS.md).
+
+    ``hunt`` runs the MAP-Elites search (``--demo``: the tier-1 smoke
+    shape, ≤5 s) and prints — or writes with ``--out`` — the archive
+    document. ``promote`` graduates a hunt archive's per-axis best
+    entries into corpus files (default: the checked-in
+    pbs_tpu/scenarios/corpus/). ``replay`` re-runs the corpus through
+    the chaos invariant gate; ``--check`` additionally demands
+    byte-identical golden digests — the CI regression mode, exit 1 on
+    any drift (exactly like `pbst tune --check`)."""
+    from pbs_tpu import scenarios
+
+    if args.action == "hunt":
+        if args.knobs:
+            # A fresh process only sees registry defaults; adopt the
+            # channel file's values into the process overlay so
+            # `pbst knobs set --channel F scenarios.hunt.population=32`
+            # actually reshapes THIS hunt (HuntConfig.from_knobs and
+            # the scoring-weight snapshot both read through it).
+            from pbs_tpu import knobs as registry
+            from pbs_tpu.knobs.channel import KnobChannel
+
+            try:
+                _, vals = KnobChannel.attach(args.knobs).snapshot()
+                registry.set_local(vals)
+            except (OSError, ValueError) as e:
+                print(f"pbst: bad --knobs {args.knobs!r}: {e}",
+                      file=sys.stderr)
+                return 2
+        cfg = (scenarios.HuntConfig.demo(seed=args.seed) if args.demo
+               else scenarios.HuntConfig.from_knobs(seed=args.seed))
+        progress = (None if args.json
+                    else lambda line: print(line, file=sys.stderr))
+        result = scenarios.hunt(cfg, workers=args.workers,
+                                progress=progress)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(result, f, indent=1, sort_keys=True)
+                f.write("\n")
+            print(f"wrote {args.out}", file=sys.stderr)
+        if args.json:
+            print(json.dumps(result, indent=1, sort_keys=True))
+        else:
+            print(f"{'signature':<12} {'score':>9} "
+                  f"{' '.join(f'{a:>9}' for a in scenarios.AXES)}")
+            for sig in sorted(
+                    result["archive"],
+                    key=lambda s: (-result["archive"][s]["score"], s)):
+                e = result["archive"][sig]
+                print(f"{sig:<12} {e['score']:>9.4f} "
+                      + " ".join(f"{e['axes'][a]:>9.4f}"
+                                 for a in scenarios.AXES))
+            print(f"archive {len(result['archive'])} entr(ies), "
+                  f"{len(result['rejected'])} gate-rejected, "
+                  f"digest {result['archive_digest'][:16]}…")
+        return 0
+
+    if args.action == "promote":
+        if not args.archive:
+            print("pbst: promote needs --archive FILE (written by "
+                  "`scenarios hunt --out FILE`)", file=sys.stderr)
+            return 2
+        try:
+            with open(args.archive) as f:
+                hunt_result = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"pbst: bad --archive {args.archive!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        axes = (tuple(a.strip() for a in args.axes.split(",")
+                      if a.strip())
+                if args.axes else scenarios.PROMOTE_AXES)
+        if not axes:
+            print(f"pbst: --axes {args.axes!r} names no stress axes",
+                  file=sys.stderr)
+            return 2
+        try:
+            outcomes = scenarios.promote_frontier(
+                hunt_result, corpus_dir=args.corpus, axes=axes)
+        except (KeyError, ValueError) as e:
+            print(f"pbst: promote failed: {e}", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps({"version": 1, "outcomes": outcomes},
+                             indent=1, sort_keys=True))
+        else:
+            for o in outcomes:
+                if o["promoted"]:
+                    print(f"{o['axis']:<9} promoted {o['name']} "
+                          f"(axis {o['axis_value']:.4f}, score "
+                          f"{o['score']:.4f}) -> {o['path']}")
+                else:
+                    print(f"{o['axis']:<9} SKIPPED: {o['reason']}")
+        return 0 if all(o["promoted"] for o in outcomes) else 1
+
+    if args.action == "replay":
+        try:
+            result = scenarios.replay_corpus(corpus_dir=args.corpus,
+                                             check=args.check)
+        except (OSError, ValueError) as e:
+            print(f"pbst: bad corpus: {e}", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(result, indent=1, sort_keys=True))
+        else:
+            for v in result["verdicts"]:
+                status = "ok" if v["ok"] else "FAILED"
+                line = f"{v['name']:<22} {v['axis'] or '-':<9} {status}"
+                if not v["ok"]:
+                    line += f" ({'; '.join(v['problems'][:2])})"
+                print(line)
+            print(f"{'ok' if result['ok'] else 'FAILED'} "
+                  f"({result['entries']} scenario(s), corpus digest "
+                  f"{result['corpus_digest'][:16]}…"
+                  f"{', digests checked' if args.check else ''})")
+        if not result["verdicts"]:
+            print("pbst: corpus is empty "
+                  f"(dir: {result['corpus_dir']})", file=sys.stderr)
+            return 2
+        return 0 if result["ok"] else 1
+
+    if args.action == "whatif":
+        paths = scenarios.corpus_paths(args.corpus)
+        if not paths:
+            print("pbst: corpus is empty "
+                  f"(dir: {args.corpus or scenarios.CORPUS_DIR})",
+                  file=sys.stderr)
+            return 2
+        out = []
+        for p in paths:
+            try:
+                out.append(scenarios.whatif_entry(
+                    scenarios.load_entry(p), workers=args.workers))
+            except (OSError, ValueError) as e:
+                print(f"pbst: bad corpus entry {p!r}: {e}",
+                      file=sys.stderr)
+                return 2
+        if args.json:
+            print(json.dumps({"version": 1, "whatif": out},
+                             indent=1, sort_keys=True))
+        else:
+            for w in out:
+                pr = w["proposal"]
+                print(f"{w['name']:<22} class={w['workload_class']:<9} "
+                      f"arrivals={w['arrivals']:<5} "
+                      f"margin={pr['margin_x1e6'] / 1e6:+.6f} "
+                      f"candidate={json.dumps(pr['candidate'], sort_keys=True)}")
+        return 0
+
+    print(f"pbst: unknown scenarios action {args.action!r}",
+          file=sys.stderr)
+    return 2
+
+
+def scenarios_entry() -> None:
+    """Console entry ``pbst-scenarios``."""
+    sys.exit(main(["scenarios", *sys.argv[1:]]))
+
+
 def cmd_tune(args) -> int:
     """Simulation-driven policy autotuning (pbs_tpu.sched.tune;
     docs/TUNE.md). Default: run the successive-halving search for the
@@ -2095,6 +2256,43 @@ def main(argv=None) -> int:
                          "pbs_tpu/sched/tuned/)")
     sp.add_argument("--json", action="store_true")
     sp.set_defaults(fn=cmd_tune)
+
+    sp = sub.add_parser(
+        "scenarios", help="adversarial scenario frontier + promoted "
+                          "regression corpus (docs/SCENARIOS.md)")
+    sp.add_argument("action",
+                    choices=["hunt", "promote", "replay", "whatif"])
+    sp.add_argument("--demo", action="store_true",
+                    help="hunt: the tier-1 smoke shape (tiny "
+                         "population/horizons, <=5 s)")
+    sp.add_argument("--seed", type=int, default=0,
+                    help="hunt seed (sha256-derived streams; same "
+                         "seed => byte-identical archive digest)")
+    sp.add_argument("--workers", type=int, default=1,
+                    help="evaluation worker processes (1 = inline; "
+                         "archive digest is worker-count invariant)")
+    sp.add_argument("--out", metavar="FILE",
+                    help="hunt: also write the archive document here "
+                         "(feeds `scenarios promote --archive`)")
+    sp.add_argument("--archive", metavar="FILE",
+                    help="promote: hunt document written by "
+                         "`scenarios hunt --out`")
+    sp.add_argument("--axes", default=None,
+                    help="promote: comma-separated stress axes "
+                         "(default: burn,fairness,slack)")
+    sp.add_argument("--corpus", metavar="DIR", default=None,
+                    help="promote/replay: corpus directory (default: "
+                         "the checked-in pbs_tpu/scenarios/corpus/)")
+    sp.add_argument("--check", action="store_true",
+                    help="replay: demand byte-identical golden "
+                         "digests (the CI regression gate)")
+    sp.add_argument("--knobs", metavar="CHANNEL", default=None,
+                    help="hunt: adopt a knob-channel file's values "
+                         "(scenarios.hunt.* / scenarios.score.w_*) "
+                         "before configuring the hunt — pairs with "
+                         "`pbst knobs set --channel CHANNEL ...`")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_scenarios)
 
     sp = sub.add_parser("demo", help="run the two-tenant sim demo")
     sp.add_argument("--scheduler", default="credit")

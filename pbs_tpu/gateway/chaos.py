@@ -78,6 +78,29 @@ def draw_arrival(t, rng) -> tuple[bool, int]:
     return u < 0.15, 4 + int(rng.integers(0, 9))
 
 
+class ArrivalModel:
+    """Pluggable per-tick arrival shape for the chaos harnesses
+    (``arrival_model=``). The default (None) is :func:`draw_arrival`,
+    byte-identical to the pre-hook harnesses; a custom model (the
+    scenario genome's traffic shapes — docs/SCENARIOS.md) owns its
+    tenants' rng streams and MUST consume a fixed number of draws per
+    ``draw`` call so its decision stream is a pure function of the
+    seed. ``note_result`` closes the loop for reactive shapes (retry
+    storms re-submitting after a shed)."""
+
+    def draw(self, t, tick: int, rng) -> tuple[bool, int]:
+        return draw_arrival(t, rng)
+
+    def note_result(self, tenant: str, tick: int,
+                    admitted: bool) -> None:
+        pass
+
+
+def _tenant_slo_info(tenants) -> dict:
+    return {t.name: {"slo": t.slo, "slo_target_ns": t.slo_target_ns}
+            for t in tenants}
+
+
 def _span_continuity(recorder: SpanRecorder, admitted_rids: list[str],
                      problems: list[str]) -> tuple[SpanAssembler, Any]:
     """The span-continuity invariant both harnesses gate on
@@ -118,9 +141,7 @@ def _export_obs(recorder: SpanRecorder, recs, obs_dir: str | None,
         return
     recorder.export(
         obs_dir, run_meta=run_meta,
-        tenants={t.name: {"slo": t.slo,
-                          "slo_target_ns": t.slo_target_ns}
-                 for t in tenants},
+        tenants=_tenant_slo_info(tenants),
         recs=recs)
 
 
@@ -131,10 +152,13 @@ def run_gateway_chaos(workload: str = "mixed", seed: int = 0,
                       trace_path: str | None = None,
                       ledger_path: str | None = None,
                       kill_backend: bool = True,
-                      obs_dir: str | None = None) -> dict:
+                      obs_dir: str | None = None,
+                      arrival_model: ArrivalModel | None = None) -> dict:
     """One seeded gateway chaos scenario; returns the report dict
     (``ok`` = every invariant held). Installs the plan process-wide for
-    the duration — callers must not have their own plan armed."""
+    the duration — callers must not have their own plan armed.
+    ``arrival_model=None`` keeps the stock :func:`draw_arrival`
+    stream — and therefore every golden digest — byte-identical."""
     plan = plan if plan is not None else FaultPlan.gateway(seed)
     inj = faults_mod.install(plan, trace_path=trace_path)
     problems: list[str] = []
@@ -178,10 +202,16 @@ def run_gateway_chaos(workload: str = "mixed", seed: int = 0,
             if tick == kill_at:
                 backends[0].fail()
             for t in tenants:
-                fire, cost = draw_arrival(t, arrivals[t.name])
+                if arrival_model is None:
+                    fire, cost = draw_arrival(t, arrivals[t.name])
+                else:
+                    fire, cost = arrival_model.draw(
+                        t, tick, arrivals[t.name])
                 if not fire:
                     continue
                 r = gw.submit(t.name, {"tick": tick}, cost=cost)
+                if arrival_model is not None:
+                    arrival_model.note_result(t.name, tick, r.admitted)
                 if r.admitted:
                     admitted_rids.append(r.rid)
                 else:
@@ -242,6 +272,11 @@ def run_gateway_chaos(workload: str = "mixed", seed: int = 0,
         "killed_backend": backends[0].name if kill_at >= 0 else None,
         "stats": st,
         "spans": asm.summary(),
+        # Per-tenant SLO view off the SAME span chains the continuity
+        # invariant just validated — the stress scorer's burn-rate
+        # input (pbs_tpu/scenarios/score.py). Report-only: digests
+        # never cover it.
+        "slo": asm.slo_report(tenants=_tenant_slo_info(tenants)),
         "faults_fired": dict(sorted(fault_counts.items())),
         "trace_digest": inj.trace_digest(),
         "problems": problems,
@@ -282,7 +317,9 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
                          drain_rejoin: bool = True,
                          obs_dir: str | None = None,
                          knob_plan: list[dict] | None = None,
-                         autopilot: "bool | dict | None" = None) -> dict:
+                         autopilot: "bool | dict | None" = None,
+                         arrival_model: ArrivalModel | None = None
+                         ) -> dict:
     """One seeded federated-gateway chaos scenario; returns the report
     dict (``ok`` = every invariant held). Gateway deaths, partitions,
     and lease expiries come from the armed plan; a drain of a seeded
@@ -315,7 +352,12 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
     values, and no-job-lost + the piecewise mint bound hold
     throughout; the loop's every decision and member adoption is
     keyed into the report digest. ``autopilot=None`` keeps the digest
-    payload byte-identical to the pre-autopilot harness."""
+    payload byte-identical to the pre-autopilot harness.
+
+    ``arrival_model`` swaps the stock :func:`draw_arrival` stream for
+    a custom :class:`ArrivalModel` (the scenario-genome traffic
+    shapes, docs/SCENARIOS.md); ``None`` keeps every golden digest
+    byte-identical."""
     # Armed on any non-None, non-False value: autopilot={} means "the
     # default-configured loop", not "off" (truthiness would silently
     # disarm it).
@@ -465,10 +507,16 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
                     "gwr0", 97, clock, tick_ns, seed,
                     backends_per_gateway, n_tenants))
             for t in tenants:
-                fire, cost = draw_arrival(t, arrivals[t.name])
+                if arrival_model is None:
+                    fire, cost = draw_arrival(t, arrivals[t.name])
+                else:
+                    fire, cost = arrival_model.draw(
+                        t, tick, arrivals[t.name])
                 if not fire:
                     continue
                 r = fed.submit(t.name, {"tick": tick}, cost=cost)
+                if arrival_model is not None:
+                    arrival_model.note_result(t.name, tick, r.admitted)
                 if r.admitted:
                     admitted_cost[t.name] = \
                         admitted_cost.get(t.name, 0.0) + cost
@@ -684,6 +732,9 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
         "events": events,
         "stats": st,
         "spans": asm.summary(),
+        # Report-only SLO view (never digest-covered) — see
+        # run_gateway_chaos.
+        "slo": asm.slo_report(tenants=_tenant_slo_info(tenants)),
         "lease_audit": {t: {k: round(v, 6) for k, v in a.items()}
                         for t, a in sorted(audit.items())},
         "faults_fired": dict(sorted(fault_counts.items())),

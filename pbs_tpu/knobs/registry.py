@@ -56,7 +56,7 @@ UNITS = ("ns", "us", "ms", "s", "", "per_s", "tokens", "records",
          "steps", "flop_per_s", "bytes_per_s")
 
 SUBSYSTEMS = ("sched", "gateway", "telemetry", "obs", "runtime", "dist",
-              "autopilot")
+              "autopilot", "scenarios")
 
 
 class KnobError(ValueError):
@@ -417,6 +417,53 @@ _declare("autopilot.switch_cost_ns", "int", "ns",
              "this is ~9% overhead; at the pathological collapsed "
              "10 us band it is ~11x, which is what the canary guard "
              "must catch")
+
+# -- scenarios: the coverage-guided adversarial frontier search
+# (pbs_tpu/scenarios/; docs/SCENARIOS.md). Declared here so a hunt is
+# tunable with `pbst knobs set` instead of code edits, and so the
+# knob-discipline pass owns these constants like every other loop's.
+_declare("scenarios.hunt.population", "int", "",
+         8, 1, 256,
+         doc="candidate genomes evaluated per hunt generation")
+_declare("scenarios.hunt.generations", "int", "",
+         4, 1, 1024,
+         doc="hunt generations (evaluate -> admit -> breed rounds)")
+_declare("scenarios.hunt.mutation_rate", "float", "",
+         0.35, 0.0, 1.0,
+         doc="per-gene perturbation probability of the mutate "
+             "operator (at least one gene always moves)")
+_declare("scenarios.hunt.crossover_rate", "float", "",
+         0.5, 0.0, 1.0,
+         doc="probability a child is bred by elite crossover instead "
+             "of elite mutation")
+_declare("scenarios.hunt.archive_buckets", "int", "",
+         6, 2, 64,
+         doc="behavior-signature buckets per stress axis (the "
+             "MAP-Elites grid resolution)")
+_declare("scenarios.hunt.archive_max", "int", "",
+         64, 1, 10_000,
+         doc="elite-archive bound; lowest-stress entries are evicted "
+             "past it (evictions are logged, never silent)")
+_declare("scenarios.score.w_burn", "float", "",
+         1.0, 0.0, 100.0,
+         doc="stress weight: worst per-tenant SLO burn rate "
+             "(normalized b/(1+b))")
+_declare("scenarios.score.w_fairness", "float", "",
+         1.0, 0.0, 100.0,
+         doc="stress weight: Jain fairness collapse (1 - jain) under "
+             "the sim harness")
+_declare("scenarios.score.w_slack", "float", "",
+         1.0, 0.0, 100.0,
+         doc="stress weight: lease-audit slack (conservative spend "
+             "fraction of all token-backed spend)")
+_declare("scenarios.score.w_gap", "float", "",
+         0.5, 0.0, 100.0,
+         doc="stress weight: span-gap proximity (custody transfers — "
+             "handoffs+requeues — per admitted request)")
+_declare("scenarios.score.w_shed", "float", "",
+         0.5, 0.0, 100.0,
+         doc="stress weight: shed asymmetry (max-min per-tenant shed "
+             "fraction spread at the front door)")
 
 # -- telemetry.source hardware model (telemetry/source.py)
 _declare("telemetry.source.peak_flops", "float", "flop_per_s",

@@ -36,9 +36,13 @@ from pbs_tpu.sim.trace import (
     trace_meta,
 )
 from pbs_tpu.sim.workload import (
+    TENANT_KINDS,
     WORKLOADS,
     TenantSpec,
     build_workload,
+    make_mix,
+    register_workload,
+    unregister_workload,
     workload_names,
 )
 
@@ -67,8 +71,12 @@ __all__ = [
     "recorded_steps",
     "replay_partition",
     "trace_meta",
+    "TENANT_KINDS",
     "WORKLOADS",
     "TenantSpec",
     "build_workload",
+    "make_mix",
+    "register_workload",
+    "unregister_workload",
     "workload_names",
 ]

@@ -26,6 +26,7 @@ Catalog (the mixes the harness sweeps):
 from __future__ import annotations
 
 import dataclasses
+from typing import Any
 
 import numpy as np
 
@@ -164,33 +165,67 @@ def bursty_serving(i: int, rng: np.random.Generator,
 
 # -- mixes ------------------------------------------------------------------
 
+#: Tenant-generator vocabulary of :func:`make_mix` — the kind names a
+#: mix (or a scenario genome) composes tenants from. ``serve`` is the
+#: only generator that consumes the horizon (its wake/sleep schedule
+#: must cover the run).
+TENANT_KINDS = ("hbm", "coll", "compute", "alt", "serve")
+
+_MAKERS = {
+    "hbm": hbm_stall_heavy,
+    "coll": collective_contended,
+    "compute": compute_bound,
+    "alt": phase_alternating,
+}
+
+
+def make_mix(kinds, seed: int, horizon_ns: int) -> list[TenantSpec]:
+    """THE parameterized mix constructor: one tenant per entry of
+    ``kinds`` (each a :data:`TENANT_KINDS` name), tenant ``i`` seeded
+    from ``_rng(seed, i)`` exactly like the hand-written catalog always
+    did. Both the catalog mixes below and the scenario-genome bridge
+    (``pbs_tpu.scenarios.genome``) build through here, so a generator
+    tweak moves every consumer together instead of forking two
+    diverging copies."""
+    out: list[TenantSpec] = []
+    for i, kind in enumerate(kinds):
+        if kind == "serve":
+            out.append(bursty_serving(i, _rng(seed, i), horizon_ns))
+        else:
+            try:
+                maker = _MAKERS[kind]
+            except KeyError:
+                raise KeyError(
+                    f"unknown tenant kind {kind!r}; "
+                    f"available: {list(TENANT_KINDS)}") from None
+            out.append(maker(i, _rng(seed, i)))
+    return out
+
 
 def _mix_stable(seed, n, horizon_ns):
-    return [hbm_stall_heavy(i, _rng(seed, i)) for i in range(n)]
+    return make_mix(["hbm"] * n, seed, horizon_ns)
 
 
 def _mix_contended(seed, n, horizon_ns):
-    return [collective_contended(i, _rng(seed, i)) for i in range(n)]
+    return make_mix(["coll"] * n, seed, horizon_ns)
 
 
 def _mix_phases(seed, n, horizon_ns):
-    return [phase_alternating(i, _rng(seed, i)) for i in range(n)]
+    return make_mix(["alt"] * n, seed, horizon_ns)
 
 
 def _mix_serving(seed, n, horizon_ns):
     # The always-on trainer keeps the partition busy between bursts so
     # the run loop never drains (and it is the victim whose quanta the
     # serving tenants' wake latency depends on).
-    out = [hbm_stall_heavy(0, _rng(seed, 0))]
-    out += [bursty_serving(i, _rng(seed, i), horizon_ns)
-            for i in range(1, max(2, n))]
-    return out
+    return make_mix(["hbm"] + ["serve"] * (max(2, n) - 1),
+                    seed, horizon_ns)
 
 
 def _mix_mixed(seed, n, horizon_ns):
-    makers = (hbm_stall_heavy, collective_contended, compute_bound,
-              phase_alternating)
-    return [makers[i % len(makers)](i, _rng(seed, i)) for i in range(n)]
+    cycle = ("hbm", "coll", "compute", "alt")
+    return make_mix([cycle[i % len(cycle)] for i in range(n)],
+                    seed, horizon_ns)
 
 
 WORKLOADS = {
@@ -201,6 +236,30 @@ WORKLOADS = {
     "mixed": _mix_mixed,
 }
 
+#: Dynamically registered workload builders (scenario genomes, test
+#: rigs). Deliberately NOT part of :func:`workload_names` — the
+#: catalog is the stable sweep/parametrization surface; registered
+#: workloads are transient, process-local bridges into the harnesses.
+_DYNAMIC: dict[str, Any] = {}
+
+
+def register_workload(name: str, builder) -> str:
+    """Register a transient workload builder (signature
+    ``builder(seed, n_tenants, horizon_ns) -> list[TenantSpec]``) so
+    the sim engine and chaos harnesses can run it by name. Catalog
+    names are reserved; re-registering the same name replaces it
+    (a genome's name embeds its content digest, so a replacement is
+    byte-identical by construction). Returns the name."""
+    if name in WORKLOADS:
+        raise KeyError(f"workload {name!r} is a catalog mix; "
+                       "registered workloads must not shadow it")
+    _DYNAMIC[name] = builder
+    return name
+
+
+def unregister_workload(name: str) -> None:
+    _DYNAMIC.pop(name, None)
+
 
 def workload_names() -> list[str]:
     return sorted(WORKLOADS)
@@ -208,10 +267,11 @@ def workload_names() -> list[str]:
 
 def build_workload(name: str, seed: int = 0, n_tenants: int = 4,
                    horizon_ns: int = 2 * SEC) -> list[TenantSpec]:
-    try:
-        mix = WORKLOADS[name]
-    except KeyError:
+    mix = WORKLOADS.get(name)
+    if mix is None:
+        mix = _DYNAMIC.get(name)
+    if mix is None:
         raise KeyError(
-            f"unknown workload {name!r}; available: {workload_names()}"
-        ) from None
+            f"unknown workload {name!r}; available: {workload_names()} "
+            f"(+{len(_DYNAMIC)} registered)")
     return mix(seed, max(1, int(n_tenants)), int(horizon_ns))

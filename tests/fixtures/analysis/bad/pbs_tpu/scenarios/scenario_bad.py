@@ -1,0 +1,18 @@
+"""Seeded violations for the scenario-discipline pass."""
+
+from pbs_tpu.scenarios.genome import Genome
+
+# BAD: hand-built genome bypasses the gene-table validation and the
+# seeded-factory provenance (scenario-raw-genome).
+hand_built = Genome(genes=(("n_tenants", 4),))
+
+# BAD: qualified constructor path is the same escape.
+import pbs_tpu.scenarios.genome as genome_mod
+
+also_bad = genome_mod.Genome(genes=())
+
+
+def breed(parent):
+    # GOOD (not flagged): the seeded factories.
+    child = parent.mutate(7)
+    return child.crossover(parent, 8)

@@ -1,0 +1,13 @@
+"""Behavior twin of scenario_bad.py that follows the convention."""
+
+from pbs_tpu.scenarios.genome import Genome
+
+# GOOD: genomes come from the seeded factories only.
+seeded = Genome.from_seed(0)
+
+restored = Genome.from_dict(seeded.as_dict())
+
+
+def breed(parent):
+    child = parent.mutate(7)
+    return child.crossover(parent, 8)

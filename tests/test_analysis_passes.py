@@ -55,6 +55,7 @@ def test_all_rules_fire_on_bad_tree():
         "knob-unrouted", "knob-inline-tunable", "knob-unknown",
         "knob-unit-drift", "knob-native-drift",
         "rollout-push", "rollout-set-local",
+        "scenario-corpus-golden", "scenario-raw-genome",
     }
 
 
@@ -117,7 +118,7 @@ def test_cli_list_passes(capsys):
     for pid in ("lock-discipline", "time-units", "sched-ops",
                 "counter-api", "gateway-discipline", "perf-discipline",
                 "obs-discipline", "knob-discipline",
-                "rollout-discipline"):
+                "rollout-discipline", "scenario-discipline"):
         assert pid in out
 
 
