@@ -19,6 +19,7 @@ from typing import Any, Iterable
 
 from pbs_tpu.analysis.core import CheckContext, Finding, Pass, SourceFile
 from pbs_tpu.analysis.counterapi import CounterApiPass
+from pbs_tpu.analysis.durabilitypass import DurabilityPass
 from pbs_tpu.analysis.gatewaypass import GatewayDisciplinePass
 from pbs_tpu.analysis.knobspass import KnobDisciplinePass
 from pbs_tpu.analysis.locks import LockDisciplinePass
@@ -43,6 +44,7 @@ ALL_PASSES: tuple[type[Pass], ...] = (
     KnobDisciplinePass,
     RolloutDisciplinePass,
     ScenarioDisciplinePass,
+    DurabilityPass,
 )
 
 

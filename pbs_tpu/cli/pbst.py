@@ -1091,17 +1091,27 @@ def cmd_chaos(args) -> int:
     (gateway/federation.py: gateway deaths, partitions, lease
     expiries, plus a seeded drain + rejoin schedule) with the
     no-job-lost AND no-rate-inflation invariants.
+    ``--plan crash`` is the federation plan plus seeded kill-9s of
+    the WHOLE process state, recovered from the write-ahead intent
+    journal alone (docs/DURABILITY.md).
     ``--selfcheck`` runs the scenario twice and requires identical
     digests. Exit 0 = every invariant held."""
     from pbs_tpu.faults import FaultPlan, run_chaos
 
-    if args.plan == "federation":
-        from pbs_tpu.gateway import run_federation_chaos
+    if args.plan in ("federation", "crash"):
+        from pbs_tpu.gateway import run_federation_chaos, stock_crash_plan
 
+        ticks = args.rounds * 80
         kw = dict(workload=args.workload, seed=args.seed,
                   n_gateways=args.gateways, n_tenants=args.tenants,
-                  ticks=args.rounds * 80, trace_path=args.trace,
+                  ticks=ticks, trace_path=args.trace,
                   obs_dir=args.obs)
+        if args.plan == "crash":
+            # The kill-9 plan (docs/DURABILITY.md): the federation
+            # plan PLUS seeded whole-process deaths — one torn
+            # mid-frame journal commit, one tick-boundary kill —
+            # recovered from journal bytes alone.
+            kw["crash_plan"] = stock_crash_plan(ticks)
         report = run_federation_chaos(**kw)
         ok = report["ok"]
         if args.selfcheck:
@@ -1117,9 +1127,23 @@ def cmd_chaos(args) -> int:
             print(json.dumps(report, indent=1, sort_keys=True))
         else:
             st = report["stats"]
-            print(f"federation chaos workload={report['workload']} "
+            label = ("crash chaos" if args.plan == "crash"
+                     else "federation chaos")
+            print(f"{label} workload={report['workload']} "
                   f"seed={report['seed']} gateways={report['gateways']} "
                   f"ticks={report['ticks']}")
+            if "crash" in report:
+                c = report["crash"]
+                print(f"recoveries={c['recoveries']} "
+                      f"unacked={c['unacked']} "
+                      f"final_generation={c['final_generation']}")
+                for e in c["events"]:
+                    print(f"  kill {e['kind']} @ {e['position']} -> "
+                          f"gen {e['generation']} "
+                          f"(recovered {e['recovered']}, requeued "
+                          f"{e['requeued_inflight']}, torn "
+                          f"{e['torn_bytes']} B, unacked "
+                          f"{e['unacked']})")
             print(f"admitted={st['admitted']} completed={st['completed']} "
                   f"handoffs={st['handoffs']} remaps={st['remaps']} "
                   f"lease_refusals={st['lease_refusals']} "
@@ -1232,6 +1256,60 @@ def chaos_entry() -> None:
     """Console entry ``pbst-chaos`` (CI convenience: exactly
     ``pbst chaos ...`` without the subcommand word)."""
     sys.exit(main(["chaos", *sys.argv[1:]]))
+
+
+def cmd_journal(args) -> int:
+    """Inspect a write-ahead gateway journal (docs/DURABILITY.md).
+
+    ``dump``   — every sealed record as stable sorted-key JSON
+                 (intern table applied, float odometers unpacked).
+    ``verify`` — validate frames/CRCs and summarize.
+
+    Exit-code contract (both actions): 0 = valid, possibly with a
+    torn-tail WARNING (a crash artifact — expected, never trusted);
+    2 = corrupt body (CRC/marker mismatch on a complete frame) or not
+    a journal at all. A torn tail never exits nonzero: recovery
+    handles it by design, and CI must distinguish 'crashed while
+    writing' from 'bits rotted'."""
+    from pbs_tpu.gateway.journal import (
+        JournalCorrupt,
+        format_record,
+        iter_interned,
+        read_journal,
+    )
+
+    try:
+        view = read_journal(args.path)
+    except JournalCorrupt as e:
+        print(f"pbst journal: CORRUPT: {e}", file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"pbst journal: cannot read {args.path!r}: {e}",
+              file=sys.stderr)
+        return 2
+    names = {sid: name for name, sid in iter_interned(view.records)}
+    warnings = []
+    if view.torn_bytes:
+        warnings.append(
+            f"torn tail: {view.torn_bytes} trailing byte(s) past the "
+            f"last sealed frame (crash artifact; never replayed)")
+    doc = {
+        "path": args.path,
+        "generation": view.generation,
+        "frames": view.frames,
+        "records": len(view.records),
+        "valid_bytes": view.valid_bytes,
+        "torn_bytes": view.torn_bytes,
+        "warnings": warnings,
+    }
+    if args.action == "dump":
+        doc["entries"] = [format_record(r, names)
+                          for r in view.records]
+    print(json.dumps(doc, indent=1, sort_keys=True))
+    if warnings and not args.json_only:
+        for w in warnings:
+            print(f"pbst journal: WARNING: {w}", file=sys.stderr)
+    return 0
 
 
 def cmd_gateway(args) -> int:
@@ -2196,7 +2274,8 @@ def main(argv=None) -> int:
     sp.add_argument("--rounds", type=int, default=5)
     sp.add_argument("--plan", default="chaos",
                     help="'chaos', 'rpc', 'gateway', 'federation', "
-                         "'none', or a FaultPlan JSON path")
+                         "'crash' (federation + journal-recovered "
+                         "kill-9s), 'none', or a FaultPlan JSON path")
     sp.add_argument("--trace", default=None,
                     help="write the fault trace JSONL here")
     sp.add_argument("--obs", default=None, metavar="DIR",
@@ -2207,6 +2286,16 @@ def main(argv=None) -> int:
                     help="run twice; digests must match")
     sp.add_argument("--json", action="store_true")
     sp.set_defaults(fn=cmd_chaos)
+
+    sp = sub.add_parser(
+        "journal",
+        help="inspect a write-ahead gateway journal "
+             "(docs/DURABILITY.md)")
+    sp.add_argument("action", choices=["dump", "verify"])
+    sp.add_argument("path", help="journal file (e.g. gateway.jrnl)")
+    sp.add_argument("--json-only", action="store_true",
+                    help="suppress the stderr torn-tail warning lines")
+    sp.set_defaults(fn=cmd_journal)
 
     sp = sub.add_parser(
         "gateway", help="serving front door (docs/GATEWAY.md)")

@@ -29,6 +29,10 @@ point                 fault kinds                                 seam
 ``gateway.partition``  partition                                  gateway/federation.py
 ``lease.expire``      expire                                      gateway/federation.py
 ``autopilot.candidate``  pathological                             autopilot/pilot.py
+``journal.crash``     crash                                       gateway/journal.py
+                                                                  (mid-commit torn frame)
+``gateway.process.kill``  kill                                    gateway/chaos.py
+                                                                  (tick-boundary kill-9)
 ====================  ==========================================  ==============
 """
 
@@ -53,6 +57,8 @@ POINTS: dict[str, tuple[str, ...]] = {
     "gateway.partition": ("partition",),
     "lease.expire": ("expire",),
     "autopilot.candidate": ("pathological",),
+    "journal.crash": ("crash",),
+    "gateway.process.kill": ("kill",),
 }
 
 

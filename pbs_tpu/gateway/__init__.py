@@ -42,10 +42,22 @@ def __getattr__(name: str):
     # The chaos harnesses pull in the sim workload catalog; keep that
     # import lazy so `pbs_tpu.gateway` stays cheap for serving callers
     # (the same pattern as pbs_tpu.faults.run_chaos).
-    if name in ("run_gateway_chaos", "run_federation_chaos", "quota_for"):
+    if name in ("run_gateway_chaos", "run_federation_chaos", "quota_for",
+                "stock_crash_plan"):
         from pbs_tpu.gateway import chaos
 
         return getattr(chaos, name)
+    # Durability surface (docs/DURABILITY.md), lazy for the same
+    # reason: serving callers without a journal pay nothing.
+    if name in ("GatewayJournal", "JournalCorrupt", "ProcessKill",
+                "read_journal"):
+        from pbs_tpu.gateway import journal
+
+        return getattr(journal, name)
+    if name in ("recover_gateway", "recover_federation"):
+        from pbs_tpu.gateway import recovery
+
+        return getattr(recovery, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
@@ -58,11 +70,14 @@ __all__ = [
     "FederatedGateway",
     "GW_LEDGER_SLOTS",
     "Gateway",
+    "GatewayJournal",
     "HashRing",
     "INTERACTIVE",
+    "JournalCorrupt",
     "Lease",
     "LeaseBroker",
     "LeasedBucket",
+    "ProcessKill",
     "Request",
     "SLO_CLASSES",
     "Shed",
@@ -71,7 +86,11 @@ __all__ = [
     "TenantQuota",
     "TokenBucket",
     "quota_for",
+    "read_journal",
+    "recover_federation",
+    "recover_gateway",
     "run_federation_chaos",
     "run_gateway_chaos",
     "sched_feedback_sink",
+    "stock_crash_plan",
 ]

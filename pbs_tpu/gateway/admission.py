@@ -105,6 +105,7 @@ class TokenBucket:
 SHED_REASON_CODES = {
     "quota": 1, "tenant-queue-full": 2, "queue-full": 3,
     "unknown-tenant": 4, "injected-shed": 5, "cost-over-burst": 6,
+    "no-gateway": 7,  # federation-level: every front door unreachable
 }
 
 

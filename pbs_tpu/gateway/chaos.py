@@ -39,11 +39,17 @@ from typing import Any
 import numpy as np
 
 from pbs_tpu.faults import injector as faults_mod
-from pbs_tpu.faults.plan import FaultPlan
+from pbs_tpu.faults.plan import FaultPlan, FaultSpec
 from pbs_tpu.gateway.admission import INTERACTIVE, TenantQuota
 from pbs_tpu.gateway.backends import SimServeBackend
 from pbs_tpu.gateway.federation import FederatedGateway
 from pbs_tpu.gateway.gateway import Gateway
+from pbs_tpu.gateway.journal import (
+    GatewayJournal,
+    JournalError,
+    ProcessKill,
+    read_journal,
+)
 from pbs_tpu.obs.spans import SpanAssembler, SpanRecorder
 from pbs_tpu.sim.workload import build_workload
 from pbs_tpu.utils.clock import MS, SEC, VirtualClock
@@ -102,7 +108,9 @@ def _tenant_slo_info(tenants) -> dict:
 
 
 def _span_continuity(recorder: SpanRecorder, admitted_rids: list[str],
-                     problems: list[str]) -> tuple[SpanAssembler, Any]:
+                     problems: list[str],
+                     aborted: "set[str] | None" = None
+                     ) -> tuple[SpanAssembler, Any]:
     """The span-continuity invariant both harnesses gate on
     (docs/TRACING.md): every admitted rid has a COMPLETE, GAP-FREE
     chain (admit → terminal complete) in the recorder's ring — across
@@ -124,7 +132,7 @@ def _span_continuity(recorder: SpanRecorder, admitted_rids: list[str],
     asm = SpanAssembler(recs, recorder.rid_table(),
                         recorder.member_table(),
                         recorder.tenant_table())
-    chain_problems = asm.validate(admitted_rids)
+    chain_problems = asm.validate(admitted_rids, aborted=aborted)
     # Cap the spew: one run with a systemic gap would otherwise emit
     # thousands of identical lines.
     problems.extend(chain_problems[:20])
@@ -307,6 +315,51 @@ def _federation_member(name: str, salt: int, clock, tick_ns: int,
                    name=name)
 
 
+def stock_crash_plan(ticks: int) -> list[dict]:
+    """The ``pbst chaos --plan crash`` schedule: one mid-frame
+    journal-commit kill (torn tail on disk) early, one tick-boundary
+    kill-9 after the rejoin. Pure function of ``ticks``."""
+    return [
+        {"record": 360, "cut": 11},
+        {"tick": (2 * int(ticks)) // 3 + 7},
+    ]
+
+
+def _crash_specs(crash_plan: list[dict]) -> tuple[FaultSpec, ...]:
+    """crash_plan entries -> FaultSpecs on the two process-death
+    points (docs/DURABILITY.md):
+
+    - ``{"record": K, "cut": B}`` — kill the process mid-commit with
+      exactly K records durable and the next frame torn B bytes into
+      the offending record (``journal.crash``; ``after`` counts the
+      journal's cumulative record positions);
+    - ``{"tick": T}`` — kill-9 at the top of harness tick T, a clean
+      frame boundary (``gateway.process.kill``);
+    - ``{"p": x, "times": n}`` — seeded probabilistic tick kills (the
+      scenario genome's crash gene).
+    """
+    specs: list[FaultSpec] = []
+    for e in crash_plan:
+        if "record" in e:
+            specs.append(FaultSpec(
+                "journal.crash", "crash", p=1.0,
+                after=int(e["record"]), times=1,
+                args={"cut_bytes": int(e.get("cut", 12))}))
+        elif "tick" in e:
+            specs.append(FaultSpec(
+                "gateway.process.kill", "kill", p=1.0,
+                after=int(e["tick"]), times=1))
+        elif "p" in e:
+            specs.append(FaultSpec(
+                "gateway.process.kill", "kill", p=float(e["p"]),
+                after=int(e.get("after", 20)),
+                times=int(e.get("times", 2))))
+        else:
+            raise ValueError(f"crash_plan entry {e!r} names none of "
+                             "record/tick/p")
+    return tuple(specs)
+
+
 def run_federation_chaos(workload: str = "mixed", seed: int = 0,
                          n_gateways: int = 3,
                          backends_per_gateway: int = 2,
@@ -318,7 +371,8 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
                          obs_dir: str | None = None,
                          knob_plan: list[dict] | None = None,
                          autopilot: "bool | dict | None" = None,
-                         arrival_model: ArrivalModel | None = None
+                         arrival_model: ArrivalModel | None = None,
+                         crash_plan: list[dict] | None = None
                          ) -> dict:
     """One seeded federated-gateway chaos scenario; returns the report
     dict (``ok`` = every invariant held). Gateway deaths, partitions,
@@ -357,6 +411,22 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
     ``arrival_model`` swaps the stock :func:`draw_arrival` stream for
     a custom :class:`ArrivalModel` (the scenario-genome traffic
     shapes, docs/SCENARIOS.md); ``None`` keeps every golden digest
+    byte-identical.
+
+    ``crash_plan`` (docs/DURABILITY.md) arms the write-ahead intent
+    journal on a real file and KILLS THE WHOLE PROCESS STATE at the
+    seeded positions — every in-memory object dropped, only journal
+    bytes (and the span ring, the durable observability store) kept —
+    including mid-frame (a ``record`` entry tears the commit with a
+    byte cut inside a record). Recovery rebuilds the federation via
+    :func:`~pbs_tpu.gateway.recovery.recover_federation` and the run
+    continues; the harness reconciles its client-side books to the
+    durable truth (requests whose ADMIT frame never committed were
+    never durably acked — their client saw a connection reset, not a
+    loss). The gate: no durably-admitted request lost, recovered mint
+    odometers under the piecewise bound, span chains stitched across
+    every restart by SPAN_RECOVER events, same seed ⇒ same digests.
+    ``crash_plan=None`` arms no journal and keeps every golden
     byte-identical."""
     # Armed on any non-None, non-False value: autopilot={} means "the
     # default-configured loop", not "off" (truthiness would silently
@@ -369,27 +439,53 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
         raise ValueError(
             "knob_plan and autopilot are mutually exclusive: both "
             "own the federation's knob channel")
+    if crash_plan and (knob_plan or ap_armed):
+        # Recovery reconciles queues and lease books; the knob channel
+        # and autopilot loop carry additional process state the
+        # journal deliberately does not cover (docs/DURABILITY.md
+        # "Scope").
+        raise ValueError(
+            "crash_plan is mutually exclusive with knob_plan/"
+            "autopilot: the journal covers gateway state, not the "
+            "knob control plane")
     if plan is None:
         plan = (FaultPlan.autopilot(seed) if ap_armed
                 else FaultPlan.federation(seed))
+    if crash_plan:
+        plan = FaultPlan(seed=plan.seed,
+                         specs=tuple(plan.specs)
+                         + _crash_specs(crash_plan)).validate()
     inj = faults_mod.install(plan, trace_path=trace_path)
     problems: list[str] = []
     knob_events: list[dict] = []
     knob_dir = None
     ap_dir = None
+    jr_dir = None
+    journal = None
     pilot = None
     try:
         clock = VirtualClock()
+
+        def _member_factory(name: str):
+            salt = 97 if name.startswith("gwr") else int(name[2:])
+            return _federation_member(name, salt, clock, tick_ns, seed,
+                                      backends_per_gateway, n_tenants)
+
         members = [
-            _federation_member(f"gw{i}", i, clock, tick_ns, seed,
-                               backends_per_gateway, n_tenants)
+            _member_factory(f"gw{i}")
             for i in range(max(1, int(n_gateways)))
         ]
         spans = SpanRecorder(capacity=1 << 16)
+        if crash_plan:
+            import tempfile
+
+            jr_dir = tempfile.mkdtemp(prefix="pbst-journal-")
+            jr_path = f"{jr_dir}/gateway.jrnl"
+            journal = GatewayJournal.create(jr_path)
         fed = FederatedGateway(members, clock=clock,
                                renew_period_ns=4 * tick_ns,
                                lease_ttl_ns=6 * tick_ns,
-                               spans=spans)
+                               spans=spans, journal=journal)
         tenants = build_workload(workload, seed=seed, n_tenants=n_tenants)
         quotas: dict[str, TenantQuota] = {}
         for t in tenants:
@@ -492,56 +588,218 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
                     f"{fed.completed} + queued {fed.queued()} + "
                     f"inflight {fed.inflight_count()}")
 
-        for tick in range(int(ticks)):
-            if knob_writer is not None:
-                _push_knobs(tick)
-            if tick == drain_at and len(fed.members) > 1:
-                candidates = [n for n in sorted(fed.members)
-                              if n not in fed._draining]
-                if len(candidates) > 1:
-                    victim = candidates[
-                        int(sched_rng.integers(0, len(candidates)))]
-                    fed.drain(victim)
-            if tick == rejoin_at:
-                fed.add(_federation_member(
-                    "gwr0", 97, clock, tick_ns, seed,
-                    backends_per_gateway, n_tenants))
+        #: Crash-harness client-side books: rid -> (tenant, cost) so a
+        #: recovery can roll back the unacked suffix exactly.
+        rid_books: dict[str, tuple[str, int]] = {}
+        unacked_rids: set[str] = set()
+        crash_events: list[dict] = []
+
+        def _cold_boot(err: JournalError):
+            """Recovery when NOT EVEN the topology image is durable:
+            the crash tore the journal's very first frame (position 0
+            of the soak — zero sealed records on disk), so there is no
+            state to replay. Reboot exactly as at start — same member
+            names, same tenant registration order — on the reopened
+            journal (torn tail truncated, generation bumped), and let
+            the caller roll back every client-side book: nothing was
+            ever durably acked. Returns ``(fed, RecoveryInfo)`` like
+            recover_federation."""
+            from pbs_tpu.gateway.recovery import (
+                RecoveryInfo,
+                replay,
+                state_digest,
+            )
+
+            view = read_journal(jr_path)
+            st = replay(view.records, lease_ttl_ns=6 * tick_ns)
+            if st.live_members():
+                raise err  # a different JournalError: surface it
+            jr = GatewayJournal.reopen(jr_path, view=view)
+            boot = FederatedGateway(
+                [_member_factory(f"gw{i}")
+                 for i in range(max(1, int(n_gateways)))],
+                clock=clock, renew_period_ns=4 * tick_ns,
+                lease_ttl_ns=6 * tick_ns, spans=spans, journal=jr)
             for t in tenants:
-                if arrival_model is None:
-                    fire, cost = draw_arrival(t, arrivals[t.name])
-                else:
-                    fire, cost = arrival_model.draw(
-                        t, tick, arrivals[t.name])
-                if not fire:
+                boot.register_tenant(t.name, quotas[t.name])
+            # Fresh rid namespace, same as recover_federation: the
+            # unacked pre-crash rids left records in the durable span
+            # ring, and a rebooted gw0-0 must never collide with them.
+            import itertools
+
+            for name in sorted(boot.members):
+                boot.members[name].rid_generation = jr.generation
+                boot.members[name]._rids = itertools.count()
+            now = clock.now_ns()
+            boot.events.append({"now_ns": now, "event": "recover",
+                                "gateway": f"g{jr.generation}"})
+            jr.recover_mark(now, 0, 0)
+            try:
+                jr.commit()
+            except Exception:
+                jr.abandon()  # same contract as recover_federation
+                raise
+            return boot, RecoveryInfo(
+                generation=jr.generation, rids=set(st.reqs),
+                done=st.done_rids(), recovered=[],
+                requeued_inflight=[], shed_total=st.shed_total(),
+                state_digest=state_digest(st),
+                torn_bytes=view.torn_bytes)
+
+        def _recover_now():
+            """The kill-9 handler: drop every in-memory object (the
+            dead process), keep only journal bytes + the span ring
+            (the durable observability store, its in-process staging
+            batch dropped like any dying process buffer), recover,
+            and reconcile the harness's client-side books to the
+            durable truth. Returns the resolving RecoveryInfo +
+            unacked count (the caller records the crash events)."""
+            nonlocal fed, journal, shed_results, completions, \
+                admitted_rids
+            from pbs_tpu.gateway.journal import JournalCorrupt
+            from pbs_tpu.gateway.recovery import recover_federation
+
+            spans.batch.drop_pending()
+            if journal is not None:
+                journal.abandon()
+            fed = None  # the process is dead; only bytes remain
+            journal = None
+            try:
+                fed, info = recover_federation(
+                    jr_path, member_factory=_member_factory, clock=clock,
+                    spans=spans, renew_period_ns=4 * tick_ns,
+                    lease_ttl_ns=6 * tick_ns)
+            except JournalCorrupt:
+                raise  # bit rot is never recoverable-by-reboot
+            except JournalError as err:
+                fed, info = _cold_boot(err)
+            journal = fed.journal
+            lost = [rid for rid in admitted_rids
+                    if rid not in info.rids]
+            for rid in lost:
+                tname, rcost = rid_books.pop(rid)
+                admitted_cost[tname] = admitted_cost.get(tname, 0.0) \
+                    - rcost
+                unacked_rids.add(rid)
+            admitted_rids = [rid for rid in admitted_rids
+                             if rid in info.rids]
+            # Completions whose frame never committed re-deliver
+            # after recovery (at-least-once across a crash).
+            completions = [c for c in completions if c[0] in info.done]
+            shed_results = info.shed_total
+            return info, len(lost)
+
+        def _kill9(pk: ProcessKill) -> ProcessKill:
+            """Handle a process death, retrying when recovery's own
+            commit is the next crash victim (recovery is idempotent;
+            each deterministic spec fires once). EVERY fired kill gets
+            its own crash event — a kill that lands inside a
+            recovery's commit still fired, and the fired-vs-planned
+            gate must count it — all stamped with the recovery that
+            finally resolved them. Returns the FIRST kill: its kind,
+            not the last retry's, decides resume semantics."""
+            first = pk
+            fired = [pk]
+            while True:
+                try:
+                    info, unacked = _recover_now()
+                    break
+                except ProcessKill as again:
+                    fired.append(again)
+            for each in fired:
+                crash_events.append({
+                    "kind": each.kind, "position": each.position,
+                    "generation": info.generation,
+                    "unacked": unacked,
+                    "torn_bytes": info.torn_bytes,
+                    "requeued_inflight": len(info.requeued_inflight),
+                    "recovered": len(info.recovered),
+                    "state_digest": info.state_digest,
+                })
+            if len(crash_events) > 16:
+                raise RuntimeError(
+                    "crash plan produced >16 recoveries; runaway")
+            return first
+
+        tick = 0
+        #: Last tick whose kill consult already happened: a tick
+        #: re-entered after its own process kill must NOT consult
+        #: again — the extra draw would advance the fault stream and
+        #: shift every later deterministic {"tick": T} position to
+        #: T-1 (one consult per tick index is the plan contract).
+        consulted_kill_tick = -1
+        while tick < int(ticks):
+            try:
+                if crash_plan and tick != consulted_kill_tick:
+                    consulted_kill_tick = tick
+                    f = faults_mod.consult("gateway.process.kill",
+                                           "proc")
+                    if f is not None:
+                        raise ProcessKill("process", tick)
+                if knob_writer is not None:
+                    _push_knobs(tick)
+                if tick == drain_at and len(fed.members) > 1:
+                    candidates = [n for n in sorted(fed.members)
+                                  if n not in fed._draining]
+                    if len(candidates) > 1:
+                        victim = candidates[
+                            int(sched_rng.integers(0, len(candidates)))]
+                        fed.drain(victim)
+                if tick == rejoin_at:
+                    fed.add(_member_factory("gwr0"))
+                for t in tenants:
+                    if arrival_model is None:
+                        fire, cost = draw_arrival(t, arrivals[t.name])
+                    else:
+                        fire, cost = arrival_model.draw(
+                            t, tick, arrivals[t.name])
+                    if not fire:
+                        continue
+                    r = fed.submit(t.name, {"tick": tick}, cost=cost)
+                    if arrival_model is not None:
+                        arrival_model.note_result(t.name, tick,
+                                                  r.admitted)
+                    if r.admitted:
+                        admitted_cost[t.name] = \
+                            admitted_cost.get(t.name, 0.0) + cost
+                        admitted_rids.append(r.rid)
+                        if crash_plan:
+                            rid_books[r.rid] = (t.name, cost)
+                    else:
+                        shed_results += 1
+                        if r.retry_after_ns <= 0:
+                            problems.append(
+                                f"shed of {t.name} at tick {tick} "
+                                f"carries no retry-after ({r.reason})")
+                completions.extend(fed.tick())
+                if pilot is not None:
+                    pilot.tick()
+                if tick % 50 == 0:
+                    _check_books(f"tick {tick}")
+            except ProcessKill as pk:
+                if _kill9(pk).kind == "process":
+                    # Tick-boundary kill: nothing of tick T ran yet;
+                    # re-enter it (the times-capped spec won't
+                    # re-fire). A mid-commit kill instead happened
+                    # inside fed.tick() — tick T's arrivals were
+                    # already submitted, so the run resumes at T+1.
                     continue
-                r = fed.submit(t.name, {"tick": tick}, cost=cost)
-                if arrival_model is not None:
-                    arrival_model.note_result(t.name, tick, r.admitted)
-                if r.admitted:
-                    admitted_cost[t.name] = \
-                        admitted_cost.get(t.name, 0.0) + cost
-                    admitted_rids.append(r.rid)
-                else:
-                    shed_results += 1
-                    if r.retry_after_ns <= 0:
-                        problems.append(
-                            f"shed of {t.name} at tick {tick} carries "
-                            f"no retry-after ({r.reason})")
-            completions.extend(fed.tick())
-            if pilot is not None:
-                pilot.tick()
-            if tick % 50 == 0:
-                _check_books(f"tick {tick}")
             clock.advance(tick_ns)
+            tick += 1
 
         # Drain: no new arrivals; pump until idle (bounded — partitions
-        # heal on the same clock, so convergence only needs ticks).
+        # heal on the same clock, so convergence only needs ticks). A
+        # leftover crash position can still fire inside a drain-phase
+        # commit; recovery continues the drain.
         for _ in range(int(ticks) * 6):
             if not fed.busy():
                 break
-            completions.extend(fed.tick())
-            if pilot is not None:
-                pilot.tick()
+            try:
+                completions.extend(fed.tick())
+                if pilot is not None:
+                    pilot.tick()
+            except ProcessKill as pk:
+                _kill9(pk)
             clock.advance(tick_ns)
 
         _check_books("end")
@@ -662,21 +920,36 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
                             f"autopilot: member {name} not on the "
                             f"reference profile after rollback: "
                             f"{drift}")
+        if crash_plan:
+            # The crash gate's own checks: every deterministic crash
+            # position fired, and recovery actually recovered work.
+            planned = sum(1 for e in crash_plan if "p" not in e)
+            if len(crash_events) < planned:
+                problems.append(
+                    f"crash plan scheduled {planned} deterministic "
+                    f"kill(s) but only {len(crash_events)} fired")
         # THE federation span invariant: one continuous, gap-free
         # chain per admitted rid even across gateway.death /
         # gateway.partition / drain+rejoin — custody transfers stitch,
-        # they do not restart.
-        asm, span_recs = _span_continuity(spans, admitted_rids, problems)
+        # they do not restart — and, under a crash plan, across every
+        # PROCESS death (SPAN_RECOVER re-anchors; unacked rids are the
+        # reconciled suffix, excluded from the universe).
+        asm, span_recs = _span_continuity(
+            spans, admitted_rids, problems,
+            aborted=unacked_rids if crash_plan else None)
         _export_obs(spans, span_recs, obs_dir, tenants, {
             "harness": "federation", "workload": workload, "seed": seed,
             "gateways": n_gateways, "tenants": n_tenants, "ticks": ticks,
         })
     finally:
         faults_mod.uninstall()
-        if knob_dir is not None or ap_dir is not None:
+        if journal is not None:
+            journal.abandon()
+        if knob_dir is not None or ap_dir is not None or \
+                jr_dir is not None:
             import shutil
 
-            for d in (knob_dir, ap_dir):
+            for d in (knob_dir, ap_dir, jr_dir):
                 if d is not None:
                     shutil.rmtree(d, ignore_errors=True)
 
@@ -707,6 +980,17 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
         digest_payload["applied_knobs"] = {
             k: round(float(v), 6)
             for k, v in sorted(fed.applied_knobs.items())}
+    if crash_plan is not None:
+        # Crash-armed runs witness the RECOVERY RESPONSE: every kill
+        # (kind, journal position, generation, unacked suffix size,
+        # torn bytes, replayed-state digest) keys into the digest, so
+        # same-seed-same-digest pins the recovery itself. Keyed in
+        # only when a crash plan is armed — plain runs keep their
+        # pre-journal digests byte-identical.
+        digest_payload["crash"] = {
+            "events": crash_events,
+            "unacked": sorted(unacked_rids),
+        }
     if pilot is not None:
         # Autopilot-armed runs witness the LOOP'S RESPONSE: every
         # decision (candidate, scores, margin, guard verdict) and
@@ -748,6 +1032,15 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
         report["applied_knobs"] = {
             k: round(float(v), 6)
             for k, v in sorted(fed.applied_knobs.items())}
+    if crash_plan is not None:
+        report["crash"] = {
+            "plan": list(crash_plan),
+            "events": crash_events,
+            "unacked": len(unacked_rids),
+            "recoveries": len(crash_events),
+            "final_generation": (crash_events[-1]["generation"]
+                                 if crash_events else 0),
+        }
     if pilot is not None:
         report["autopilot"] = pilot.report()
     return report

@@ -54,7 +54,12 @@ import hashlib
 
 from pbs_tpu import knobs
 from pbs_tpu.faults import injector as _faults
-from pbs_tpu.gateway.admission import SLO_CLASSES, TenantQuota, TokenBucket
+from pbs_tpu.gateway.admission import (
+    SHED_REASON_CODES,
+    SLO_CLASSES,
+    TenantQuota,
+    TokenBucket,
+)
 from pbs_tpu.gateway.gateway import Gateway, SubmitResult
 from pbs_tpu.utils.clock import SEC
 
@@ -68,6 +73,12 @@ DEFAULT_LEASE_TTL_NS = knobs.default("gateway.federation.lease_ttl_ns")
 NO_GATEWAY_RETRY_NS = knobs.default("gateway.federation.no_gateway_retry_ns")
 #: Default gateway.partition fault duration before the heal fires.
 PARTITION_HEAL_NS = knobs.default("gateway.federation.partition_heal_ns")
+#: Sealed lease-book checkpoint cadence of an armed journal
+#: (docs/DURABILITY.md; knob registry journal.checkpoint_period_ns).
+JOURNAL_CKPT_PERIOD_NS = knobs.default("journal.checkpoint_period_ns")
+#: Pseudo-member sid for federation-level journal records (no-gateway
+#: sheds happen before any member is chosen).
+FED_MEMBER = "@fed"
 
 
 def _hash64(key: str) -> int:
@@ -415,7 +426,7 @@ class FederatedGateway:
                  renew_period_ns: int = DEFAULT_RENEW_PERIOD_NS,
                  lease_ttl_ns: int = DEFAULT_LEASE_TTL_NS,
                  conservative_frac: float | None = None,
-                 spans=None):
+                 spans=None, journal=None):
         if not members:
             raise ValueError("federation needs at least one gateway")
         self.clock = clock if clock is not None else members[0].clock
@@ -468,8 +479,42 @@ class FederatedGateway:
         self.shadow = None
         self._last_renew_ns: int | None = None
         self._health_cache: tuple[int, dict] = (-1, {})
+        #: Write-ahead intent journal (gateway/journal.py,
+        #: docs/DURABILITY.md): ONE journal shared by every member —
+        #: membership, tenant contracts, lease grant/deposit/destroy
+        #: odometer records, and sealed lease-book checkpoints are
+        #: journaled here, members stage their request intents into
+        #: it, and the federation group-commits ONE frame per
+        #: ``tick()``. None = zero cost.
+        self.journal = None
+        self._last_ckpt_ns: int | None = None
+        #: Spend odometers of members that no longer exist as objects
+        #: (killed/retired before a crash): recovery folds them in
+        #: here so ``lease_audit``'s "admitted cost is token-backed"
+        #: identity survives the restart. tenant -> (leased,
+        #: conservative). Empty on a never-recovered federation.
+        self._recovered_spent: dict[str, tuple[float, float]] = {}
         for gw in members:
             self._attach(gw)
+        if journal is not None:
+            self.attach_journal(journal)
+
+    # -- journal (docs/DURABILITY.md) ------------------------------------
+
+    def attach_journal(self, journal) -> None:
+        """Arm the shared write-ahead journal: every current and
+        future member stages its request intents into it (each
+        journals its own identity image on attach; commit stays with
+        the federation — one frame per ``tick()``), and membership
+        deaths, custody transfers, lease odometer records, and sealed
+        lease-book checkpoints are journaled from here."""
+        if self.journal is not None:
+            raise ValueError(
+                "federation already has a journal attached; one "
+                "durable record owns the front door")
+        self.journal = journal
+        for name in sorted(self.members):
+            self.members[name].attach_journal(journal, autocommit=False)
 
     # -- membership ------------------------------------------------------
 
@@ -490,6 +535,8 @@ class FederatedGateway:
                 f"({sorted(gw.admission.quotas) or sorted(gw.admission._buckets)}); "
                 "members join bare — register tenants through "
                 "FederatedGateway.register_tenant, the lease path")
+        if self.journal is not None:
+            gw.attach_journal(self.journal, autocommit=False)
         self.members[gw.name] = gw
         gw.admission.bucket_factory = self._bucket_factory(gw.name)
         if self.spans is not None:
@@ -567,6 +614,8 @@ class FederatedGateway:
         if name in self._draining:
             return
         now = self.clock.now_ns()
+        if self.journal is not None:
+            self.journal.member_event(now, name, "drain")
         self.events.append({"now_ns": now, "event": "drain",
                             "gateway": name})
         self.ring.remove(name)
@@ -574,7 +623,13 @@ class FederatedGateway:
         for tenant in sorted(gw.admission._buckets):
             b = gw.admission._buckets[tenant]
             if isinstance(b, LeasedBucket) and b.level > 0:
-                self._deposit(tenant, name, b.level, now)
+                accepted = self._deposit(tenant, name, b.level, now)
+                if self.journal is not None:
+                    bank = self.broker.banks.get(tenant)
+                    self.journal.deposit(
+                        now, tenant, name, accepted,
+                        bank.minted if bank else 0.0,
+                        bank.level if bank else 0.0)
                 b.level = 0.0
                 b.expires_ns = now  # lease released
         self._handoff_queued(gw)
@@ -593,6 +648,8 @@ class FederatedGateway:
         gw = self.members.pop(name)  # no longer an adoption target
         self._member_watchers.pop(name, None)
         now = self.clock.now_ns()
+        if self.journal is not None:
+            self.journal.member_event(now, name, "kill")
         self.events.append({"now_ns": now, "event": "kill",
                             "gateway": name})
         self.ring.remove(name)
@@ -605,6 +662,8 @@ class FederatedGateway:
         for tenant in sorted(gw.admission._buckets):
             b = gw.admission._buckets[tenant]
             if isinstance(b, LeasedBucket) and b.level > 0:
+                if self.journal is not None:
+                    self.journal.destroy(now, tenant, name, b.level)
                 self.destroyed[tenant] = (
                     self.destroyed.get(tenant, 0.0) + b.level)
                 b.level = 0.0
@@ -638,7 +697,10 @@ class FederatedGateway:
                     for r in reqs:
                         self.spans.handoff(now, r.rid, gw.name,
                                            target.name)
-                target.adopt_tenant(cls, tenant, reqs, deficit)
+                # The custody-move intent is journaled by the adopting
+                # member itself, before its queue mutates.
+                target.adopt_tenant(cls, tenant, reqs, deficit,
+                                    from_member=gw.name)
                 self.handoffs += len(reqs)
 
     def _handoff_target(self, tenant: str) -> Gateway:
@@ -660,6 +722,9 @@ class FederatedGateway:
         return min(pool, key=lambda g: (self._member_load(g), g.name))
 
     def _retire(self, name: str) -> None:
+        if self.journal is not None:
+            self.journal.member_event(self.clock.now_ns(), name,
+                                      "retire")
         gw = self.members.pop(name)
         self._member_watchers.pop(name, None)
         self._draining.discard(name)
@@ -763,6 +828,14 @@ class FederatedGateway:
         if target is None:
             # Every front door is dead/partitioned: an explicit shed
             # with a backoff hint, never a hang or a silent drop.
+            if self.journal is not None:
+                q = self.quotas.get(tenant)
+                cls = slo or (q.slo if q is not None else "batch")
+                self.journal.shed(
+                    self.clock.now_ns(), FED_MEMBER, tenant,
+                    SLO_CLASSES.index(cls)
+                    if cls in SLO_CLASSES else 0,
+                    SHED_REASON_CODES["no-gateway"])
             self.fed_sheds["no-gateway"] = \
                 self.fed_sheds.get("no-gateway", 0) + 1
             return SubmitResult(False, None, "no-gateway",
@@ -907,6 +980,15 @@ class FederatedGateway:
         want = max(b.capacity, b.pending_need) - b.level
         lease = self._grant(tenant, name, max(0.0, want), now_ns)
         if lease is not None:
+            if self.journal is not None:
+                # The grant record carries the bank's post-grant
+                # odometers — each one is a sealed mini-checkpoint of
+                # the mint/level state recovery rebuilds from.
+                bank = self.broker.banks.get(tenant)
+                self.journal.grant(
+                    now_ns, tenant, name, lease.tokens,
+                    bank.minted if bank else 0.0,
+                    bank.level if bank else 0.0)
             b.credit(lease.tokens, now_ns, self.lease_ttl_ns)
 
     # -- the pump --------------------------------------------------------
@@ -947,6 +1029,14 @@ class FederatedGateway:
                 self.events.append({"now_ns": now, "event": "heal",
                                     "gateway": name})
         self._renew_all(now)
+        if self.journal is not None and (
+                self._last_ckpt_ns is None
+                or now - self._last_ckpt_ns >= JOURNAL_CKPT_PERIOD_NS):
+            # Sealed lease-book checkpoint: the bank odometers land as
+            # a CKPT group recovery reconciles against
+            # (docs/DURABILITY.md "Checkpoints").
+            self._last_ckpt_ns = now
+            self.journal.checkpoint(now, self.broker.audit())
         done: list[tuple[str, dict]] = []
         for name in sorted(self.members):
             if name in self._partitioned:
@@ -961,6 +1051,13 @@ class FederatedGateway:
                 self._retire(name)
         if self.spans is not None:
             self.spans.flush()
+        if self.journal is not None:
+            # ONE group-commit frame per federation round, AFTER the
+            # span flush: the span ring is always a superset of the
+            # committed journal, so a mid-commit crash leaves only
+            # EXTRA span records (the unacked suffix), never a
+            # committed intent without its span.
+            self.journal.commit()
         return done
 
     # -- observability ---------------------------------------------------
@@ -1014,6 +1111,9 @@ class FederatedGateway:
         everyone = list(self.members.values()) + self._retired
         for tenant, bank in self.broker.audit().items():
             leased_spent = conservative_spent = held = 0.0
+            extra = self._recovered_spent.get(tenant)
+            if extra is not None:
+                leased_spent, conservative_spent = extra
             for gw in everyone:
                 b = gw.admission._buckets.get(tenant)
                 if isinstance(b, LeasedBucket):

@@ -52,6 +52,7 @@ from pbs_tpu.gateway.fairqueue import (
     DeficitRoundRobin,
     Request,
 )
+from pbs_tpu.gateway import journal as _jr
 from pbs_tpu.obs.spans import HistBatch, LatencyHistograms, SpanRecorder
 from pbs_tpu.obs.trace import EmitBatch, Ev, TraceBuffer
 from pbs_tpu.telemetry.counters import NUM_COUNTERS, Counter
@@ -103,6 +104,7 @@ class Gateway:
         name: str = "gw",
         spans: SpanRecorder | None = None,
         hist_slots: int = 256,
+        journal=None,
     ):
         if not backends:
             raise ValueError("gateway needs at least one backend")
@@ -125,6 +127,20 @@ class Gateway:
         #: Initialized before tenant registration: register_tenant
         #: describes each tenant contract to an attached recorder.
         self.shadow = None
+        #: Write-ahead intent journal (gateway/journal.py,
+        #: docs/DURABILITY.md): when attached, every ADMIT/DISPATCH/
+        #: COMPLETE/SHED/REQUEUE intent is journaled BEFORE the
+        #: in-memory state machine moves, and ``tick()`` group-commits
+        #: the round's intents as one frame. None = zero cost. Set
+        #: before tenant registration: register_tenant journals each
+        #: contract.
+        self._journal = None
+        self.journal_autocommit = True
+        #: Recovery epoch of this gateway's rid namespace: 0 = the
+        #: plain pre-crash form (rids byte-identical to un-journaled
+        #: gateways); recovery bumps it so new rids can never collide
+        #: with an UNACKED pre-crash rid (gateway/recovery.py).
+        self.rid_generation = 0
         for tenant, q in (quotas or {}).items():
             self.register_tenant(tenant, q, now_ns=now)
         #: Global concurrency bound across backends; default: the sum
@@ -195,6 +211,8 @@ class Gateway:
         # Feedback accumulators since the last feedback tick.
         self._fb_delay_ns = {cls: 0 for cls in SLO_CLASSES}
         self._fb_events = {cls: 0 for cls in SLO_CLASSES}
+        if journal is not None:
+            self.attach_journal(journal)
         # Bookkeeping.
         self._rids = itertools.count()
         self._tenant_slot: dict[str, int] = {}  # stable ints for trace
@@ -208,6 +226,28 @@ class Gateway:
         #: (latency percentiles come from the histograms, not a deque).
         self._delays = {cls: deque(maxlen=1024) for cls in SLO_CLASSES}
         self.completions: deque = deque(maxlen=4096)  # (rid, info)
+
+    # -- journal (docs/DURABILITY.md) ------------------------------------
+
+    def attach_journal(self, journal, autocommit: bool = True) -> None:
+        """Arm the write-ahead intent journal: subsequent admission,
+        dispatch, completion, shed, and requeue decisions are staged
+        as journal intents BEFORE the in-memory move, and (when
+        ``autocommit``) each ``tick()`` seals them as one group-commit
+        frame. A federation passes ``autocommit=False`` and commits
+        once per federation round for all members.
+
+        The gateway journals its own identity image on attach — a
+        MEMBER add plus a TENANT record per registered contract — so
+        replay always starts from a complete topology whether the
+        journal was armed at construction or mid-run (replay treats
+        re-registration as idempotent)."""
+        self._journal = journal
+        self.journal_autocommit = bool(autocommit)
+        now = self.clock.now_ns()
+        journal.member_event(now, self.name, "add")
+        for tenant, quota in sorted(self.admission.quotas.items()):
+            journal.tenant(now, tenant, quota)
 
     # -- spans (docs/TRACING.md) -----------------------------------------
 
@@ -290,6 +330,12 @@ class Gateway:
 
     def register_tenant(self, tenant: str, quota: TenantQuota,
                         now_ns: int | None = None) -> None:
+        if self._journal is not None:
+            # Contract before books: replay re-creates the tenant's
+            # bank before any of its intents replays.
+            self._journal.tenant(
+                self.clock.now_ns() if now_ns is None else now_ns,
+                tenant, quota)
         self.admission.register(
             tenant, quota,
             now_ns=self.clock.now_ns() if now_ns is None else now_ns)
@@ -335,6 +381,14 @@ class Gateway:
                                     shed.retry_after_ns)
             if f.fault == "delay":
                 penalty_ns = int(f.args.get("delay_ns", 1 * MS))
+        jr = self._journal
+        if jr is not None:
+            # Spend-kind watermarks: which lease odometer the admission
+            # charge is about to move (the ADMIT intent records it, so
+            # recovery can re-derive the exact spend books).
+            b = self.admission._buckets.get(tenant)
+            pre_leased = getattr(b, "leased_spent", None)
+            pre_cons = getattr(b, "conservative_spent", None)
         shed = self.admission.admit(
             tenant, cost, now,
             # The tenant's slots across BOTH classes: max_queued bounds
@@ -347,7 +401,25 @@ class Gateway:
             self._emit_shed(now, tenant, cls, shed)
             return SubmitResult(False, None, shed.reason,
                                 shed.retry_after_ns)
-        rid = f"{self.name}-{next(self._rids)}"
+        rid = _jr.rid_string(self.name, self.rid_generation,
+                             next(self._rids))
+        if jr is not None:
+            spend = _jr.SPEND_NONE
+            b = self.admission._buckets.get(tenant)
+            if b is not None and hasattr(b, "leased_spent"):
+                if pre_leased is not None:
+                    if b.leased_spent > pre_leased:
+                        spend = _jr.SPEND_LEASED
+                    elif b.conservative_spent > pre_cons:
+                        spend = _jr.SPEND_CONSERVATIVE
+                elif b.leased_spent > 0:  # lazily-built leased bucket
+                    spend = _jr.SPEND_LEASED
+                elif b.conservative_spent > 0:
+                    spend = _jr.SPEND_CONSERVATIVE
+            # The ADMIT intent lands before the queue/books move — the
+            # write-ahead ordering dur-unjournaled-mutation enforces.
+            jr.admit(now, self.name, rid, tenant, self._cls_code(cls),
+                     cost, spend)
         req = Request(rid=rid, tenant=tenant, slo=cls, cost=cost,
                       payload=payload, submit_ns=now,
                       penalty_ns=penalty_ns)
@@ -370,6 +442,8 @@ class Gateway:
         its original front door); it enters at the head of the fair
         queue exactly like a backend-loss casualty."""
         now = self.clock.now_ns()
+        if self._journal is not None:
+            self._journal.adopt(now, self.name, req.rid)
         req.backend = None
         req.requeues += 1
         self.adopted += 1
@@ -381,11 +455,18 @@ class Gateway:
                                self.name)
 
     def adopt_tenant(self, cls: str, tenant: str, requests: list[Request],
-                     deficit: float = 0.0) -> None:
+                     deficit: float = 0.0,
+                     from_member: str = "") -> None:
         """Batch custody transfer of a tenant's queued FIFO from a
         draining or dead federated member: order preserved at the front
         of the queue, DRR deficit carried so the tenant resumes its
-        cycle instead of restarting with fresh credit."""
+        cycle instead of restarting with fresh credit. ``from_member``
+        names the source (the journal's custody-move intent needs
+        both ends)."""
+        if self._journal is not None:
+            self._journal.adopt_tenant(
+                self.clock.now_ns(), self.name, from_member, tenant,
+                self._cls_code(cls), int(max(0.0, deficit) * 1e6))
         self.queue.restore_tenant(cls, tenant, requests, deficit)
         self.adopted += len(requests)
 
@@ -409,6 +490,13 @@ class Gateway:
         self._ledger_flush()
         self._feedback(now)
         self.flush_trace()
+        if self._journal is not None and self.journal_autocommit:
+            # Group commit AFTER the observability flushes: the span
+            # ring is always a superset of the committed journal, so a
+            # crash mid-commit can only leave EXTRA span records (for
+            # the unacked suffix), never a committed intent without
+            # its span (docs/DURABILITY.md "Crash windows").
+            self._journal.commit()
         return done
 
     def flush_trace(self) -> None:
@@ -430,6 +518,8 @@ class Gateway:
             if not b.alive():
                 continue
             for req, info in b.poll(now):
+                if self._journal is not None:
+                    self._journal.complete(now, self.name, req.rid)
                 self.inflight.pop(req.rid, None)
                 self.completed += 1
                 cls = req.slo
@@ -479,6 +569,8 @@ class Gateway:
             # the FIFO oldest-first: the longest-waiting casualty must
             # re-dispatch first, not last.
             for req in reversed(casualties):
+                if self._journal is not None:
+                    self._journal.requeue(now, self.name, req.rid)
                 self.inflight.pop(req.rid, None)
                 req.backend = None
                 req.requeues += 1
@@ -561,6 +653,10 @@ class Gateway:
             req.reported_wait_ns = max(req.reported_wait_ns,
                                        req.queue_delay_ns)
             self._fb_events[req.slo] += 1
+            if self._journal is not None:
+                self._journal.dispatch(
+                    now, self.name, req.rid,
+                    int(max(0.0, self.queue.last_deficit) * 1e6))
             self.inflight[req.rid] = req
             self.dispatched += 1
             if self.spans is not None:
@@ -649,6 +745,9 @@ class Gateway:
 
     def _emit_shed(self, now: int, tenant: str, cls: str,
                    shed: Shed) -> None:
+        if self._journal is not None:
+            self._journal.shed(now, self.name, tenant,
+                               self._cls_code(cls), shed.reason_code)
         self._ledger_add(cls, Counter.COMPILES, 1)
         self._emit(now, Ev.GW_SHED, self._slot_of(tenant),
                    self._cls_code(cls), shed.reason_code,

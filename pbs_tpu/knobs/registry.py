@@ -56,7 +56,7 @@ UNITS = ("ns", "us", "ms", "s", "", "per_s", "tokens", "records",
          "steps", "flop_per_s", "bytes_per_s")
 
 SUBSYSTEMS = ("sched", "gateway", "telemetry", "obs", "runtime", "dist",
-              "autopilot", "scenarios")
+              "autopilot", "scenarios", "journal")
 
 
 class KnobError(ValueError):
@@ -464,6 +464,27 @@ _declare("scenarios.score.w_shed", "float", "",
          0.5, 0.0, 100.0,
          doc="stress weight: shed asymmetry (max-min per-tenant shed "
              "fraction spread at the front door)")
+
+# -- journal: the gateway's write-ahead intent journal
+# (gateway/journal.py; docs/DURABILITY.md). Group-commit watermarks,
+# durability cadence, and the lease-book checkpoint period.
+_declare("journal.batch_capacity", "int", "records",
+         256, 1, 1_000_000,
+         doc="EmitBatch size watermark of the journal staging path "
+             "(staged intent records per in-memory flush; disk sees "
+             "one frame per commit regardless)")
+_declare("journal.flush_ns", "int", "ns",
+         1 * _MS, 1 * _US, 60 * _SEC,
+         doc="EmitBatch time watermark over staged intent timestamps")
+_declare("journal.fsync_every", "int", "",
+         0, 0, 1_000_000,
+         doc="fsync cadence in commits (0 = never fsync: page-cache "
+             "durability, survives kill-9 but not power loss; 1 = "
+             "every group commit)")
+_declare("journal.checkpoint_period_ns", "int", "ns",
+         20 * _MS, 1 * _MS, 3_600 * _SEC,
+         doc="sealed lease-book checkpoint cadence (CKPT/CKPT_SEAL "
+             "groups recovery reconciles the broker books against)")
 
 # -- telemetry.source hardware model (telemetry/source.py)
 _declare("telemetry.source.peak_flops", "float", "flop_per_s",

@@ -551,6 +551,20 @@ class SpanRecorder:
                         self.member_id(from_member),
                         self.member_id(to_member))
 
+    def recover(self, now: int, rid: str, member: str,
+                generation: int) -> None:
+        """Crash-recovery stitch (docs/DURABILITY.md): emitted for
+        every request the journal replay re-materialized, re-anchoring
+        its chain in the recovery epoch ``generation`` at the member
+        that now holds custody. Legal anywhere in a chain — including
+        first, when the pre-crash span records died staged in the
+        dead process's batch."""
+        sid = self.span_id(rid)
+        if sid is None:
+            return
+        self.batch.emit(now, Ev.SPAN_RECOVER, sid,
+                        self.member_id(member), int(generation))
+
     def emit_event(self, now: int, ev: int, *args: int) -> None:
         """Non-span audit record sharing this recorder's ring (the
         autopilot decision events, class 0x09xx): rides the same
@@ -619,6 +633,7 @@ SPAN_ARGS: dict[int, tuple[int, int | None]] = {
     int(Ev.SPAN_COMPLETE): (4, 3),  # backend, service, latency, member
     int(Ev.SPAN_REQUEUE): (2, 1),   # backend, member
     int(Ev.SPAN_HANDOFF): (2, None),  # from_member, to_member
+    int(Ev.SPAN_RECOVER): (2, 0),   # member, generation
 }
 
 _SPAN_CLASS = 0x0800
@@ -675,12 +690,25 @@ class SpanAssembler:
     # -- the gap-free chain invariant ------------------------------------
 
     def validate(self, admitted: list[str] | None = None,
-                 require_complete: bool = True) -> list[str]:
+                 require_complete: bool = True,
+                 aborted: "set[str] | None" = None) -> list[str]:
         """Problems list (empty = every chain holds). ``admitted`` pins
         the expected universe: every admitted rid must HAVE a chain
         (a rid with no records at all is the worst gap), and every
         chain must start with SPAN_ADMIT, walk only legal transitions,
-        and (``require_complete``) end in exactly one SPAN_COMPLETE."""
+        and (``require_complete``) end in exactly one SPAN_COMPLETE.
+
+        SPAN_RECOVER (docs/DURABILITY.md) is legal from ANY state —
+        including as the chain's first record, and after a terminal
+        SPAN_COMPLETE whose journal frame never committed — and resets
+        the chain to QUEUED with the completion count cleared: the
+        recovered request re-executes, and "exactly one complete"
+        means one per final recovery epoch.
+
+        ``aborted`` names rids whose admission was never durable (the
+        crash harness's unacked suffix): their partial chains are
+        excluded from the extras complaint instead of read as
+        never-admitted records."""
         problems: list[str] = []
         universe = admitted if admitted is not None else sorted(self.chains)
         for rid in universe:
@@ -689,7 +717,7 @@ class SpanAssembler:
                 problems.append(f"span {rid}: admitted but no records")
                 continue
             ts0, ev0 = chain[0][0], chain[0][1]
-            if ev0 != Ev.SPAN_ADMIT:
+            if ev0 not in (Ev.SPAN_ADMIT, Ev.SPAN_RECOVER):
                 problems.append(
                     f"span {rid}: chain starts with "
                     f"{Ev(ev0).name}, not SPAN_ADMIT")
@@ -697,6 +725,13 @@ class SpanAssembler:
             state = _QUEUED
             completes = 0
             for ts, ev, *a in chain[1:]:
+                if ev == Ev.SPAN_RECOVER:
+                    # Crash-recovery re-anchor: every recovered
+                    # request is requeued, and completes count from
+                    # the epoch that finally delivered.
+                    state = _QUEUED
+                    completes = 0
+                    continue
                 if ev == Ev.SPAN_ADMIT:
                     problems.append(f"span {rid}: duplicate SPAN_ADMIT")
                     break
@@ -722,7 +757,7 @@ class SpanAssembler:
                         "terminal state)" if completes == 0 else
                         f"span {rid}: {completes} SPAN_COMPLETE records")
         if admitted is not None:
-            extras = set(self.chains) - set(admitted)
+            extras = set(self.chains) - set(admitted) - set(aborted or ())
             for rid in sorted(extras):
                 problems.append(
                     f"span {rid}: records exist for a rid never "
@@ -739,6 +774,9 @@ class SpanAssembler:
         handoffs = sum(
             1 for chain in self.chains.values()
             for ts, ev, *a in chain if ev == Ev.SPAN_HANDOFF)
+        recovers = sum(
+            1 for chain in self.chains.values()
+            for ts, ev, *a in chain if ev == Ev.SPAN_RECOVER)
         completes = sum(
             1 for chain in self.chains.values()
             if any(ev == Ev.SPAN_COMPLETE for _, ev, *a in chain))
@@ -746,6 +784,7 @@ class SpanAssembler:
             "chains": len(self.chains),
             "complete": completes,
             "handoff_events": handoffs,
+            "recover_events": recovers,
             "shed_events": self.shed_events,
         }
 

@@ -126,6 +126,13 @@ class Ev(enum.IntEnum):
     #                               latency_ns, member
     SPAN_REQUEUE = 0x0807  # args: span, backend_slot, member
     SPAN_HANDOFF = 0x0808  # args: span, from_member, to_member
+    SPAN_RECOVER = 0x0809  # args: span, member, generation — crash
+    #   recovery re-anchored this request's chain (docs/DURABILITY.md):
+    #   legal from ANY state (including as the chain's first record
+    #   when the pre-crash span records died in a staging batch) and
+    #   resets the chain to QUEUED — recovery requeues everything it
+    #   recovers, and a COMPLETE whose frame never committed may
+    #   legitimately be followed by a re-execution.
     # autopilot decisions (0x09xx) — the self-tuning loop's audit trail
     # (docs/AUTOPILOT.md; pbs_tpu.autopilot). Emitted through the
     # shared SpanRecorder ring so every decision lands in emission
@@ -453,6 +460,15 @@ class EmitBatch:
 
     def pending(self) -> int:
         return self._n
+
+    def drop_pending(self) -> int:
+        """Discard staged records WITHOUT writing them — the kill-9
+        model (gateway/chaos.py): records staged in a dead process's
+        batch never reached the ring and must not leak into the
+        recovered process's stream. Returns the count dropped."""
+        n, self._n = self._n, 0
+        self._t0 = -1
+        return n
 
     def flush(self) -> int:
         """Push staged records to the ring; returns records written

@@ -305,6 +305,100 @@ def _sweep_cell(n: int, native: bool = False) -> BenchFns:
     return run, lambda: None, None
 
 
+def _journal_append(n: int) -> BenchFns:
+    """Write-ahead journal staging + group commit (gateway/journal.py,
+    docs/DURABILITY.md): ns per intent record when a tick's worth (256)
+    stages through the EmitBatch path and lands as ONE CRC'd frame
+    write — the marginal cost every admitted request pays once the
+    journal is armed."""
+    import os
+    import tempfile
+
+    from pbs_tpu.gateway.journal import HEADER_WORDS, GatewayJournal
+
+    d = tempfile.mkdtemp(prefix="pbst-jr-bench-")
+    path = os.path.join(d, "bench.jrnl")
+    j = GatewayJournal.create(path)
+    for name in ("gw", "t0", "r0"):
+        j.intern(name)  # steady state: names interned outside timing
+    j.commit()
+    batch = 256
+    inner = max(1, n // batch)
+
+    def run() -> int:
+        admit = j.admit
+        for _ in range(inner):
+            for i in range(batch):
+                admit(i, "gw", "r0", "t0", 0, 1, 1)
+            j.commit()
+        return inner * batch
+
+    def reset() -> None:
+        os.ftruncate(j._fd, HEADER_WORDS * 8)
+        os.lseek(j._fd, 0, os.SEEK_END)
+
+    def teardown() -> None:
+        import shutil
+
+        os.close(j._fd)
+        shutil.rmtree(d, ignore_errors=True)
+
+    return run, reset, teardown
+
+
+def _gateway_pump(n: int) -> BenchFns:
+    """Full gateway pump round-trip with the journal ARMED on top of
+    the complete observability stack (spans + histograms + ledger +
+    trace staging): wall-ns per completed request through submit →
+    admit → dispatch → complete → group commit. The ISSUE 15 gate:
+    this must stay within 2x of the PR 9 observability-armed pump
+    (89 us/req on the reference container)."""
+    import os
+    import tempfile
+
+    from pbs_tpu.gateway.admission import TenantQuota
+    from pbs_tpu.gateway.backends import SimServeBackend
+    from pbs_tpu.gateway.gateway import Gateway
+    from pbs_tpu.gateway.journal import GatewayJournal
+    from pbs_tpu.utils.clock import MS as _MS, VirtualClock
+
+    d = tempfile.mkdtemp(prefix="pbst-pump-bench-")
+    clock = VirtualClock()
+    j = GatewayJournal.create(os.path.join(d, "gw.jrnl"))
+    gw = Gateway(
+        [SimServeBackend("b0", n_slots=8, service_ns_per_cost=_MS,
+                         seed=0)],
+        clock=clock, trace_capacity=4096,
+        ledger_path=os.path.join(d, "gw.led"), journal=j,
+        max_queued=1 << 16)
+    gw.register_tenant("t0", TenantQuota(
+        rate=1e9, burst=1e6, slo="interactive", max_queued=1 << 16))
+
+    def run() -> int:
+        done = 0
+        submit, tick = gw.submit, gw.tick
+        while done < n:
+            for _ in range(8):
+                submit("t0", None, cost=1)
+            clock.advance(2 * _MS)
+            done += len(tick())
+        return max(1, done)
+
+    def reset() -> None:
+        # Drain the ring so staged observability never hits the
+        # full-ring drop path mid-round.
+        while gw.trace.consume(4096).shape[0]:
+            pass
+
+    def teardown() -> None:
+        import shutil
+
+        os.close(j._fd)
+        shutil.rmtree(d, ignore_errors=True)
+
+    return run, reset, teardown
+
+
 def _rpc_roundtrip(n: int) -> BenchFns:
     from pbs_tpu.dist.rpc import RpcClient, RpcServer
 
@@ -340,6 +434,10 @@ BENCHES: dict[str, tuple[Callable[..., BenchFns], int, int]] = {
     # scheduler hiccup read as a 2x "regression" in the CI smoke.
     "ledger.snapshot_many": (_ledger_snapshot_many, 12_800, 6_400),
     "fairqueue.cycle": (_fairqueue_cycle, 10_000, 2_000),
+    "journal.append": (_journal_append, 65_536, 8_192),
+    # ops = completed requests; ns/op is the full armed-journal pump
+    # round-trip per request (the ISSUE 15 2x-of-89us acceptance gate).
+    "gateway.pump": (_gateway_pump, 2_000, 400),
     "sim.smoke": (_sim_smoke, 100, 25),
     # n is the horizon in virtual ms / the cell count; ns/op for
     # sim.sustained is wall-ns per simulated-ns (lower = faster sim).
@@ -375,6 +473,10 @@ NATIVE_BENCHES = (
 #: benches keep the tight default.
 CHECK_THRESHOLDS: dict[str, float] = {
     "rpc.roundtrip": 4.0,
+    # File I/O (page-cache writes) + whole-stack pump: wall-clock-
+    # bound like the sim benches, same 3x host-variance armor.
+    "journal.append": 3.0,
+    "gateway.pump": 3.0,
     "sim.smoke": 3.0,
     "sim.sustained": 3.0,
     "sweep.cell": 3.0,

@@ -115,7 +115,22 @@ GENES: tuple[Gene, ...] = (
          "gateway.admit injected-shed probability"),
     Gene("misroute_p", "float", 0.0, 0.15,
          "gateway.route misroute probability"),
+    # -- crash shape (feeds crash_plan; docs/DURABILITY.md). These are
+    # EXTENSION genes: serialized only when nonzero, so every genome
+    # and corpus entry minted before they existed keeps its digest —
+    # and its recorded golden replay — byte-identical.
+    Gene("crash_p", "float", 0.0, 0.008,
+         "gateway.process.kill whole-process death probability per "
+         "harness tick (journal-recovered kill-9)"),
+    Gene("crash_positions", "int", 0, 3,
+         "deterministic kill-9 count, bucketized: k kills land at "
+         "evenly spaced tick fractions i/(k+1) of the run"),
 )
+
+#: Genes added after the corpus format shipped: zero is the exact
+#: pre-gene behavior, omitted from the canonical serialization so old
+#: digests cannot move, and defaulted to zero on load.
+EXTENSION_GENES = ("crash_p", "crash_positions")
 
 _GENES_BY_NAME = {g.name: g for g in GENES}
 
@@ -161,8 +176,13 @@ class Genome:
         raise KeyError(name)
 
     def as_dict(self) -> dict:
+        # Extension genes serialize only when nonzero (zero IS the
+        # pre-gene behavior): a genome that never crashes has the same
+        # canonical bytes — and digest, and eval seed, and recorded
+        # golden replay — it had before the genes existed.
         return {"version": GENOME_VERSION,
-                "genes": {k: v for k, v in self.genes}}
+                "genes": {k: v for k, v in self.genes
+                          if v != 0 or k not in EXTENSION_GENES}}
 
     def canonical(self) -> str:
         return json.dumps(self.as_dict(), sort_keys=True,
@@ -208,6 +228,10 @@ class Genome:
         raw = d.get("genes")
         if not isinstance(raw, dict):
             raise ValueError("genome carries no genes dict")
+        # Absent extension genes mean zero (their omitted-when-zero
+        # serialization), never an error: pre-extension corpus
+        # entries stay loadable at their recorded digests.
+        raw = {**{g: 0 for g in EXTENSION_GENES}, **raw}
         unknown = sorted(set(raw) - set(_GENES_BY_NAME))
         missing = sorted(set(_GENES_BY_NAME) - set(raw))
         if unknown or missing:
@@ -359,6 +383,24 @@ class Genome:
             specs.append(FaultSpec("gateway.route", "misroute",
                                    p=g["misroute_p"]))
         return FaultPlan(seed=int(seed), specs=tuple(specs)).validate()
+
+    def crash_plan(self, ticks: int) -> "list[dict] | None":
+        """The crash genes as a ``run_federation_chaos(crash_plan=)``
+        schedule (docs/DURABILITY.md): ``crash_positions`` kills land
+        at evenly spaced tick fractions, ``crash_p`` adds seeded
+        probabilistic kills (times-capped). Both zero — the
+        pre-extension genome — returns None, which arms no journal
+        and keeps every recorded golden byte-identical."""
+        p = float(self["crash_p"])
+        k = int(self["crash_positions"])
+        if p == 0 and k == 0:
+            return None
+        plan: list[dict] = []
+        for j in range(k):
+            plan.append({"tick": ((j + 1) * int(ticks)) // (k + 1)})
+        if p > 0:
+            plan.append({"p": p, "times": 2, "after": 20})
+        return plan
 
     def arrival_model(self, tenants, ticks: int, seed: int,
                       n_gateways: int = 3) -> "GenomeArrivals":

@@ -173,7 +173,11 @@ def evaluate(genome: Genome, cfg: StressConfig,
             workload=name, seed=seed, n_gateways=cfg.n_gateways,
             backends_per_gateway=cfg.backends_per_gateway,
             n_tenants=n_tenants, ticks=cfg.ticks, tick_ns=cfg.tick_ns,
-            plan=genome.fault_plan(seed), arrival_model=fed_model)
+            plan=genome.fault_plan(seed), arrival_model=fed_model,
+            # Crash genes -> journal-recovered kill-9s; None (both
+            # genes zero) arms no journal and keeps the recorded
+            # goldens byte-identical (docs/DURABILITY.md).
+            crash_plan=genome.crash_plan(cfg.ticks))
     finally:
         unregister_workload(name)
 
@@ -247,7 +251,8 @@ def run_gate(genome: Genome, cfg: StressConfig,
             workload=name, seed=seed, n_gateways=cfg.n_gateways,
             backends_per_gateway=cfg.backends_per_gateway,
             n_tenants=n_tenants, ticks=cfg.ticks, tick_ns=cfg.tick_ns,
-            plan=genome.fault_plan(seed), arrival_model=model)
+            plan=genome.fault_plan(seed), arrival_model=model,
+            crash_plan=genome.crash_plan(cfg.ticks))
     finally:
         unregister_workload(name)
     problems = list(rep["problems"])

@@ -1,0 +1,43 @@
+"""Seeded durability-discipline violations (docs/ANALYSIS.md).
+
+Every durable-state mutation here moves with NO preceding journal
+intent, and the frame reader parses raw bytes with no CRC or torn-tail
+validation — the two failure shapes the pass exists to catch.
+"""
+
+import struct
+
+
+class LossyGateway:
+    """Gateway-shaped machinery that forgets the write-ahead rule."""
+
+    def __init__(self, queue, bucket):
+        self.queue = queue
+        self.bucket = bucket
+        self.inflight = {}
+
+    def submit(self, req):
+        # BAD: the queue moves before (without) any journal intent — a
+        # crash here loses the admitted request.
+        self.queue.push(req)
+        return req.rid
+
+    def repair(self, req):
+        # BAD: requeue with no intent.
+        self.queue.requeue_front(req)
+
+    def renew(self, tokens, now_ns):
+        # BAD: lease top-up with no grant record.
+        self.bucket.credit(tokens, now_ns, 1000)
+
+    def dispatch(self, req):
+        # BAD: inflight transition with no intent.
+        self.inflight[req.rid] = req
+
+
+def load_journal_frames(path):
+    # BAD: consumes journal bytes with a raw unpack — no CRC check, no
+    # torn-tail rule; corrupt or torn frames replay silently.
+    with open(path, "rb") as f:
+        data = f.read()
+    return struct.unpack_from("<4Q", data, 0)

@@ -56,6 +56,7 @@ def test_all_rules_fire_on_bad_tree():
         "knob-unit-drift", "knob-native-drift",
         "rollout-push", "rollout-set-local",
         "scenario-corpus-golden", "scenario-raw-genome",
+        "dur-unjournaled-mutation", "dur-unsealed-read",
     }
 
 
@@ -118,7 +119,8 @@ def test_cli_list_passes(capsys):
     for pid in ("lock-discipline", "time-units", "sched-ops",
                 "counter-api", "gateway-discipline", "perf-discipline",
                 "obs-discipline", "knob-discipline",
-                "rollout-discipline", "scenario-discipline"):
+                "rollout-discipline", "scenario-discipline",
+                "durability-discipline"):
         assert pid in out
 
 

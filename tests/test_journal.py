@@ -1,0 +1,297 @@
+"""Write-ahead gateway journal + recovery (docs/DURABILITY.md).
+
+The torn-tail property test is the heart: for EVERY byte prefix of a
+real journal, reading either recovers exactly a frame-aligned prefix
+of the records (the torn suffix discarded, never trusted) or refuses
+outright — it never mis-recovers. CRC corruption on a complete frame
+is a hard error with the offset; recovery is idempotent; and the
+lease-audit odometers survive a kill-9 bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import pytest
+
+from pbs_tpu.cli.pbst import main
+from pbs_tpu.gateway import (
+    Gateway,
+    GatewayJournal,
+    JournalCorrupt,
+    SimServeBackend,
+    TenantQuota,
+    read_journal,
+    recover_gateway,
+)
+from pbs_tpu.gateway.journal import HEADER_WORDS, Jr
+from pbs_tpu.gateway.recovery import (
+    apply_recover_transform,
+    replay,
+    state_digest,
+)
+from pbs_tpu.utils.clock import MS, VirtualClock
+
+
+def _small_run(tmp_path, ticks: int = 24):
+    """A journaled single-gateway run with admits, dispatches,
+    completions, and sheds on the record."""
+    path = str(tmp_path / "gw.jrnl")
+    clock = VirtualClock()
+    journal = GatewayJournal.create(path)
+    gw = Gateway(
+        [SimServeBackend("b0", n_slots=2, service_ns_per_cost=3 * MS,
+                         seed=1)],
+        clock=clock, journal=journal)
+    gw.register_tenant("tenant-with-a-deliberately-long-name-x", TenantQuota(
+        rate=200.0, burst=20.0, slo="interactive", max_queued=4))
+    gw.register_tenant("t1", TenantQuota(rate=100.0, burst=40.0,
+                                         slo="batch"))
+    for i in range(ticks):
+        gw.submit("tenant-with-a-deliberately-long-name-x", None,
+                  cost=1 + i % 2)
+        if i % 3 == 0:
+            gw.submit("t1", None, cost=2 + i % 5)
+        gw.tick()
+        clock.advance(1 * MS)
+    journal.commit()
+    return path, clock, gw
+
+
+def test_roundtrip_records_and_interning(tmp_path):
+    path, _, gw = _small_run(tmp_path)
+    view = read_journal(path)
+    assert view.torn_bytes == 0
+    assert view.generation == 0
+    assert view.frames > 0
+    ops = [r[1] for r in view.records]
+    for op in (Jr.INTERN, Jr.MEMBER, Jr.TENANT, Jr.ADMIT, Jr.DISPATCH,
+               Jr.COMPLETE):
+        assert int(op) in ops, Jr(op).name
+    # The >24-byte tenant name chunked through INTERN records and
+    # reassembles exactly.
+    from pbs_tpu.gateway.journal import iter_interned
+
+    names = [n for n, _ in iter_interned(view.records)]
+    assert "tenant-with-a-deliberately-long-name-x" in names
+
+
+def test_torn_tail_every_byte_prefix_recovers_or_refuses(tmp_path):
+    """THE durability property: truncate the journal at every byte
+    length; parsing must yield an exact frame-aligned record PREFIX
+    (torn tail discarded) or refuse — never a partial frame, never
+    reordered or invented records."""
+    path, _, _ = _small_run(tmp_path, ticks=12)
+    full = read_journal(path).records
+    data = open(path, "rb").read()
+    cut_path = str(tmp_path / "cut.jrnl")
+    prefix_lens = set()
+    for cut in range(len(data) + 1):
+        with open(cut_path, "wb") as f:
+            f.write(data[:cut])
+        if cut < HEADER_WORDS * 8:
+            with pytest.raises(JournalCorrupt):
+                read_journal(cut_path)
+            continue
+        view = read_journal(cut_path)
+        k = len(view.records)
+        assert view.records == full[:k], f"mis-recovery at cut {cut}"
+        assert view.valid_bytes + view.torn_bytes == cut
+        prefix_lens.add(k)
+    # Every frame boundary was reachable, and mid-frame cuts rounded
+    # DOWN to a boundary (more cuts than boundaries).
+    assert len(prefix_lens) > 1
+    assert len(full) in prefix_lens
+
+
+def test_crc_corruption_is_hard_error_with_offset(tmp_path):
+    path, _, _ = _small_run(tmp_path, ticks=8)
+    data = bytearray(open(path, "rb").read())
+    # Flip one byte inside RECORD/CRC bytes of several frames (first
+    # frame's first record, a mid-file record, the final CRC word);
+    # each must refuse with an offset, never silently skip. (A flip
+    # in a frame's LENGTH word instead degrades to torn-tail
+    # semantics at that boundary — conservative truncation, never
+    # invented records — see docs/DURABILITY.md.)
+    view = read_journal(path)
+    mid_frame_rec = HEADER_WORDS * 8 + 8 + 3  # first record, frame 0
+    for pos in (mid_frame_rec, view.valid_bytes - 4,
+                view.valid_bytes - 20):
+        bad = bytearray(data)
+        bad[pos] ^= 0x40
+        bad_path = str(tmp_path / "bad.jrnl")
+        with open(bad_path, "wb") as f:
+            f.write(bytes(bad))
+        with pytest.raises(JournalCorrupt) as ei:
+            read_journal(bad_path)
+        assert ei.value.offset >= 0
+        assert str(ei.value.offset) in str(ei.value)
+
+
+def test_recovery_idempotence_same_state_digest(tmp_path):
+    path, clock, _ = _small_run(tmp_path)
+    a_path = str(tmp_path / "a.jrnl")
+    b_path = str(tmp_path / "b.jrnl")
+    shutil.copy(path, a_path)
+    shutil.copy(path, b_path)
+    _, info_a = recover_gateway(
+        a_path, [SimServeBackend("b0", seed=7)], clock=clock)
+    _, info_b = recover_gateway(
+        b_path, [SimServeBackend("b0", seed=9)], clock=clock)
+    assert info_a.state_digest == info_b.state_digest
+    assert info_a.recovered == info_b.recovered
+    # Pure replay form too: fold + transform twice = identical digest.
+    view = read_journal(path)
+    s1 = replay(view.records, 0)
+    apply_recover_transform(s1)
+    s2 = replay(view.records, 0)
+    apply_recover_transform(s2)
+    assert state_digest(s1) == state_digest(s2)
+
+
+def test_single_gateway_recovery_books_and_order(tmp_path):
+    path, clock, gw = _small_run(tmp_path)
+    pre = (gw.admitted, gw.completed, dict(gw.admission.sheds))
+    queued_before = [r.rid for r in gw.queue.pending()]
+    inflight_before = sorted(gw.inflight)
+    del gw
+    gw2, info = recover_gateway(
+        path, [SimServeBackend("b0", n_slots=2,
+                               service_ns_per_cost=3 * MS, seed=2)],
+        clock=clock)
+    # Books: identity holds, sheds and counters restored, inflight
+    # requeued (no second admission charge — admitted unchanged).
+    assert gw2.admitted == pre[0]
+    assert gw2.completed == pre[1]
+    assert gw2.admission.sheds == pre[2]
+    assert gw2.admitted == gw2.completed + gw2.queue.depth() \
+        + len(gw2.inflight)
+    assert len(gw2.inflight) == 0
+    assert set(info.requeued_inflight) == set(inflight_before)
+    # Queued-at-crash requests are all there, in admission order per
+    # tenant FIFO, with the inflight casualties requeued at the front.
+    queued_after = [r.rid for r in gw2.queue.pending()]
+    assert set(queued_after) == set(queued_before) | set(inflight_before)
+    # Drains to zero with fresh backends; new rids live in the next
+    # generation's namespace.
+    for _ in range(600):
+        if not gw2.busy():
+            break
+        gw2.tick()
+        clock.advance(1 * MS)
+    assert gw2.admitted == gw2.completed
+    r = gw2.submit("t1", None)
+    assert r.admitted and "-r1-" in r.rid
+
+
+def test_reopen_truncates_torn_tail_and_bumps_generation(tmp_path):
+    path, _, _ = _small_run(tmp_path, ticks=6)
+    clean = read_journal(path)
+    with open(path, "ab") as f:
+        f.write(b"\x01\x02\x03")  # a crash's torn droppings
+    j = GatewayJournal.reopen(path)
+    assert j.generation == clean.generation + 1
+    view = read_journal(path)
+    assert view.torn_bytes == 0  # tail truncated at reopen
+    assert view.generation == clean.generation + 1
+    assert len(view.records) == len(clean.records)
+    j.close()
+
+
+def test_federation_lease_audit_survives_kill9_exactly(tmp_path):
+    """The recovered broker books ARE the journaled odometers: the
+    full lease_audit dict — minted, granted, deposited, bank level,
+    spends, held, destroyed — is bit-identical across the kill."""
+    from pbs_tpu.gateway import FederatedGateway, quota_for
+    from pbs_tpu.gateway.recovery import recover_federation
+
+    path = str(tmp_path / "fed.jrnl")
+    clock = VirtualClock()
+    tick_ns = 1 * MS
+
+    def member(name):
+        salt = int(name[2:]) if name[2:].isdigit() else 99
+        backends = [SimServeBackend(f"{name}b{j}", n_slots=2,
+                                    service_ns_per_cost=3 * tick_ns,
+                                    seed=salt * 31 + j)
+                    for j in range(2)]
+        return Gateway(backends, clock=clock, max_queued=256, name=name)
+
+    journal = GatewayJournal.create(path)
+    fed = FederatedGateway([member("gw0"), member("gw1")], clock=clock,
+                           renew_period_ns=4 * tick_ns,
+                           lease_ttl_ns=6 * tick_ns, journal=journal)
+    fed.register_tenant("ti", quota_for("ti", "interactive", 256))
+    fed.register_tenant("tb", quota_for("tb", "batch", 256))
+    for tick in range(80):
+        fed.submit("ti", None, cost=1)
+        if tick % 3 == 0:
+            fed.submit("tb", None, cost=5)
+        if tick == 40:
+            fed.kill("gw1")  # a member death BEFORE the process death
+        fed.tick()
+        clock.advance(tick_ns)
+    audit_before = fed.lease_audit()
+    stats_before = fed.stats()
+    journal.abandon()
+    del fed
+    fed2, info = recover_federation(
+        path, member_factory=member, clock=clock,
+        renew_period_ns=4 * tick_ns, lease_ttl_ns=6 * tick_ns)
+    assert fed2.lease_audit() == audit_before
+    st = fed2.stats()
+    assert st["admitted"] == stats_before["admitted"]
+    assert st["completed"] == stats_before["completed"]
+    assert fed2.admitted == fed2.completed + fed2.queued() \
+        + fed2.inflight_count()
+    # And the run can finish: everything admitted completes.
+    for _ in range(600):
+        if not fed2.busy():
+            break
+        fed2.tick()
+        clock.advance(tick_ns)
+    assert fed2.admitted == fed2.completed
+    fed2.journal.close()
+
+
+# -- CLI (docs/CLI.md) -------------------------------------------------------
+
+
+def test_cli_journal_dump_and_verify(tmp_path, capsys):
+    path, _, _ = _small_run(tmp_path, ticks=6)
+    assert main(["journal", "verify", path]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["warnings"] == [] and doc["records"] > 0
+    assert main(["journal", "dump", path]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert len(doc["entries"]) == doc["records"]
+    ops = {e["op"] for e in doc["entries"]}
+    assert {"ADMIT", "DISPATCH", "COMPLETE", "TENANT"} <= ops
+    # Dumps are stable sorted-key JSON: byte-identical on a re-run.
+    assert main(["journal", "dump", path]) == 0
+    assert json.loads(capsys.readouterr().out) == doc
+
+
+def test_cli_journal_torn_tail_warns_exit_zero(tmp_path, capsys):
+    path, _, _ = _small_run(tmp_path, ticks=6)
+    with open(path, "ab") as f:
+        f.write(os.urandom(5))
+    assert main(["journal", "verify", path]) == 0
+    out = capsys.readouterr()
+    doc = json.loads(out.out)
+    assert len(doc["warnings"]) == 1
+    assert doc["torn_bytes"] == 5
+    assert "WARNING" in out.err
+
+
+def test_cli_journal_corrupt_exit_two(tmp_path, capsys):
+    path, _, _ = _small_run(tmp_path, ticks=6)
+    data = bytearray(open(path, "rb").read())
+    data[60] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(data))
+    assert main(["journal", "verify", path]) == 2
+    assert "CORRUPT" in capsys.readouterr().err
+    assert main(["journal", "dump", str(tmp_path / "nope.jrnl")]) == 2

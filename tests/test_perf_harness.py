@@ -33,7 +33,8 @@ CHEAP = ["trace.emit", "trace.emit_many", "trace.consume",
 def test_bench_registry_names():
     assert {"trace.emit", "trace.emit_many", "trace.consume",
             "span.emit", "hist.record", "hist.record_many",
-            "ledger.snapshot_many", "fairqueue.cycle", "sim.smoke",
+            "ledger.snapshot_many", "fairqueue.cycle",
+            "journal.append", "gateway.pump", "sim.smoke",
             "sim.sustained", "sweep.cell",
             "rpc.roundtrip"} == set(bench_names())
     # The native matrix is the substrate subset: every native bench

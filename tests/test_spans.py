@@ -162,7 +162,8 @@ def test_assembler_happy_chain_validates():
     asm = _asm(rec)
     assert asm.validate(["gw-0"]) == []
     assert asm.summary() == {"chains": 1, "complete": 1,
-                             "handoff_events": 0, "shed_events": 0}
+                             "handoff_events": 0, "recover_events": 0,
+                             "shed_events": 0}
     lat = asm.latencies()["gw-0"]
     assert lat == {"e2e_ns": 20, "queue_ns": 5, "service_ns": 14,
                    "requeues": 0, "handoffs": 0}
