@@ -443,12 +443,17 @@ SERVING_BAR_TOKENS_S = 5000.0
 
 def _serving_fallback_main() -> None:
     """Chip-free serving benchmark (ROADMAP item 5a): the full
-    gateway + ContinuousBatcher stack on CPU — admission, DRR fair
-    queue, dispatch, decode — measured end to end. Tokens/s is the
-    headline; latency quantiles come from the gateway's log2
-    histograms (pbs_tpu.obs.spans; docs/TRACING.md), the same
-    estimator ``pbst slo report`` uses. Prints exactly ONE JSON line,
-    like the flagship worker."""
+    gateway + sharded serving stack on CPU — admission, DRR fair
+    queue, dispatch, rule-partitioned decode — measured end to end.
+    The backend is :class:`pbs_tpu.serve.ShardedServeBackend`
+    (docs/SERVING.md) on a 1x1 dp*tp mesh: the same regex-rule
+    partitioning + GSPMD placement path the multi-chip deployment
+    uses, degenerate at tp=1, so the fallback exercises the real
+    serving tier rather than a bare engine. Tokens/s is the headline;
+    latency quantiles come from the gateway's log2 histograms
+    (pbs_tpu.obs.spans; docs/TRACING.md), the same estimator ``pbst
+    slo report`` uses. Prints exactly ONE JSON line, like the
+    flagship worker."""
 
     def _int_env(name: str, default: int) -> int:
         raw = os.environ.get(name)
@@ -476,28 +481,31 @@ def _serving_fallback_main() -> None:
     import jax.numpy as jnp
     import numpy as np
 
-    from pbs_tpu.gateway import BatcherBackend, Gateway, TenantQuota
+    from pbs_tpu.gateway import Gateway, TenantQuota
     from pbs_tpu.models import TransformerConfig, init_params
-    from pbs_tpu.models.serving import ContinuousBatcher
+    from pbs_tpu.serve import ShardedServeBackend
 
     cfg = TransformerConfig(
         vocab=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
         d_ff=128, max_seq=128, dtype=jnp.float32)
     params = init_params(cfg, jax.random.PRNGKey(0))
-    eng = ContinuousBatcher(cfg, params, n_slots=slots,
-                            prompt_bucket=16, max_len=64)
+    backend = ShardedServeBackend(
+        "engine", cfg, params, tp=1, dp=1, n_slots=slots,
+        prompt_bucket=16, max_len=64)
+    eng = backend.engine
     rng = np.random.default_rng(0)
     prompts = [list(rng.integers(1, 128, size=6)) for _ in range(4)]
     # Warmup DIRECTLY on the engine, before the gateway exists:
     # compile time must not land in the gateway's latency histograms
     # (a multi-second compile in the p99 bucket would swamp the
-    # steady-state signal the fallback exists to produce).
+    # steady-state signal the fallback exists to produce). This is
+    # also the one legitimate bypass submission the stats line shows.
     _mark("warmup decode (compiles)")
     eng.submit(prompts[0], 2)
     while eng.has_work():
         eng.step()
     gw = Gateway(
-        [BatcherBackend("engine", eng)],
+        [backend],
         quotas={"bench": TenantQuota(rate=1e9, burst=1e9,
                                      slo="interactive",
                                      max_queued=max(64, requests))})
@@ -539,6 +547,10 @@ def _serving_fallback_main() -> None:
         "shed": shed,
         "tokens": int(tokens),
         "device": str(jax.devices()[0]),
+        # The serving tier's placement facts (docs/SERVING.md): a 1x1
+        # mesh here; the same row from a multi-chip box shows tp>1.
+        "mesh": backend.stats()["mesh"],
+        "sharded_param_leaves": backend.stats()["param_leaves"],
         "fallback_from": "flagship_train_throughput",
     }))
     sys.stdout.flush()

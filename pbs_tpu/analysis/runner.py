@@ -29,6 +29,7 @@ from pbs_tpu.analysis.perfpass import PerfDisciplinePass
 from pbs_tpu.analysis.rolloutpass import RolloutDisciplinePass
 from pbs_tpu.analysis.scenariopass import ScenarioDisciplinePass
 from pbs_tpu.analysis.schedops import SchedOpsPass
+from pbs_tpu.analysis.servepass import ServeDisciplinePass
 from pbs_tpu.analysis.units import TimeUnitPass
 
 #: The suite, in report order. Adding a pass = append here + docs.
@@ -45,6 +46,7 @@ ALL_PASSES: tuple[type[Pass], ...] = (
     RolloutDisciplinePass,
     ScenarioDisciplinePass,
     DurabilityPass,
+    ServeDisciplinePass,
 )
 
 

@@ -1936,6 +1936,120 @@ def cmd_serve_demo(args) -> int:
     return 0
 
 
+def _serve_tiny_cfg():
+    """The serve CLI's tiny CPU-safe model (docs/SERVING.md): small
+    enough that construction + a full demo stays inside the tier-1
+    smoke budget, big enough that every partition rule family (embed /
+    norms / attention / mlp / head) has a leaf to place."""
+    import jax.numpy as jnp
+
+    from pbs_tpu.models import TransformerConfig
+
+    return TransformerConfig(
+        vocab=64, d_model=16, n_layers=1, n_heads=2, n_kv_heads=1,
+        d_ff=32, max_seq=64, dtype=jnp.float32)
+
+
+def cmd_serve(args) -> int:
+    """The sharded serving tier, hands-on (docs/SERVING.md):
+
+    - ``pbst serve demo`` — a rule-partitioned 1x1-mesh backend (or,
+      with ``--disagg``, the prefill/decode disaggregated pair) behind
+      the REAL gateway front door; requests carry no prompt and the
+      backend synthesizes deterministic ones from the rid (the chaos
+      path). Prints one JSON object: completions + gateway stats +
+      the serve backend's stats.
+    - ``pbst serve stats`` — the partition table's static story with
+      no engine built: every template path with the rule that claims
+      it and the resolved positional spec, plus the audit (dead /
+      shadowed / uncovered — all must be empty; the serve-discipline
+      pass gates the same facts in CI).
+    """
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    try:
+        jax.config.update("jax_platforms",
+                          os.environ["JAX_PLATFORMS"].split(",")[0])
+    except RuntimeError:
+        pass
+
+    cfg = _serve_tiny_cfg()
+    if args.action == "stats":
+        import re
+
+        from pbs_tpu.models import init_params
+        from pbs_tpu.serve.partition import (
+            PARTITION_RULES,
+            audit_rules,
+            iter_leaf_paths,
+            match_partition_rules,
+        )
+
+        params = init_params(cfg, jax.random.PRNGKey(args.seed))
+        specs = match_partition_rules(PARTITION_RULES, params)
+        spec_by_path = dict(iter_leaf_paths(specs))
+        placed = {
+            path: {"rule": next(pat for pat, _ in PARTITION_RULES
+                                if re.search(pat, path)),
+                   "spec": list(spec_by_path[path])}
+            for path, _leaf in iter_leaf_paths(params)
+        }
+        print(json.dumps({
+            "rules": [{"pattern": pat, "spec": list(spec)}
+                      for pat, spec in PARTITION_RULES],
+            "audit": audit_rules(PARTITION_RULES),
+            "leaves": placed,
+        }, indent=1))
+        return 0
+
+    from pbs_tpu.gateway import Gateway, TenantQuota
+
+    if args.disagg:
+        from pbs_tpu.serve import DisaggServeBackend
+
+        backend = DisaggServeBackend(
+            "serve0", cfg, n_slots=args.slots, prompt_bucket=8,
+            max_len=32, seed=args.seed)
+    else:
+        from pbs_tpu.serve import ShardedServeBackend
+
+        backend = ShardedServeBackend(
+            "serve0", cfg, n_slots=args.slots, prompt_bucket=8,
+            max_len=32, seed=args.seed)
+    gw = Gateway(
+        [backend],
+        quotas={"demo": TenantQuota(rate=1000.0, burst=256.0,
+                                    slo="interactive",
+                                    max_queued=max(64, args.requests))})
+    shed = 0
+    for i in range(args.requests):
+        # No prompt on purpose: the backend synthesizes one from the
+        # rid, the same path chaos requests take.
+        r = gw.submit("demo", {"req": i}, cost=1 + i % 4)
+        if not r.admitted:
+            shed += 1
+    done = []
+    while gw.busy():
+        done += gw.tick()
+    print(json.dumps({
+        "completions": len(done),
+        "shed": shed,
+        "sample_completion": done[0][1] if done else {},
+        "gateway": gw.stats(),
+        "serve": backend.stats(),
+    }, indent=1))
+    return 0
+
+
+def serve_entry() -> None:
+    """Console entry ``pbst-serve`` (CI convenience: exactly
+    ``pbst serve ...`` without the subcommand word)."""
+    sys.exit(main(["serve", *sys.argv[1:]]))
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="pbst",
                                 description="PBS-T management CLI")
@@ -1961,6 +2075,21 @@ def main(argv=None) -> int:
     sp.add_argument("--slots", type=int, default=2)
     sp.add_argument("--prefix-cache", type=int, default=4)
     sp.set_defaults(fn=cmd_serve_demo)
+
+    sp = sub.add_parser(
+        "serve",
+        help="sharded serving tier: 'demo' runs a rule-partitioned "
+             "backend (--disagg: prefill/decode pools) behind the "
+             "gateway; 'stats' prints the partition table + audit "
+             "(docs/SERVING.md)")
+    sp.add_argument("action", choices=["demo", "stats"])
+    sp.add_argument("--requests", type=int, default=6)
+    sp.add_argument("--slots", type=int, default=2)
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--disagg", action="store_true",
+                    help="demo the prefill/decode disaggregated "
+                         "backend instead of the single-pool one")
+    sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser(
         "trace",

@@ -39,6 +39,13 @@ class Backend:
     #: / the engine), distinguishing backend-internal queueing from
     #: execution on the request's timeline. None = spans off.
     exec_hook = None
+    #: Intra-backend stage handoff seam (docs/SERVING.md): set by the
+    #: same span wiring to ``(request, now_ns, from_member, to_member)
+    #: -> None``; a staged backend (prefill/decode disaggregation)
+    #: calls it when a request moves between its internal pools, so
+    #: the request keeps ONE stitched span chain (SPAN_HANDOFF + an
+    #: internal re-DISPATCH). None = spans off or single-stage backend.
+    handoff_hook = None
 
     def alive(self) -> bool:
         return True

@@ -161,12 +161,23 @@ def run_gateway_chaos(workload: str = "mixed", seed: int = 0,
                       ledger_path: str | None = None,
                       kill_backend: bool = True,
                       obs_dir: str | None = None,
-                      arrival_model: ArrivalModel | None = None) -> dict:
+                      arrival_model: ArrivalModel | None = None,
+                      serve=None) -> dict:
     """One seeded gateway chaos scenario; returns the report dict
     (``ok`` = every invariant held). Installs the plan process-wide for
     the duration — callers must not have their own plan armed.
     ``arrival_model=None`` keeps the stock :func:`draw_arrival`
-    stream — and therefore every golden digest — byte-identical."""
+    stream — and therefore every golden digest — byte-identical.
+
+    ``serve`` (docs/SERVING.md) swaps the LAST simulated backend for a
+    real serving backend built by ``serve(name, seed) -> Backend`` — a
+    factory returning a duck-typed backend (ShardedServeBackend /
+    DisaggServeBackend constructed with ``clock="virtual"`` so the
+    engine reads this harness's VirtualClock). ``backends[0]`` stays
+    simulated, so the mid-run kill still exercises the drain/requeue
+    path; the serve backend's stats land additively under
+    ``report["serve"]``. ``serve=None`` builds the all-sim pool and
+    keeps every golden byte-identical."""
     plan = plan if plan is not None else FaultPlan.gateway(seed)
     inj = faults_mod.install(plan, trace_path=trace_path)
     problems: list[str] = []
@@ -182,6 +193,10 @@ def run_gateway_chaos(workload: str = "mixed", seed: int = 0,
                             seed=seed + i)
             for i in range(max(1, int(n_backends)))
         ]
+        serve_backend = None
+        if serve is not None:
+            serve_backend = serve(f"b{len(backends) - 1}", seed)
+            backends[-1] = serve_backend
         tenants = build_workload(workload, seed=seed, n_tenants=n_tenants)
         spans = SpanRecorder(capacity=1 << 16)
         gw = Gateway(backends, clock=clock, max_queued=64 * len(tenants),
@@ -290,6 +305,10 @@ def run_gateway_chaos(workload: str = "mixed", seed: int = 0,
         "problems": problems,
         "ok": not problems,
     }
+    if serve_backend is not None:
+        # Additive: serve=None runs never carry the key, so their
+        # report shape (and every golden) is untouched.
+        report["serve"] = serve_backend.stats()
     return report
 
 
@@ -298,19 +317,27 @@ def run_gateway_chaos(workload: str = "mixed", seed: int = 0,
 
 def _federation_member(name: str, salt: int, clock, tick_ns: int,
                        seed: int, n_backends: int,
-                       n_tenants: int) -> Gateway:
+                       n_tenants: int, serve=None) -> Gateway:
     """One federation member with its own backend pool. Backend seeds
     derive from (seed, salt, index) so every member's service jitter is
     an independent, replayable stream. Service runs SLOWER than the
     tick (3 ticks per cost unit) so queues and in-flight work actually
     form at the members — a gateway death must reliably catch
-    casualties for the failover path to be under test at all."""
+    casualties for the failover path to be under test at all.
+
+    ``serve`` (docs/SERVING.md): same factory contract as
+    :func:`run_gateway_chaos` — replaces this member's LAST backend
+    with a real serving backend; the leading Sim backends keep the
+    queue-forming service profile the failover gates rely on."""
     backends = [
         SimServeBackend(f"{name}b{j}", n_slots=2,
                         service_ns_per_cost=3 * tick_ns,
                         seed=seed * 1009 + salt * 31 + j)
         for j in range(max(1, int(n_backends)))
     ]
+    if serve is not None:
+        j = len(backends) - 1
+        backends[j] = serve(f"{name}b{j}", seed * 1009 + salt * 31 + j)
     return Gateway(backends, clock=clock, max_queued=64 * max(1, n_tenants),
                    name=name)
 
@@ -372,8 +399,8 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
                          knob_plan: list[dict] | None = None,
                          autopilot: "bool | dict | None" = None,
                          arrival_model: ArrivalModel | None = None,
-                         crash_plan: list[dict] | None = None
-                         ) -> dict:
+                         crash_plan: list[dict] | None = None,
+                         serve=None) -> dict:
     """One seeded federated-gateway chaos scenario; returns the report
     dict (``ok`` = every invariant held). Gateway deaths, partitions,
     and lease expiries come from the armed plan; a drain of a seeded
@@ -427,7 +454,18 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
     odometers under the piecewise bound, span chains stitched across
     every restart by SPAN_RECOVER events, same seed ⇒ same digests.
     ``crash_plan=None`` arms no journal and keeps every golden
-    byte-identical."""
+    byte-identical.
+
+    ``serve`` (docs/SERVING.md) puts a real serving backend behind
+    member ``gw0`` — the last of its backends is built by
+    ``serve(name, seed) -> Backend`` instead of a SimServeBackend
+    (same factory contract as :func:`run_gateway_chaos`; construct it
+    with ``clock="virtual"``). Its stats land in ``report["serve"]``
+    and key into the report digest, so same-seed-same-digest pins the
+    serving tier's response too. Mutually exclusive with
+    ``crash_plan`` (recovery rebuilds members from journal bytes; a
+    jitted engine cannot be resurrected from them). ``serve=None``
+    keeps every golden byte-identical."""
     # Armed on any non-None, non-False value: autopilot={} means "the
     # default-configured loop", not "off" (truthiness would silently
     # disarm it).
@@ -448,6 +486,11 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
             "crash_plan is mutually exclusive with knob_plan/"
             "autopilot: the journal covers gateway state, not the "
             "knob control plane")
+    if crash_plan and serve is not None:
+        raise ValueError(
+            "crash_plan is mutually exclusive with serve: recovery "
+            "rebuilds members from journal bytes, which cannot "
+            "resurrect a jitted serving engine's slot state")
     if plan is None:
         plan = (FaultPlan.autopilot(seed) if ap_armed
                 else FaultPlan.federation(seed))
@@ -466,10 +509,17 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
     try:
         clock = VirtualClock()
 
+        serve_backends: list = []
+
         def _member_factory(name: str):
             salt = 97 if name.startswith("gwr") else int(name[2:])
-            return _federation_member(name, salt, clock, tick_ns, seed,
-                                      backends_per_gateway, n_tenants)
+            sv = serve if (serve is not None and name == "gw0") else None
+            m = _federation_member(name, salt, clock, tick_ns, seed,
+                                   backends_per_gateway, n_tenants,
+                                   serve=sv)
+            if sv is not None:
+                serve_backends.append(m.backends[-1])
+            return m
 
         members = [
             _member_factory(f"gw{i}")
@@ -991,6 +1041,13 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
             "events": crash_events,
             "unacked": sorted(unacked_rids),
         }
+    if serve is not None:
+        # Serve-armed runs witness the SERVING TIER'S RESPONSE: the
+        # engine counters (tokens, completions, prefix traffic) key
+        # into the digest, so same-seed-same-digest pins the sharded
+        # engine's behaviour behind gw0. Keyed in only when armed —
+        # plain runs keep their digests byte-identical.
+        digest_payload["serve"] = [sb.stats() for sb in serve_backends]
     if pilot is not None:
         # Autopilot-armed runs witness the LOOP'S RESPONSE: every
         # decision (candidate, scores, margin, guard verdict) and
@@ -1043,4 +1100,6 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
         }
     if pilot is not None:
         report["autopilot"] = pilot.report()
+    if serve is not None:
+        report["serve"] = [sb.stats() for sb in serve_backends]
     return report

@@ -259,11 +259,24 @@ class Gateway:
         self.spans = recorder
         for b in self.backends:
             b.exec_hook = self._span_exec
+            b.handoff_hook = self._span_handoff
 
     def _span_exec(self, req: Request, now_ns: int) -> None:
         if self.spans is not None:
             self.spans.exec(now_ns, req.rid,
                             self._backend_slot(req.backend), self.name)
+
+    def _span_handoff(self, req: Request, now_ns: int,
+                      from_member: str, to_member: str) -> None:
+        """Intra-backend pool handoff (docs/SERVING.md): the HANDOFF
+        re-queues the span state machine, so an internal re-DISPATCH
+        follows immediately — the same stitch a federation's
+        cross-member handoff emits, with pool names as members."""
+        if self.spans is not None:
+            self.spans.handoff(now_ns, req.rid, from_member, to_member)
+            self.spans.dispatch(now_ns, req.rid,
+                                self._backend_slot(req.backend),
+                                0, 0, self.name)
 
     # -- shadow capture (pbs_tpu/autopilot, docs/AUTOPILOT.md) -----------
 

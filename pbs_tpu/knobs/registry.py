@@ -56,7 +56,7 @@ UNITS = ("ns", "us", "ms", "s", "", "per_s", "tokens", "records",
          "steps", "flop_per_s", "bytes_per_s")
 
 SUBSYSTEMS = ("sched", "gateway", "telemetry", "obs", "runtime", "dist",
-              "autopilot", "scenarios", "journal")
+              "autopilot", "scenarios", "journal", "serve")
 
 
 class KnobError(ValueError):
@@ -485,6 +485,30 @@ _declare("journal.checkpoint_period_ns", "int", "ns",
          20 * _MS, 1 * _MS, 3_600 * _SEC,
          doc="sealed lease-book checkpoint cadence (CKPT/CKPT_SEAL "
              "groups recovery reconciles the broker books against)")
+
+# -- serve: the sharded serving backend + prefill/decode
+# disaggregation (pbs_tpu/serve; docs/SERVING.md). Declared here so
+# the autopilot can canary serving knobs exactly like scheduler ones.
+_declare("serve.backend.decode_slots", "int", "",
+         4, 1, 64,
+         doc="decode slots of a ShardedServeBackend's engine "
+             "(concurrent requests holding KV-cache lanes; one decode "
+             "token per lane per gateway tick)")
+_declare("serve.disagg.pool_split_ratio", "float", "",
+         0.25, 0.05, 0.75,
+         doc="fraction of a disaggregated backend's slot budget owned "
+             "by the prefill pool (the rest decodes); the prefill/"
+             "decode topology knob of docs/SERVING.md")
+_declare("serve.disagg.prefill_chunk_tokens", "int", "tokens",
+         64, 8, 4096,
+         doc="prompt tokens the prefill pool may ingest per gateway "
+             "tick (admission-side backpressure: long-context prompts "
+             "cannot starve decode of a pump quantum)")
+_declare("serve.disagg.kv_handoff_batch", "int", "",
+         2, 1, 64,
+         doc="prefilled requests handed from the prefill pool to the "
+             "decode pool per tick (each handoff moves one prompt "
+             "window of KV and emits one SPAN_HANDOFF)")
 
 # -- telemetry.source hardware model (telemetry/source.py)
 _declare("telemetry.source.peak_flops", "float", "flop_per_s",

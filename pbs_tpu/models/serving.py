@@ -207,8 +207,9 @@ def _shard_slot_cache(cache: dict, mesh) -> dict:
     """KV slabs sharded over the kv heads on tp; cursors replicated."""
     import jax.sharding as jsh
 
-    kv = jsh.NamedSharding(
-        mesh, jsh.PartitionSpec(None, None, None, "tp", None))
+    from pbs_tpu.parallel.sharding import slot_cache_kv_sharding
+
+    kv = slot_cache_kv_sharding(mesh)
     rep = jsh.NamedSharding(mesh, jsh.PartitionSpec(None))
     return {
         "k": jax.device_put(cache["k"], kv),
@@ -344,10 +345,9 @@ class ContinuousBatcher:
             return first, last_logits, cache, extra
 
         if mesh is not None:
-            import jax.sharding as _jsh
+            from pbs_tpu.parallel.sharding import slot_cache_kv_sharding
 
-            _kv_sharding = _jsh.NamedSharding(
-                mesh, _jsh.PartitionSpec(None, None, None, "tp", None))
+            _kv_sharding = slot_cache_kv_sharding(mesh)
         else:
             _kv_sharding = None
 

@@ -109,6 +109,15 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("dp", seq))
 
 
+def slot_cache_kv_sharding(mesh: Mesh) -> NamedSharding:
+    """KV slot-cache slabs ``(layer, slot, pos, n_kv, head_dim)``:
+    shard the kv-head axis over tp, everything else replicated — the
+    serving twin of the Megatron attention layout above. The single
+    home for this spec: mesh-axis names stay inside ``parallel/`` (the
+    ``serve-raw-mesh-axis`` rule, docs/ANALYSIS.md)."""
+    return NamedSharding(mesh, P(None, None, None, "tp", None))
+
+
 def activation_constrainer(mesh: Mesh | None):
     """Returns the ``constrain`` fn threaded through the model: pins the
     residual stream (B, S, d).

@@ -1,0 +1,23 @@
+"""Seeded serve-discipline raw-mesh-axis violations (pbst check
+fixture — never imported)."""
+
+from jax.sharding import NamedSharding, PartitionSpec
+from jax.sharding import PartitionSpec as P
+
+from pbs_tpu.parallel.mesh import make_mesh
+
+
+def cache_sharding(mesh):
+    # serve-raw-mesh-axis: "tp" hard-codes this module to one mesh
+    # shape; route it through a parallel/sharding.py helper.
+    return NamedSharding(mesh, PartitionSpec(None, None, "tp", None))
+
+
+def batch_spec():
+    # serve-raw-mesh-axis via the P alias and a tuple container.
+    return P(("dp", "tp"), None)
+
+
+def build_mesh(devices):
+    # serve-raw-mesh-axis: axis names in a make_mesh dict literal.
+    return make_mesh({"dp": 2, "tp": 4}, devices)

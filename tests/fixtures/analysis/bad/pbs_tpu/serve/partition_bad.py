@@ -1,0 +1,26 @@
+"""Seeded serve-discipline violations in a partition rule table
+(pbst check fixture — never imported)."""
+
+# The coverage universe a real serving model's leaf paths instantiate.
+TEMPLATE_PATHS = (
+    "embed",
+    "layers/attn_norm",
+    "layers/wq",
+    "layers/wo",
+    "final_norm",
+    "head",  # serve-unmatched-rule: no rule below covers "head"
+)
+
+PARTITION_RULES = (
+    (r"^embed$", (-1, None)),
+    # serve-unmatched-rule (dead): typo'd family — matches no path.
+    (r"/wz$", (None, None, -1)),
+    (r"(^|/)(attn_norm|final_norm)$", ()),
+    (r"/w[qkv]$", (None, None, -1)),
+    # serve-unmatched-rule (shadowed): the broad attention rule above
+    # already claimed every /wq path this one could match.
+    (r"/wq$", (None, None, -1)),
+    (r"/wo$", (None, -1, None)),
+    # serve-unmatched-rule (does not compile): broken escape.
+    (r"/w[13$", (None, None, -1)),
+)

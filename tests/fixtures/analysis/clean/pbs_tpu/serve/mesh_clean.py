@@ -1,0 +1,15 @@
+"""Clean twin of mesh_bad.py: axis names come from the parallel
+layer's helpers; no literals at the sharding call sites."""
+
+from jax.sharding import NamedSharding
+
+from pbs_tpu.parallel.sharding import slot_cache_kv_sharding
+
+
+def cache_sharding(mesh):
+    return slot_cache_kv_sharding(mesh)
+
+
+def replicated(mesh, spec):
+    # Specs built elsewhere (the rule table) pass through untouched.
+    return NamedSharding(mesh, spec)

@@ -1,0 +1,19 @@
+"""Clean twin of partition_bad.py: every rule claims at least one
+fresh template path, every path is covered, every regex compiles."""
+
+TEMPLATE_PATHS = (
+    "embed",
+    "layers/attn_norm",
+    "layers/wq",
+    "layers/wo",
+    "final_norm",
+    "head",
+)
+
+PARTITION_RULES = (
+    (r"^embed$", (-1, None)),
+    (r"(^|/)(attn_norm|final_norm)$", ()),
+    (r"/w[qkv]$", (None, None, -1)),
+    (r"/wo$", (None, -1, None)),
+    (r"^head$", (None, -1)),
+)

@@ -57,6 +57,7 @@ def test_all_rules_fire_on_bad_tree():
         "rollout-push", "rollout-set-local",
         "scenario-corpus-golden", "scenario-raw-genome",
         "dur-unjournaled-mutation", "dur-unsealed-read",
+        "serve-unmatched-rule", "serve-raw-mesh-axis",
     }
 
 
@@ -120,7 +121,7 @@ def test_cli_list_passes(capsys):
                 "counter-api", "gateway-discipline", "perf-discipline",
                 "obs-discipline", "knob-discipline",
                 "rollout-discipline", "scenario-discipline",
-                "durability-discipline"):
+                "durability-discipline", "serve-discipline"):
         assert pid in out
 
 

@@ -1,0 +1,329 @@
+"""pbs_tpu.serve: rule-table partitioning, the sharded gateway
+backend, prefill/decode disaggregation, and the disarmed-golden
+contract (docs/SERVING.md)."""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pbs_tpu.gateway import Gateway, TenantQuota, run_gateway_chaos
+from pbs_tpu.models import TransformerConfig, init_params
+from pbs_tpu.obs.spans import SpanAssembler, SpanRecorder
+from pbs_tpu.serve import (
+    DisaggServeBackend,
+    ShardedServeBackend,
+    synth_payload,
+)
+from pbs_tpu.serve.partition import (
+    PARTITION_RULES,
+    TEMPLATE_PATHS,
+    audit_rules,
+    iter_leaf_paths,
+    make_serve_mesh,
+    make_shard_and_gather_fns,
+    match_partition_rules,
+    resolve_spec,
+)
+from pbs_tpu.utils.clock import MS, VirtualClock
+
+TINY = dict(vocab=64, d_model=16, n_layers=1, n_heads=2, n_kv_heads=1,
+            d_ff=32, max_seq=64, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return TransformerConfig(**TINY)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _tiny_kw(seed):
+    return dict(tp=1, dp=1, n_slots=2, prompt_bucket=8, max_len=32,
+                seed=seed, clock="virtual")
+
+
+def sharded_factory_for(cfg):
+    def factory(name, seed):
+        return ShardedServeBackend(name, cfg, **_tiny_kw(seed))
+    return factory
+
+
+def disagg_factory_for(cfg):
+    def factory(name, seed):
+        return DisaggServeBackend(name, cfg, tp=1, dp=1, n_slots=4,
+                                  prompt_bucket=8, max_len=32,
+                                  seed=seed, clock="virtual")
+    return factory
+
+
+# -- the rule table ----------------------------------------------------------
+
+
+def test_every_leaf_matches_exactly_one_rule(params):
+    """The exactly-one contract the table's order-free readability
+    rests on: for the flagship tree no leaf needs first-match-wins to
+    disambiguate — every path matches ONE rule."""
+    for path, _leaf in iter_leaf_paths(params):
+        hits = [pat for pat, _ in PARTITION_RULES
+                if re.search(pat, path)]
+        assert len(hits) == 1, f"{path}: matched {hits}"
+
+
+def test_template_paths_pin_the_param_tree(params):
+    """TEMPLATE_PATHS is the audit's coverage universe; it must BE the
+    init_params leaf set or the audit goes blind to drift."""
+    actual = tuple(p for p, _ in iter_leaf_paths(params))
+    assert sorted(actual) == sorted(TEMPLATE_PATHS)
+
+
+def test_audit_is_clean():
+    audit = audit_rules(PARTITION_RULES)
+    assert audit == {"dead": [], "shadowed": [], "uncovered": []}
+
+
+def test_every_rule_claims_a_leaf(params):
+    paths = [p for p, _ in iter_leaf_paths(params)]
+    for pat, _spec in PARTITION_RULES:
+        assert any(re.search(pat, p) for p in paths), \
+            f"rule {pat!r} claims no leaf of the flagship tree"
+
+
+def test_unmatched_leaf_is_a_hard_error(params):
+    bad = dict(params, mystery=jnp.ones((4, 4)))
+    with pytest.raises(ValueError, match="mystery"):
+        match_partition_rules(PARTITION_RULES, bad)
+
+
+def test_scalar_leaves_are_unpartitioned():
+    specs = match_partition_rules(
+        PARTITION_RULES, {"embed": jnp.ones((8, 4)),
+                          "step": jnp.float32(0.0)})
+    assert specs["step"] == ()
+
+
+def test_resolve_spec_positional_semantics():
+    mesh = make_serve_mesh(tp=1, dp=1)
+    # Python indexing: -1 is the LAST axis name; non-negative indexes
+    # forward (SNIPPETS.md positional-spec semantics).
+    assert resolve_spec(mesh, (-1, None)) == jax.sharding.PartitionSpec(
+        mesh.axis_names[-1], None)
+    assert resolve_spec(mesh, (0,)) == jax.sharding.PartitionSpec(
+        mesh.axis_names[0])
+    with pytest.raises(ValueError, match="out of range"):
+        resolve_spec(mesh, (7,))
+
+
+# -- shard / gather ----------------------------------------------------------
+
+
+def test_shard_gather_roundtrip_byte_identical(params):
+    mesh = make_serve_mesh(tp=1, dp=1)
+    shard, gather = make_shard_and_gather_fns(params, mesh)
+    back = gather(shard(params))
+    flat_a = jax.tree_util.tree_leaves(params)
+    flat_b = jax.tree_util.tree_leaves(back)
+    assert len(flat_a) == len(flat_b)
+    for a, b in zip(flat_a, flat_b):
+        na, nb = np.asarray(a), np.asarray(b)
+        assert na.dtype == nb.dtype and na.shape == nb.shape
+        assert na.tobytes() == nb.tobytes()
+
+
+# -- the sharded backend under gateway chaos ---------------------------------
+
+CHAOS_KW = dict(workload="mixed", seed=3, n_backends=3, n_tenants=3,
+                ticks=60)
+
+
+def test_sharded_backend_serves_gateway_chaos(cfg):
+    r = run_gateway_chaos(serve=sharded_factory_for(cfg), **CHAOS_KW)
+    assert r["problems"] == []
+    assert r["ok"] is True
+    # No admitted request lost, span chains gap-free (both inside
+    # problems==[]), and the serve tier actually served.
+    st = r["stats"]
+    assert st["admitted"] == st["completed"] > 0
+    assert r["serve"]["completed"] > 0
+    assert r["serve"]["synth_dispatches"] == r["serve"]["completed"]
+    assert r["serve"]["bypass_submits"] == 0
+    assert r["killed_backend"] == "b0"  # the sim at [0] still dies
+
+
+def test_sharded_backend_chaos_same_seed_same_digest(cfg):
+    a = run_gateway_chaos(serve=sharded_factory_for(cfg), **CHAOS_KW)
+    b = run_gateway_chaos(serve=sharded_factory_for(cfg), **CHAOS_KW)
+    assert a["trace_digest"] == b["trace_digest"]
+    assert a["serve"] == b["serve"]
+    assert a["stats"]["shed"] == b["stats"]["shed"]
+
+
+def test_disagg_backend_serves_gateway_chaos(cfg):
+    r = run_gateway_chaos(serve=disagg_factory_for(cfg), **CHAOS_KW)
+    assert r["problems"] == []
+    assert r["ok"] is True
+    assert r["serve"]["completed"] > 0
+    assert r["serve"]["handoffs"] == r["serve"]["completed"]
+    # THE disagg contract: the decode pool never ran a prefill — every
+    # admission hit the handed-off KV in the prefix cache.
+    assert r["serve"]["decode_pool_prefills"] == 0
+
+
+# -- handoff span stitching --------------------------------------------------
+
+
+def test_disagg_handoff_span_chain(cfg):
+    """One stitched chain per request across the prefill->decode
+    handoff: ... EXEC(prefill) HANDOFF DISPATCH EXEC(decode) ...
+    validates gap-free under the assembler's state machine."""
+    clock = VirtualClock()
+    spans = SpanRecorder(capacity=4096)
+    backend = DisaggServeBackend("d0", cfg, tp=1, dp=1, n_slots=2,
+                                 prompt_bucket=8, max_len=32, seed=0,
+                                 clock="virtual")
+    gw = Gateway([backend], clock=clock, spans=spans,
+                 quotas={"t": TenantQuota(rate=1000.0, burst=64.0,
+                                          slo="interactive",
+                                          max_queued=64)})
+    rids = []
+    for i in range(4):
+        res = gw.submit("t", {"i": i}, cost=2)
+        assert res.admitted
+        rids.append(res.rid)
+    for _ in range(400):
+        if not gw.busy():
+            break
+        gw.tick()
+        clock.advance(MS)
+    assert not gw.busy()
+    assert backend.stats()["handoffs"] == 4
+    assert backend.stats()["decode_pool_prefills"] == 0
+    recs = spans.drain()
+    asm = SpanAssembler(recs, spans.rid_table(), spans.member_table(),
+                        spans.tenant_table())
+    assert asm.validate(rids) == []
+
+
+# -- disarmed goldens --------------------------------------------------------
+
+#: The PR 15 constants (also pinned in test_gateway_chaos.py /
+#: test_federation_chaos.py): serve=None must keep them byte-identical.
+GOLDEN_GATEWAY_DIGEST = (
+    "4ef79af3bcb1dcf7b03cad1cd27a91b61f6560f6ea6db0085e504bb08eff5737")
+GOLDEN_FED_TRACE_DIGEST = (
+    "71a188673b85cf80a67a721b247443d22e3776a09ad491fc6a5356553218d6de")
+GOLDEN_FED_REPORT_DIGEST = (
+    "1ba265a705067e8d8761aaa8d57c23b30e38c25839b29c9f1debf380b5667242")
+
+
+def test_disarmed_gateway_golden_byte_identical():
+    r = run_gateway_chaos(workload="mixed", seed=0, n_backends=3,
+                          n_tenants=4, ticks=160, serve=None)
+    assert r["trace_digest"] == GOLDEN_GATEWAY_DIGEST
+    assert "serve" not in r  # report shape untouched when disarmed
+
+
+def test_disarmed_federation_golden_byte_identical():
+    from pbs_tpu.gateway import run_federation_chaos
+
+    r = run_federation_chaos(workload="mixed", seed=0, n_gateways=3,
+                             n_tenants=4, ticks=240, serve=None)
+    assert r["trace_digest"] == GOLDEN_FED_TRACE_DIGEST
+    assert r["report_digest"] == GOLDEN_FED_REPORT_DIGEST
+    assert "serve" not in r
+
+
+def test_serve_crash_plan_mutually_exclusive(cfg):
+    from pbs_tpu.gateway import run_federation_chaos
+
+    with pytest.raises(ValueError, match="serve"):
+        run_federation_chaos(serve=sharded_factory_for(cfg),
+                             crash_plan=[{"tick": 5}])
+
+
+# -- synthesis, knobs, CLI ---------------------------------------------------
+
+
+def test_synth_payload_deterministic_and_bounded():
+    class R:
+        rid = "gw0-17"
+        cost = 9
+
+    a = synth_payload(R(), bucket=8, max_len=32, vocab=64)
+    b = synth_payload(R(), bucket=8, max_len=32, vocab=64)
+    assert a == b
+    prompt, max_new = a
+    assert 1 <= len(prompt) <= 8
+    assert all(1 <= t < 64 for t in prompt)
+    assert 1 <= max_new <= 32 - 8 - 1
+    assert len(prompt) + max_new <= 32
+
+
+def test_serve_knobs_declared():
+    from pbs_tpu.knobs import registry as knobs
+
+    assert knobs.default("serve.backend.decode_slots") == 4
+    assert 0.05 <= knobs.default("serve.disagg.pool_split_ratio") <= 0.75
+    assert knobs.default("serve.disagg.prefill_chunk_tokens") >= 8
+    assert knobs.default("serve.disagg.kv_handoff_batch") >= 1
+
+
+def test_cli_serve_stats_and_demo(capsys):
+    import json
+
+    from pbs_tpu.cli.pbst import main
+
+    assert main(["serve", "stats"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["audit"] == {"dead": [], "shadowed": [], "uncovered": []}
+    assert len(out["rules"]) == len(PARTITION_RULES)
+
+    assert main(["serve", "demo", "--requests", "4"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["completions"] == 4
+    assert out["serve"]["bypass_submits"] == 0
+
+    assert main(["serve", "demo", "--requests", "4", "--disagg"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["completions"] == 4
+    assert out["serve"]["decode_pool_prefills"] == 0
+
+
+# -- full-size soak (slow) ---------------------------------------------------
+
+
+@pytest.mark.slow
+def test_disagg_full_size_soak():
+    """The bench-shaped model through federation chaos with the
+    disaggregated backend behind gw0: a longer run with pool pressure,
+    every invariant (books, mint bound, span continuity) gated by the
+    harness, zero decode-pool prefills throughout."""
+    from pbs_tpu.gateway import run_federation_chaos
+
+    big = TransformerConfig(
+        vocab=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq=128, dtype=jnp.float32)
+
+    def factory(name, seed):
+        return DisaggServeBackend(name, big, tp=1, dp=1, n_slots=8,
+                                  prompt_bucket=16, max_len=64,
+                                  seed=seed, clock="virtual")
+
+    r = run_federation_chaos(workload="mixed", seed=0, n_gateways=3,
+                             n_tenants=4, ticks=240, serve=factory)
+    assert r["problems"] == []
+    assert r["ok"] is True
+    st = r["stats"]
+    assert st["admitted"] == st["completed"] > 0
+    sv = r["serve"][0]
+    assert sv["completed"] > 0
+    assert sv["decode_pool_prefills"] == 0
+    # Determinism at full size too.
+    again = run_federation_chaos(workload="mixed", seed=0, n_gateways=3,
+                                 n_tenants=4, ticks=240, serve=factory)
+    assert again["report_digest"] == r["report_digest"]
