@@ -86,8 +86,9 @@ struct ArgBuf {
 // the full footprint.
 inline int check_ring(ArgBuf* b) {
   if (!b->held) return 1;
-  if (!b->check(4, "ring header")) return 0;
-  return b->check(4 + (int64_t)b->ptr[2] * 8, "ring");
+  if (!b->check(kTraceHeaderWords, "ring header")) return 0;
+  return b->check(
+      kTraceHeaderWords + (int64_t)b->ptr[2] * kTraceRecWords, "ring");
 }
 
 PyObject* fc_trace_emit(PyObject*, PyObject* const* args,
@@ -120,7 +121,7 @@ PyObject* fc_trace_emit_many(PyObject*, PyObject* const* args,
   int64_t n;
   if (!buf.take(args[0], true) || !check_ring(&buf) ||
       !recs.take(args[1], false) || !as_i64(args[2], &n) ||
-      !recs.check(n * 8, "recs"))
+      !recs.check(n * kTraceRecWords, "recs"))
     return nullptr;
   return PyLong_FromLong(pbst_trace_emit_many(buf.ptr, recs.ptr, (int)n));
 }
@@ -136,7 +137,7 @@ PyObject* fc_trace_consume(PyObject*, PyObject* const* args,
   int64_t maxr;
   if (!buf.take(args[0], true) || !check_ring(&buf) ||
       !out.take(args[1], true) || !as_i64(args[2], &maxr) ||
-      !out.check(maxr * 8, "out"))
+      !out.check(maxr * kTraceRecWords, "out"))
     return nullptr;
   return PyLong_FromLong(pbst_trace_consume(buf.ptr, out.ptr, (int)maxr));
 }
@@ -153,7 +154,7 @@ PyObject* fc_hist_record(PyObject*, PyObject* const* args,
   int64_t slot, shift;
   if (!buf.take(args[0], true) || !as_i64(args[1], &slot) ||
       !as_u64(args[2], &value) || !as_i64(args[3], &shift) ||
-      !buf.check((slot + 1) * 38, "ledger"))
+      !buf.check((slot + 1) * kSlotWords, "ledger"))
     return nullptr;
   if (slot < 0) {
     PyErr_SetString(PyExc_IndexError, "hist_record: negative slot");
@@ -176,7 +177,7 @@ PyObject* fc_hist_record_many(PyObject*, PyObject* const* args,
   if (!buf.take(args[0], true) || !as_i64(args[1], &total) ||
       !slots.take(args[2], false) || !values.take(args[3], false) ||
       !as_i64(args[4], &n) || !as_i64(args[5], &shift) ||
-      !buf.check(total * 38, "ledger") ||
+      !buf.check(total * kSlotWords, "ledger") ||
       !slots.check(n, "slots") || !values.check(n, "values"))
     return nullptr;
   int rc = pbst_hist_record_many(
@@ -203,8 +204,8 @@ PyObject* fc_ledger_snapshot_many(PyObject*, PyObject* const* args,
   if (!buf.take(args[0], false) || !as_i64(args[1], &total) ||
       !slots.take(args[2], false) || !as_i64(args[3], &n) ||
       !out.take(args[4], true) || !as_i64(args[5], &retries) ||
-      !buf.check(total * 38, "ledger") ||
-      !slots.check(n, "slots") || !out.check(n * 18, "out"))
+      !buf.check(total * kSlotWords, "ledger") ||
+      !slots.check(n, "slots") || !out.check(n * kNumCounters, "out"))
     return nullptr;
   int rc = pbst_ledger_snapshot_many(
       buf.ptr, total, reinterpret_cast<int64_t*>(slots.ptr), (int)n,
@@ -217,6 +218,11 @@ PyObject* fc_ledger_snapshot_many(PyObject*, PyObject* const* args,
   return PyLong_FromLong(rc);
 }
 
+// pbst_sim_run's buffer arity (the prototype in pbst_runtime.cc):
+// gs gf js jf counters prev ph_i ph_f heap runq window hist
+// rng/wt/ww/qt/qq tabs ev.
+constexpr int kSimRunArgs = 18;
+
 PyObject* fc_sim_run(PyObject*, PyObject* const* args,
                      Py_ssize_t nargs) {
   // (gs, gf, js, jf, counters, prev, ph_i, ph_f, heap, runq, window,
@@ -226,18 +232,18 @@ PyObject* fc_sim_run(PyObject*, PyObject* const* args,
   // a whole simulated horizon, but the tier exists so the sim core
   // rides the same fastcall->ctypes->python order as every other
   // native path (and so stale-ABI detection covers it).
-  if (nargs != 18) {
+  if (nargs != kSimRunArgs) {
     PyErr_SetString(PyExc_TypeError,
                     "sim_run(gs, gf, js, jf, counters, prev, ph_i, "
                     "ph_f, heap, runq, window, hist, rng_tab, wt_tab, "
                     "ww_tab, qt_tab, qq_tab, ev) wants 18 buffers");
     return nullptr;
   }
-  ArgBuf b[18];
+  ArgBuf b[kSimRunArgs];
   // gs is writable and must at least hold the scalar block; the rest
   // are sized by the Python marshaller (sim/native_core.py) against
   // the same ABI word counts this .so exports.
-  for (int i = 0; i < 18; i++) {
+  for (int i = 0; i < kSimRunArgs; i++) {
     bool writable = !(i == 6 || i == 7 || i == 12 || i == 13 ||
                       i == 14 || i == 15 || i == 16);
     if (!b[i].take(args[i], writable)) return nullptr;

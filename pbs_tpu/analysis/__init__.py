@@ -6,13 +6,21 @@ framework types, the suite registry, and the entry points the CLI and
 tests drive.
 """
 
-from pbs_tpu.analysis.core import CheckContext, Finding, Pass, SourceFile
+from pbs_tpu.analysis.core import (
+    CheckContext,
+    CSourceFile,
+    Finding,
+    Pass,
+    SourceFile,
+)
 from pbs_tpu.analysis.runner import (
     ALL_PASSES,
     CheckResult,
+    changed_check_files,
     changed_py_files,
     check_paths,
     format_human,
+    iter_check_files,
     iter_py_files,
     list_suppressions,
     load_dynamic_graph,
@@ -23,12 +31,15 @@ __all__ = [
     "ALL_PASSES",
     "CheckContext",
     "CheckResult",
+    "CSourceFile",
     "Finding",
     "Pass",
     "SourceFile",
+    "changed_check_files",
     "changed_py_files",
     "check_paths",
     "format_human",
+    "iter_check_files",
     "iter_py_files",
     "list_suppressions",
     "load_dynamic_graph",

@@ -72,6 +72,11 @@ _SUPPRESS_RE = re.compile(
     r"#\s*pbst:\s*(ignore|ignore-file)\[([A-Za-z0-9_*,\s-]+)\]"
     r"(?:\s*--\s*(.*))?")
 
+#: Same grammar behind a C ``//`` comment leader (native/*.cc sources).
+_C_SUPPRESS_RE = re.compile(
+    r"//\s*pbst:\s*(ignore|ignore-file)\[([A-Za-z0-9_*,\s-]+)\]"
+    r"(?:\s*--\s*(.*))?")
+
 
 @dataclasses.dataclass(frozen=True)
 class Suppression:
@@ -86,6 +91,33 @@ class Suppression:
         if not any(r == "*" or r == rule for r in self.rules):
             return False
         return self.file_wide or line == self.line
+
+
+def _classify_comment(regex: re.Pattern, comment: str, line: int,
+                      rel_path: str, leader: str):
+    """One comment string -> Suppression, bad-suppression Finding, or
+    None (not a suppression comment at all). Shared by the Python and
+    C scanners so both languages get the same grammar and the same
+    justification-or-report contract."""
+    m = regex.search(comment)
+    if m is None:
+        if "pbst:" in comment and "ignore" in comment:
+            return Finding(
+                "bad-suppression", rel_path, line, 0,
+                f"unparseable suppression comment: {comment.strip()!r}",
+                hint=f"syntax: {leader} pbst: ignore[rule-id] -- "
+                     "justification")
+        return None
+    kind, rules_s, just = m.group(1), m.group(2), m.group(3)
+    rules = tuple(r.strip() for r in rules_s.split(",") if r.strip())
+    if not (just or "").strip():
+        return Finding(
+            "bad-suppression", rel_path, line, 0,
+            "suppression without a justification",
+            hint="append ' -- why this is safe' to the comment")
+    return Suppression(
+        rules=rules, line=line, file_wide=(kind == "ignore-file"),
+        justification=just.strip())
 
 
 class SourceFile:
@@ -119,25 +151,104 @@ class SourceFile:
                 for i, ln in enumerate(self.text.splitlines()) if "#" in ln
             ]
         for line, comment in comments:
-            m = _SUPPRESS_RE.search(comment)
-            if m is None:
-                if "pbst:" in comment and "ignore" in comment:
-                    self.bad_suppressions.append(Finding(
-                        "bad-suppression", self.rel_path, line, 0,
-                        f"unparseable suppression comment: {comment.strip()!r}",
-                        hint="syntax: # pbst: ignore[rule-id] -- justification"))
+            got = _classify_comment(_SUPPRESS_RE, comment, line,
+                                    self.rel_path, "#")
+            if isinstance(got, Suppression):
+                self.suppressions.append(got)
+            elif isinstance(got, Finding):
+                self.bad_suppressions.append(got)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        return any(s.matches(rule, line) for s in self.suppressions)
+
+
+class CSourceFile:
+    """One C/C++ source file (native/*.cc): raw text + the same
+    per-line suppression table as :class:`SourceFile`, behind ``//``
+    comment leaders. No AST — the memmodel passes run their own
+    tokenizing scans over :attr:`code` (text with comments and string
+    literals blanked, so protocol patterns never match prose).
+
+    Duck-compatible with SourceFile where the runner cares:
+    ``rel_path``/``suppressions``/``bad_suppressions``/``suppressed``.
+    """
+
+    is_c = True
+
+    def __init__(self, path: str, text: str, rel_path: str | None = None):
+        self.path = path
+        self.rel_path = rel_path if rel_path is not None else path
+        self.text = text
+        self.suppressions: list[Suppression] = []
+        self.bad_suppressions: list[Finding] = []
+        self.code = self._blank_noncode(text)
+        for i, ln in enumerate(self.code.splitlines()):
+            # Comment start = the first // that survives string
+            # blanking (a // inside a string literal is code).
+            col = ln.find("//")
+            if col < 0:
                 continue
-            kind, rules_s, just = m.group(1), m.group(2), m.group(3)
-            rules = tuple(r.strip() for r in rules_s.split(",") if r.strip())
-            if not (just or "").strip():
-                self.bad_suppressions.append(Finding(
-                    "bad-suppression", self.rel_path, line, 0,
-                    "suppression without a justification",
-                    hint="append ' -- why this is safe' to the comment"))
-                continue
-            self.suppressions.append(Suppression(
-                rules=rules, line=line, file_wide=(kind == "ignore-file"),
-                justification=just.strip()))
+            got = _classify_comment(_C_SUPPRESS_RE, ln[col:], i + 1,
+                                    self.rel_path, "//")
+            if isinstance(got, Suppression):
+                self.suppressions.append(got)
+            elif isinstance(got, Finding):
+                self.bad_suppressions.append(got)
+
+    @staticmethod
+    def _blank_noncode(text: str) -> str:
+        """``text`` with double-quoted string literals and /* */
+        comment bodies replaced by spaces (newlines kept, so offsets
+        and line numbers survive). // comments are KEPT verbatim — the
+        suppression scanner needs them — and stripped later by
+        :meth:`code_lines`. Single quotes are left alone: this tree
+        uses them as C++14 digit separators (0x70627374'6462ULL), not
+        char literals, and a naive quote-matcher would blank real code
+        between two separators."""
+        out = []
+        i, n = 0, len(text)
+        while i < n:
+            c = text[i]
+            if c == '"':
+                out.append(c)
+                i += 1
+                while i < n and text[i] != '"':
+                    if text[i] == "\\" and i + 1 < n:
+                        out.append("  ")
+                        i += 2
+                        continue
+                    out.append("\n" if text[i] == "\n" else " ")
+                    i += 1
+                if i < n:
+                    out.append('"')
+                    i += 1
+            elif c == "/" and i + 1 < n and text[i + 1] == "*":
+                out.append("  ")
+                i += 2
+                while i + 1 < n and not (text[i] == "*"
+                                         and text[i + 1] == "/"):
+                    out.append("\n" if text[i] == "\n" else " ")
+                    i += 1
+                if i + 1 < n:
+                    out.append("  ")
+                    i += 2
+            elif c == "/" and i + 1 < n and text[i + 1] == "/":
+                while i < n and text[i] != "\n":
+                    out.append(text[i])
+                    i += 1
+            else:
+                out.append(c)
+                i += 1
+        return "".join(out)
+
+    def code_lines(self) -> list[str]:
+        """Per-line code with // comments stripped too (1-based via
+        index+1). The surface the memmodel token scans run over."""
+        out = []
+        for ln in self.code.splitlines():
+            cut = ln.find("//")
+            out.append(ln if cut < 0 else ln[:cut])
+        return out
 
     def suppressed(self, rule: str, line: int) -> bool:
         return any(s.matches(rule, line) for s in self.suppressions)
@@ -147,8 +258,13 @@ class CheckContext:
     """Shared state for one ``pbst check`` run (all files + options)."""
 
     def __init__(self, files: list[SourceFile],
-                 dynamic_lock_edges: set[tuple[str, str]] | None = None):
+                 dynamic_lock_edges: set[tuple[str, str]] | None = None,
+                 c_files: list[CSourceFile] | None = None):
         self.files = files
+        #: C/C++ sources (native/*.cc) in the scan set — visited by
+        #: passes that override :meth:`Pass.run_c` (the cross-language
+        #: memmodel passes). Empty for pure-Python runs.
+        self.c_files = c_files or []
         #: Dynamic lock-order graph edges (from ``pbst lockdep
         #: --dump-graph``) merged into the static cross-check.
         self.dynamic_lock_edges = dynamic_lock_edges or set()
@@ -167,6 +283,11 @@ class Pass:
     description: str = ""
 
     def run(self, src: SourceFile, ctx: CheckContext) -> list[Finding]:
+        return []
+
+    def run_c(self, csrc: CSourceFile, ctx: CheckContext) -> list[Finding]:
+        """Per C source file (native/*.cc). Only the cross-language
+        passes override this; pure-Python passes never see C files."""
         return []
 
     def finalize(self, ctx: CheckContext) -> list[Finding]:

@@ -17,12 +17,23 @@ import json
 import os
 from typing import Any, Iterable
 
-from pbs_tpu.analysis.core import CheckContext, Finding, Pass, SourceFile
+from pbs_tpu.analysis.core import (
+    CheckContext,
+    CSourceFile,
+    Finding,
+    Pass,
+    SourceFile,
+)
 from pbs_tpu.analysis.counterapi import CounterApiPass
 from pbs_tpu.analysis.durabilitypass import DurabilityPass
 from pbs_tpu.analysis.gatewaypass import GatewayDisciplinePass
 from pbs_tpu.analysis.knobspass import KnobDisciplinePass
 from pbs_tpu.analysis.locks import LockDisciplinePass
+from pbs_tpu.analysis.memmodel import (
+    AbiLayoutDriftPass,
+    DeterminismDisciplinePass,
+    SeqlockDisciplinePass,
+)
 from pbs_tpu.analysis.netdiscipline import NetDisciplinePass
 from pbs_tpu.analysis.obspass import ObsDisciplinePass
 from pbs_tpu.analysis.perfpass import PerfDisciplinePass
@@ -47,6 +58,9 @@ ALL_PASSES: tuple[type[Pass], ...] = (
     ScenarioDisciplinePass,
     DurabilityPass,
     ServeDisciplinePass,
+    SeqlockDisciplinePass,
+    AbiLayoutDriftPass,
+    DeterminismDisciplinePass,
 )
 
 
@@ -85,20 +99,30 @@ class CheckResult:
         return out
 
 
-def iter_py_files(paths: Iterable[str]) -> list[str]:
+#: Extensions the checker scans. .py files get the AST pass suite;
+#: .cc files get the cross-language memmodel passes (run_c hook).
+CHECK_EXTS = (".py", ".cc")
+
+
+def iter_check_files(paths: Iterable[str],
+                     exts: tuple[str, ...] = CHECK_EXTS) -> list[str]:
     out: list[str] = []
     for p in paths:
         if os.path.isfile(p):
-            if p.endswith(".py"):
+            if p.endswith(exts):
                 out.append(p)
         elif os.path.isdir(p):
             for root, dirs, files in os.walk(p):
                 dirs[:] = sorted(d for d in dirs
                                  if not d.startswith((".", "__pycache__")))
                 for f in sorted(files):
-                    if f.endswith(".py"):
+                    if f.endswith(exts):
                         out.append(os.path.join(root, f))
     return sorted(dict.fromkeys(out))
+
+
+def iter_py_files(paths: Iterable[str]) -> list[str]:
+    return iter_check_files(paths, exts=(".py",))
 
 
 def load_dynamic_graph(path: str) -> set[tuple[str, str]]:
@@ -139,13 +163,22 @@ def load_dynamic_graph(path: str) -> set[tuple[str, str]]:
     return out
 
 
-def changed_py_files(base_ref: str, paths: Iterable[str],
-                     root: str | None = None) -> list[str]:
-    """The ``--changed`` fast path: python files under ``paths`` that
-    differ from ``base_ref`` in git (working tree vs ref, deletions
-    excluded) plus untracked files. Raises ValueError when git cannot
-    answer (not a repo, unknown ref) — the CLI maps that to a usage
-    error, never to a silently-empty "clean" run.
+def changed_check_files(base_ref: str, paths: Iterable[str],
+                        root: str | None = None) -> list[str]:
+    """The ``--changed`` fast path: checkable files (.py and .cc)
+    under ``paths`` that differ from ``base_ref`` in git (working tree
+    vs ref, deletions excluded) plus untracked files. Raises
+    ValueError when git cannot answer (not a repo, unknown ref) — the
+    CLI maps that to a usage error, never to a silently-empty "clean"
+    run.
+
+    A changed ``.cc`` file arms the cross-language memmodel passes,
+    which diff the C layout against its Python mirrors — so the
+    changed set is EXPANDED with every sibling .cc under ``paths``
+    (pbst_fastcall.cc #includes pbst_runtime.cc: constants flow across
+    files) and the declared Python ABI anchor modules (resolved
+    against the git toplevel; silently absent in trees that don't
+    have them). A .py-only change set is returned as-is.
 
     Caveat (documented in docs/ANALYSIS.md): cross-file analyses
     (static lock-order graph, knob-native-drift, knob constant
@@ -175,17 +208,37 @@ def changed_py_files(base_ref: str, paths: Iterable[str],
     # base or a subdirectory invocation silently reports clean.
     toplevel = top.stdout.strip()
     changed = {os.path.abspath(os.path.join(toplevel, n))
-               for n in diff.stdout.splitlines() if n.endswith(".py")}
+               for n in diff.stdout.splitlines() if n.endswith(CHECK_EXTS)}
     if untracked.returncode == 0:
         changed |= {os.path.abspath(os.path.join(root, n))
                     for n in untracked.stdout.splitlines()
-                    if n.endswith(".py")}
+                    if n.endswith(CHECK_EXTS)}
     wanted = set()
-    for p in iter_py_files(paths):
+    for p in iter_check_files(paths):
         ap = os.path.abspath(p)
         if ap in changed and os.path.isfile(ap):
             wanted.add(p)
+    if any(p.endswith(".cc") for p in wanted):
+        # Cross-language context for the memmodel passes: every .cc
+        # under paths (constants span #include'd siblings) + the
+        # Python mirror modules the ABI contract names.
+        from pbs_tpu.analysis.memmodel import CROSS_LANG_PY_ANCHORS
+
+        wanted |= {p for p in iter_check_files(paths)
+                   if p.endswith(".cc")}
+        for rel in CROSS_LANG_PY_ANCHORS:
+            ap = os.path.join(toplevel, rel)
+            if os.path.isfile(ap):
+                wanted.add(ap)
     return sorted(wanted)
+
+
+def changed_py_files(base_ref: str, paths: Iterable[str],
+                     root: str | None = None) -> list[str]:
+    """Back-compat shim: the .py subset of :func:`changed_check_files`
+    (no cross-language expansion)."""
+    return [p for p in changed_check_files(base_ref, paths, root)
+            if p.endswith(".py")]
 
 
 def list_suppressions(paths: Iterable[str],
@@ -197,14 +250,15 @@ def list_suppressions(paths: Iterable[str],
     audit can't under-report the escape hatch."""
     root = root or os.getcwd()
     out: list[dict] = []
-    for path in iter_py_files(paths):
+    for path in iter_check_files(paths):
         try:
             with open(path, encoding="utf-8") as f:
                 text = f.read()
         except (OSError, UnicodeDecodeError):
             continue
         rel = os.path.relpath(os.path.abspath(path), root)
-        src = SourceFile(path, text, rel_path=rel.replace(os.sep, "/"))
+        cls = CSourceFile if path.endswith(".cc") else SourceFile
+        src = cls(path, text, rel_path=rel.replace(os.sep, "/"))
         for s in src.suppressions:
             out.append({
                 "path": src.rel_path, "line": s.line,
@@ -240,16 +294,22 @@ def check_paths(paths: Iterable[str],
         selected = [p for p in ALL_PASSES if p.id in wanted]
 
     files: list[SourceFile] = []
-    for path in iter_py_files(paths):
+    c_files: list[CSourceFile] = []
+    for path in iter_check_files(paths):
         try:
             with open(path, encoding="utf-8") as f:
                 text = f.read()
         except (OSError, UnicodeDecodeError):
             continue
         rel = os.path.relpath(os.path.abspath(path), root)
-        files.append(SourceFile(path, text, rel_path=rel.replace(os.sep, "/")))
+        rel = rel.replace(os.sep, "/")
+        if path.endswith(".cc"):
+            c_files.append(CSourceFile(path, text, rel_path=rel))
+        else:
+            files.append(SourceFile(path, text, rel_path=rel))
 
-    ctx = CheckContext(files, dynamic_lock_edges=dynamic_graph)
+    ctx = CheckContext(files, dynamic_lock_edges=dynamic_graph,
+                       c_files=c_files)
     instances = [cls() for cls in selected]
     raw: list[Finding] = []
     for src in files:
@@ -260,10 +320,14 @@ def check_paths(paths: Iterable[str],
             continue
         for inst in instances:
             raw.extend(inst.run(src, ctx))
+    for csrc in c_files:
+        raw.extend(csrc.bad_suppressions)
+        for inst in instances:
+            raw.extend(inst.run_c(csrc, ctx))
     for inst in instances:
         raw.extend(inst.finalize(ctx))
 
-    by_rel = {src.rel_path: src for src in files}
+    by_rel = {src.rel_path: src for src in [*files, *c_files]}
     findings: list[Finding] = []
     suppressed: list[tuple[Finding, str]] = []
     for f in sorted(raw, key=Finding.sort_key):
@@ -275,7 +339,7 @@ def check_paths(paths: Iterable[str],
         else:
             findings.append(f)
     return CheckResult(findings=findings, suppressed=suppressed,
-                       files_scanned=len(files),
+                       files_scanned=len(files) + len(c_files),
                        passes_run=[p.id for p in instances])
 
 

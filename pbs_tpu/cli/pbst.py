@@ -482,7 +482,7 @@ def cmd_check(args) -> int:
     counter-API usage. Exit 0 clean / 1 findings / 2 usage error."""
     from pbs_tpu.analysis import (
         ALL_PASSES,
-        changed_py_files,
+        changed_check_files,
         check_paths,
         format_human,
         list_suppressions,
@@ -519,7 +519,7 @@ def cmd_check(args) -> int:
     paths = args.paths
     if args.changed:
         try:
-            paths = changed_py_files(args.changed, args.paths)
+            paths = changed_check_files(args.changed, args.paths)
         except ValueError as e:
             print(f"pbst: bad --changed {args.changed!r}: {e}",
                   file=sys.stderr)
@@ -527,7 +527,7 @@ def cmd_check(args) -> int:
         if not paths:
             # A legitimately empty change set is clean, not a usage
             # error — this is the pre-commit fast path.
-            print(f"pbst check: no python files changed vs "
+            print(f"pbst check: no checkable files changed vs "
                   f"{args.changed} under {args.paths}")
             return 0
     try:
@@ -537,7 +537,7 @@ def cmd_check(args) -> int:
         print(f"pbst: {e.args[0]}", file=sys.stderr)
         return 2
     if result.files_scanned == 0:
-        print(f"pbst: no python files under {paths}", file=sys.stderr)
+        print(f"pbst: no checkable files under {paths}", file=sys.stderr)
         return 2
     if args.format == "json":
         print(json.dumps(result.as_dict(), indent=1, sort_keys=True))
@@ -2221,8 +2221,10 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser(
         "check", help="static invariant checkers (docs/ANALYSIS.md)")
-    sp.add_argument("paths", nargs="*", default=["pbs_tpu"],
-                    help="files/dirs to check (default: pbs_tpu)")
+    sp.add_argument("paths", nargs="*", default=["pbs_tpu", "native"],
+                    help="files/dirs to check (default: pbs_tpu native "
+                         "— .py and .cc are both in scope; the "
+                         "memmodel passes check the language boundary)")
     sp.add_argument("--format", choices=["text", "json"], default="text")
     sp.add_argument("--pass", dest="passes", action="append",
                     metavar="PASS-ID",

@@ -20,7 +20,16 @@ import numpy as np
 from pbs_tpu.obs.lockprof import ProfiledLock
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
-_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libpbst_runtime.so"))
+#: PBST_NATIVE_LIB points the loader at an alternate build of the same
+#: ABI — the sanitizer tier (libpbst_runtime_{asan,ubsan}.so) runs the
+#: whole ctypes surface under ASan/UBSan in a subprocess with nothing
+#: but this env var changed. An override path is used as-is: no
+#: mtime-vs-source rebuild (the override names a specific artifact,
+#: and `make asan` owns its freshness).
+_LIB_OVERRIDE = os.environ.get("PBST_NATIVE_LIB") or None
+_LIB_PATH = os.path.abspath(
+    _LIB_OVERRIDE if _LIB_OVERRIDE
+    else os.path.join(_NATIVE_DIR, "libpbst_runtime.so"))
 
 _lock = ProfiledLock("native_load")
 _lib: ctypes.CDLL | None = None
@@ -94,6 +103,11 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.pbst_trace_consume.restype = ctypes.c_int
     lib.pbst_trace_lost.argtypes = [_U64P]
     lib.pbst_trace_lost.restype = ctypes.c_uint64
+    # Trace-layout getters, same stale-binary story as the sim ABI
+    # getters below: obs/trace.py can assert the ring geometry this
+    # .so was compiled with matches its own TRACE_*_WORDS mirrors.
+    lib.pbst_trace_rec_words.restype = ctypes.c_int
+    lib.pbst_trace_header_words.restype = ctypes.c_int
     _U8P = ctypes.POINTER(ctypes.c_uint8)
     lib.pbst_gather_rows.argtypes = [
         _U8P, ctypes.c_uint64, _U64P, ctypes.c_int, ctypes.c_uint64, _U8P]
@@ -138,8 +152,16 @@ def load() -> ctypes.CDLL | None:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB_PATH) and not _build():
-            return None
+        if not os.path.exists(_LIB_PATH):
+            if _LIB_OVERRIDE:
+                # make only knows how to produce the default artifact;
+                # an override names exactly one file, so a missing one
+                # is the caller's bug, not a build trigger.
+                _note_failure(
+                    f"PBST_NATIVE_LIB={_LIB_PATH} does not exist")
+                return None
+            if not _build():
+                return None
         for attempt in (0, 1):
             try:
                 lib = ctypes.CDLL(_LIB_PATH)
@@ -150,11 +172,11 @@ def load() -> ctypes.CDLL | None:
                 # AttributeError = stale .so missing a newer symbol;
                 # rebuild once, then degrade to the Python paths.
                 _lib = None
-                if attempt == 1:
+                if attempt == 1 or _LIB_OVERRIDE:
                     _note_failure(
-                        f"load failed after rebuild: "
-                        f"{type(e).__name__}: {e}")
-                elif not _build():
+                        f"load failed: {type(e).__name__}: {e}")
+                    break
+                if not _build():
                     break
         return _lib
 

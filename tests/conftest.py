@@ -88,13 +88,65 @@ def native_lib():
     return lib
 
 
-def require_native() -> None:
+#: Sanitizer flavors of the native runtime (dynamic witness for the
+#: memmodel static passes): flavor -> (make target, artifact name).
+#: Build outcome is cached per flavor so a host without the toolchain
+#: pays one failed make per session, not one per test, and every skip
+#: carries the same cached compiler error.
+_SAN_FLAVORS = {
+    "asan": ("asan", "libpbst_runtime_asan.so"),
+    "ubsan": ("ubsan", "libpbst_runtime_ubsan.so"),
+}
+_san_cache: dict = {}  # flavor -> (path | None, failure reason | None)
+
+
+def native_sanitizer_lib(flavor: str) -> tuple:
+    """(path, None) to the ASan/UBSan build of the native runtime, or
+    (None, why) when it cannot be produced. Builds at most once per
+    flavor per session (compile-to-temp + atomic mv in the Makefile)."""
+    if flavor in _san_cache:
+        return _san_cache[flavor]
+    target, artifact = _SAN_FLAVORS[flavor]
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    native_dir = os.path.join(root, "native")
+    try:
+        out = subprocess.run(
+            ["make", "-C", native_dir, target], capture_output=True,
+            text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        _san_cache[flavor] = (None, f"build not attempted: {e}")
+        return _san_cache[flavor]
+    if out.returncode != 0:
+        tail = " | ".join(
+            (out.stderr or out.stdout or "").strip().splitlines()[-4:])
+        _san_cache[flavor] = (None, f"make {target} failed: {tail[:400]}")
+        return _san_cache[flavor]
+    path = os.path.join(native_dir, artifact)
+    if not os.path.exists(path):
+        _san_cache[flavor] = (None, f"make {target} produced no {artifact}")
+    else:
+        _san_cache[flavor] = (path, None)
+    return _san_cache[flavor]
+
+
+def require_native(flavor: str | None = None) -> str | None:
     """Imperative form of ``native_lib`` for native-parametrized tests
     (``@pytest.mark.parametrize("use_native", ...)`` can't request a
     fixture conditionally): skip with the cached WHY when the runtime
-    is unavailable."""
+    is unavailable.
+
+    With ``flavor`` ("asan"/"ubsan"), additionally require that
+    sanitizer build of the runtime and return its path (for a
+    subprocess's PBST_NATIVE_LIB); skips with the cached build-failure
+    reason when the toolchain can't produce it."""
     from pbs_tpu.runtime import native
 
     if not native.available():
         pytest.skip(
             f"native runtime unavailable: {native.unavailable_reason()}")
+    if flavor is None:
+        return None
+    path, why = native_sanitizer_lib(flavor)
+    if path is None:
+        pytest.skip(f"native {flavor} runtime unavailable: {why}")
+    return path

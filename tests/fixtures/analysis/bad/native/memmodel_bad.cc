@@ -1,0 +1,86 @@
+// Fixture twin of the native runtime with seeded memory-model
+// violations — one per seqlock-discipline / abi-layout-drift rule.
+// Never compiled; the memmodel passes scan it as text. The layout
+// constants here DRIFT against the fixture tree's Python mirrors
+// (telemetry/counters.py says 17, runtime/doorbell.py declares a
+// magic this file lacks) — the drifted-.cc twin.
+
+#include <cstdint>
+#include <cstring>
+
+static const int kNumCounters = 18;  // py mirror says 17: drift
+static const int kHeaderWords = 2;
+static const int kSlotWords = kHeaderWords + 2 * kNumCounters;
+static const int kDoorbellHeaderWords = 4;
+// kDoorbellMagic deliberately missing: the py mirror declares _MAGIC.
+
+// BAD: version store is relaxed and there is no release fence — the
+// odd/even bracket exists but orders nothing.
+static inline void write_begin(uint64_t* s) {
+  uint64_t v = __atomic_load_n(&s[0], __ATOMIC_RELAXED);
+  __atomic_store_n(&s[0], v + 1, __ATOMIC_RELAXED);
+}
+
+static inline void write_end(uint64_t* s) {
+  __atomic_thread_fence(__ATOMIC_RELEASE);
+  uint64_t v = __atomic_load_n(&s[0], __ATOMIC_RELAXED);
+  __atomic_store_n(&s[0], v + 1, __ATOMIC_RELEASE);
+}
+
+extern "C" {
+
+// BAD: first store lands before the bracket opens, and the bracket
+// is never closed — readers spin their whole retry budget.
+void pbst_bad_slot_touch(uint64_t* buf, int64_t slot) {
+  uint64_t* s = buf + slot * kSlotWords;
+  s[2] = 7;
+  write_begin(s);
+  s[3] = 8;
+}
+
+// BAD retry loop: relaxed version loads, no odd rejection, no
+// acquire fences around the copy (the v0 == v1 re-check is the one
+// leg it gets right).
+int pbst_bad_snapshot(const uint64_t* buf, int64_t slot,
+                      uint64_t* out) {
+  const uint64_t* s = buf + slot * kSlotWords;
+  for (int i = 0; i < 64; i++) {
+    uint64_t v0 = __atomic_load_n(&s[0], __ATOMIC_RELAXED);
+    std::memcpy(out, s + kHeaderWords,
+                kNumCounters * sizeof(uint64_t));
+    uint64_t v1 = __atomic_load_n(&s[0], __ATOMIC_RELAXED);
+    if (v0 == v1) return 1;
+  }
+  return 0;
+}
+
+// BAD ring publish: head store is relaxed, and one payload word is
+// written AFTER the head already covers it.
+int pbst_bad_ring_push(uint64_t* buf, uint64_t ts) {
+  uint64_t head = __atomic_load_n(&buf[0], __ATOMIC_RELAXED);
+  uint64_t* rec = buf + kDoorbellHeaderWords + (head % buf[2]) * 8;
+  rec[0] = ts;
+  __atomic_store_n(&buf[0], head + 1, __ATOMIC_RELAXED);
+  rec[1] = ts + 1;
+  return 1;
+}
+
+// BAD: bare 38 duplicates kSlotWords — keeps compiling after the
+// layout changes.
+uint64_t pbst_bad_slot_base(int64_t slot) { return slot * 38; }
+
+// BAD: exported but referenced by no Python source in this tree.
+int pbst_orphan_words(void) { return kSlotWords; }
+
+// Bound correctly by the fixture binding layer (arity 2) — the py
+// side declares ONE argtype: abi-binding-arity.
+int pbst_add2(uint64_t* a, int n) { return a[0] ? n : 0; }
+
+}  // extern "C"
+
+// BAD: table entry has no fc_ghost_emit handler.
+PyMethodDef kBadMethods[] = {
+    {"ghost_emit", (PyCFunction)(void (*)())fc_ghost_emit,
+     METH_FASTCALL, "seeded: handler does not exist"},
+    {nullptr, nullptr, 0, nullptr},
+};

@@ -1,0 +1,4 @@
+"""Fixture ABI mirror: the C twin lost its doorbell magic."""
+
+HEADER_WORDS = 4
+_MAGIC = 0x70627374_6462

@@ -1,0 +1,26 @@
+"""Fixture binding layer: seeded arity/symbol/table mismatches."""
+
+import ctypes
+
+
+def declare(lib):
+    # Wrong arity: the C prototype pbst_add2(uint64_t*, int) takes 2.
+    lib.pbst_add2.argtypes = [ctypes.c_void_p]
+    lib.pbst_add2.restype = ctypes.c_int
+    lib.pbst_bad_slot_touch.argtypes = [ctypes.c_void_p,
+                                        ctypes.c_int64]
+    lib.pbst_bad_snapshot.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                      ctypes.c_void_p]
+    lib.pbst_bad_snapshot.restype = ctypes.c_int
+    lib.pbst_bad_ring_push.argtypes = [ctypes.c_void_p,
+                                       ctypes.c_uint64]
+    lib.pbst_bad_slot_base.argtypes = [ctypes.c_int64]
+    # Typo'd symbol: no scanned .cc file defines this entry point.
+    lib.pbst_missing_fn.restype = ctypes.c_int
+
+
+def fastcall_gate(mod):
+    # "missing_sym" is required here but absent from the method table.
+    for fn in ("ghost_emit", "missing_sym"):
+        if not hasattr(mod, fn):
+            raise ImportError(fn)

@@ -1,0 +1,23 @@
+"""Fixture: nondeterminism inside a digest-covered subsystem."""
+
+import os
+import random
+import time
+import uuid
+
+
+def stamp_run(cfg):
+    return {"id": uuid.uuid4().hex, "t": time.time()}
+
+
+def jitter():
+    rng = random.Random()
+    return rng.random() + random.random()
+
+
+def order_devices(devs):
+    out = []
+    for d in {d for d in devs}:
+        out.append(d)
+    out.append(os.urandom(4))
+    return out

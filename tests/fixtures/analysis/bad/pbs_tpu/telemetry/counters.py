@@ -1,0 +1,3 @@
+"""Fixture ABI mirror: counter count drifted vs the C side (18)."""
+
+NUM_COUNTERS = 17

@@ -1,0 +1,18 @@
+"""Fixture: raw seqlock-buffer writes bypassing the writer APIs."""
+
+import os
+import struct
+
+
+def poke_slot(mm, off, vals):
+    struct.pack_into("<4Q", mm, off, *vals)
+
+
+def patch_file(fd, off, blob):
+    os.pwrite(fd, blob, off)
+
+
+def flip_version(led, slot):
+    led._begin(slot)
+    led._store(slot, 0, 1)
+    led._end(slot)

@@ -1,0 +1,76 @@
+// Clean twin of memmodel_bad.cc: the full seqlock write/read/publish
+// protocol spelled correctly, layout constants in lockstep with the
+// fixture tree's Python mirrors, every export bound, the method table
+// complete. Never compiled; scanned as text by the memmodel passes.
+
+#include <cstdint>
+#include <cstring>
+
+static const int kNumCounters = 18;
+static const int kHeaderWords = 2;
+static const int kSlotWords = kHeaderWords + 2 * kNumCounters;
+static const int kDoorbellHeaderWords = 4;
+static const uint64_t kDoorbellMagic = 0x70627374'6462ULL;
+
+static inline void write_begin(uint64_t* s) {
+  uint64_t v = __atomic_load_n(&s[0], __ATOMIC_RELAXED);
+  __atomic_store_n(&s[0], v + 1, __ATOMIC_RELEASE);
+  __atomic_thread_fence(__ATOMIC_RELEASE);
+}
+
+static inline void write_end(uint64_t* s) {
+  __atomic_thread_fence(__ATOMIC_RELEASE);
+  uint64_t v = __atomic_load_n(&s[0], __ATOMIC_RELAXED);
+  __atomic_store_n(&s[0], v + 1, __ATOMIC_RELEASE);
+}
+
+extern "C" {
+
+void pbst_good_slot_add(uint64_t* buf, int64_t slot, uint64_t v) {
+  uint64_t* s = buf + slot * kSlotWords;
+  write_begin(s);
+  s[kHeaderWords] = s[kHeaderWords] + v;
+  write_end(s);
+}
+
+int pbst_good_snapshot(const uint64_t* buf, int64_t slot,
+                       uint64_t* out) {
+  const uint64_t* s = buf + slot * kSlotWords;
+  for (int i = 0; i < 64; i++) {
+    uint64_t v0 = __atomic_load_n(&s[0], __ATOMIC_ACQUIRE);
+    if (v0 & 1) continue;
+    __atomic_thread_fence(__ATOMIC_ACQUIRE);
+    std::memcpy(out, s + kHeaderWords,
+                kNumCounters * sizeof(uint64_t));
+    __atomic_thread_fence(__ATOMIC_ACQUIRE);
+    uint64_t v1 = __atomic_load_n(&s[0], __ATOMIC_ACQUIRE);
+    if (v0 == v1) return 1;
+  }
+  return 0;
+}
+
+int pbst_good_ring_push(uint64_t* buf, uint64_t ts, uint64_t arg) {
+  uint64_t head = __atomic_load_n(&buf[0], __ATOMIC_RELAXED);
+  uint64_t* rec = buf + kDoorbellHeaderWords + (head % buf[2]) * 2;
+  rec[0] = ts;
+  rec[1] = arg;
+  __atomic_store_n(&buf[0], head + 1, __ATOMIC_RELEASE);
+  return 1;
+}
+
+int pbst_good_doorbell_ok(const uint64_t* db) {
+  return db[1] == kDoorbellMagic;
+}
+
+}  // extern "C"
+
+static PyObject* fc_emit(PyObject* self, PyObject* const* args,
+                         Py_ssize_t nargs) {
+  return nullptr;
+}
+
+PyMethodDef kCleanMethods[] = {
+    {"emit", (PyCFunction)(void (*)())fc_emit, METH_FASTCALL,
+     "clean twin entry"},
+    {nullptr, nullptr, 0, nullptr},
+};

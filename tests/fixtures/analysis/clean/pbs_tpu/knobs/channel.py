@@ -1,0 +1,8 @@
+"""Clean twin: the sanctioned writer module owns its pack_into —
+the seqlock version-word discipline lives here by design."""
+
+import struct
+
+
+def _store(mm, off, word):
+    struct.pack_into("<Q", mm, off, word)

@@ -1,0 +1,4 @@
+"""Clean ABI mirror: header words and magic match the C twin."""
+
+HEADER_WORDS = 4
+_MAGIC = 0x70627374_6462

@@ -1,0 +1,26 @@
+"""Clean binding layer: arities mirror the C prototypes exactly."""
+
+import ctypes
+
+
+def declare(lib):
+    lib.pbst_good_slot_add.argtypes = [ctypes.c_void_p,
+                                       ctypes.c_int64,
+                                       ctypes.c_uint64]
+    lib.pbst_good_slot_add.restype = None
+    lib.pbst_good_snapshot.argtypes = [ctypes.c_void_p,
+                                       ctypes.c_int64,
+                                       ctypes.c_void_p]
+    lib.pbst_good_snapshot.restype = ctypes.c_int
+    lib.pbst_good_ring_push.argtypes = [ctypes.c_void_p,
+                                        ctypes.c_uint64,
+                                        ctypes.c_uint64]
+    lib.pbst_good_ring_push.restype = ctypes.c_int
+    lib.pbst_good_doorbell_ok.argtypes = [ctypes.c_void_p]
+    lib.pbst_good_doorbell_ok.restype = ctypes.c_int
+
+
+def fastcall_gate(mod):
+    for fn in ("emit",):
+        if not hasattr(mod, fn):
+            raise ImportError(fn)

@@ -1,0 +1,21 @@
+"""Clean determinism twin: declared clock seam, seeded RNG, sorted
+set iteration."""
+
+import random
+import time
+
+REAL_CLOCK_SEAM = ("run stamping is the one place sim reads the wall "
+                   "clock; replays pin it via cfg.now_ns")
+
+
+def stamp_run(cfg):
+    return {"t": time.time()}
+
+
+def jitter(seed):
+    rng = random.Random(seed)
+    return rng.random()
+
+
+def order_devices(devs):
+    return [d for d in sorted({d for d in devs})]

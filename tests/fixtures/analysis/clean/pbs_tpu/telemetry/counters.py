@@ -1,0 +1,3 @@
+"""Clean ABI mirror: counter count in lockstep with the C side."""
+
+NUM_COUNTERS = 18

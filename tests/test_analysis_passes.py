@@ -58,6 +58,14 @@ def test_all_rules_fire_on_bad_tree():
         "scenario-corpus-golden", "scenario-raw-genome",
         "dur-unjournaled-mutation", "dur-unsealed-read",
         "serve-unmatched-rule", "serve-raw-mesh-axis",
+        "seqlock-missing-release", "seqlock-plain-store",
+        "seqlock-unbalanced", "seqlock-reader-protocol",
+        "seqlock-ring-publish", "seqlock-raw-py-write",
+        "abi-const-drift", "abi-missing-const", "abi-magic-literal",
+        "abi-binding-arity", "abi-unknown-symbol",
+        "abi-unbound-export", "abi-fastcall-table",
+        "det-wallclock", "det-unseeded-rng", "det-urandom",
+        "det-set-iteration",
     }
 
 
@@ -121,7 +129,9 @@ def test_cli_list_passes(capsys):
                 "counter-api", "gateway-discipline", "perf-discipline",
                 "obs-discipline", "knob-discipline",
                 "rollout-discipline", "scenario-discipline",
-                "durability-discipline", "serve-discipline"):
+                "durability-discipline", "serve-discipline",
+                "seqlock-discipline", "abi-layout-drift",
+                "determinism-discipline"):
         assert pid in out
 
 

@@ -19,12 +19,15 @@ from pbs_tpu.cli.pbst import main
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "pbs_tpu")
+NATIVE = os.path.join(REPO, "native")
 
 
 @pytest.fixture(scope="module")
 def tree_result():
-    # One full-tree scan shared by the module (tier-1 budget).
-    return check_paths([PKG], root=REPO)
+    # One full-tree scan shared by the module (tier-1 budget). native/
+    # is in scope: the memmodel passes check the .cc side of the
+    # seqlock protocol and the cross-language ABI contract.
+    return check_paths([PKG, NATIVE], root=REPO)
 
 
 def test_repo_tree_is_clean(tree_result):
@@ -56,8 +59,20 @@ def test_repo_tree_is_clean(tree_result):
     ]
 
 
+def test_both_native_sources_are_in_the_scan_set():
+    """The cross-language passes only see what the walker feeds them:
+    every live .cc file must be in the default scan set, or the seqlock
+    and ABI rules silently stop covering half the boundary."""
+    from pbs_tpu.analysis import iter_check_files
+
+    cc = sorted(os.path.basename(p)
+                for p in iter_check_files([PKG, NATIVE])
+                if p.endswith(".cc"))
+    assert cc == ["pbst_fastcall.cc", "pbst_runtime.cc"]
+
+
 def test_cli_selfcheck_json_exit_zero(capsys):
-    assert main(["check", PKG, "--format", "json"]) == 0
+    assert main(["check", PKG, NATIVE, "--format", "json"]) == 0
     d = json.loads(capsys.readouterr().out)
     assert d["findings"] == []
     # The justified suppressions (see test_repo_tree_is_clean).
@@ -152,6 +167,47 @@ def test_check_changed_empty_set_is_clean(capsys):
     os.chdir(REPO)
     try:
         assert main(["check", "pbs_tpu/utils", "--changed", "HEAD"]) == 0
-        assert "no python files changed" in capsys.readouterr().out
+        assert "no checkable files changed" in capsys.readouterr().out
+    finally:
+        os.chdir(cwd)
+
+
+def test_check_changed_cc_arms_cross_language_passes(tmp_path, capsys):
+    """A changed ``native/*.cc`` must pull the Python ABI mirror
+    modules into the incremental scan set: an ABI edit on the C side
+    alone is exactly the drift the memmodel passes exist to catch, and
+    a --changed run that saw only the .cc file would diff it against
+    nothing and report clean."""
+    import subprocess
+
+    repo = tmp_path / "r"
+    (repo / "native").mkdir(parents=True)
+    counters = repo / "pbs_tpu" / "telemetry"
+    counters.mkdir(parents=True)
+    (counters / "counters.py").write_text("NUM_COUNTERS = 18\n")
+    cc = repo / "native" / "rt.cc"
+    cc.write_text("static const int kNumCounters = 18;\n")
+    subprocess.run(["git", "init", "-q"], cwd=repo, check=True)
+    subprocess.run(["git", "add", "-A"], cwd=repo, check=True)
+    subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                    "commit", "-q", "-m", "seed"], cwd=repo, check=True)
+    cwd = os.getcwd()
+    os.chdir(repo)
+    try:
+        # Drift the C side only. The changed set is {rt.cc}; the
+        # expansion must bring in pbs_tpu/telemetry/counters.py so
+        # abi-const-drift has both sides to diff.
+        cc.write_text("static const int kNumCounters = 20;\n")
+        assert main(["check", "pbs_tpu", "native",
+                     "--changed", "HEAD"]) == 1
+        out = capsys.readouterr().out
+        assert "abi-const-drift" in out
+        assert "NUM_COUNTERS = 18" in out or "kNumCounters" in out
+        # Fix the drift on both sides: incremental run is clean again.
+        cc.write_text("static const int kNumCounters = 18;\n")
+        (counters / "counters.py").write_text("NUM_COUNTERS = 18\n")
+        assert main(["check", "pbs_tpu", "native",
+                     "--changed", "HEAD"]) == 0
+        capsys.readouterr()
     finally:
         os.chdir(cwd)

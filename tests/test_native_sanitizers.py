@@ -1,0 +1,196 @@
+"""Native runtime under ASan/UBSan — the memmodel passes' dynamic twin.
+
+``pbst check`` proves the seqlock protocol is *spelled* right
+(seqlock-discipline) and the two sides agree on the layout
+(abi-layout-drift); these tests prove the spelled protocol doesn't
+read out of bounds, overflow, or misalign when actually driven. Same
+code, recompiled with ``make -C native asan|ubsan``, loaded through
+the ordinary binding layer via ``PBST_NATIVE_LIB`` in a subprocess —
+nothing else about the stack changes, so a sanitizer report is
+attributable to the runtime, not the harness.
+
+Tier-1 keeps only the smoke (one build + one ledger/trace round per
+flavor, a few seconds); the cross-process seqlock hammer and the full
+fastpath-equivalence rerun ride behind ``slow``.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from conftest import require_native
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FLAVORS = ("asan", "ubsan")
+
+
+def _san_env(flavor: str, lib_path: str) -> dict:
+    """Environment for a subprocess that runs the sanitizer build of
+    the runtime through the normal ctypes bindings."""
+    env = dict(os.environ)
+    env["PBST_NATIVE_LIB"] = lib_path
+    env["JAX_PLATFORMS"] = "cpu"
+    if flavor == "asan":
+        # The interpreter isn't ASan-built, so the runtime must be
+        # first in the link order: preload it. gcc knows where its own
+        # copy lives.
+        gcc = shutil.which("gcc") or shutil.which("g++")
+        if gcc is None:
+            pytest.skip("no gcc to locate libasan.so")
+        probe = subprocess.run(
+            [gcc, "-print-file-name=libasan.so"], capture_output=True,
+            text=True, timeout=30)
+        libasan = probe.stdout.strip()
+        if not os.path.isabs(libasan):
+            pytest.skip("toolchain has no libasan.so")
+        env["LD_PRELOAD"] = libasan
+        # CPython intentionally leaks interned/static allocations;
+        # leak reports would drown the signal (OOB/UAF in the .so).
+        env["ASAN_OPTIONS"] = "detect_leaks=0"
+    return env
+
+
+def _run_py(code: str, env: dict, timeout: int = 120):
+    return subprocess.run(
+        [sys.executable, "-c", textwrap.dedent(code)], cwd=ROOT,
+        env=env, capture_output=True, text=True, timeout=timeout)
+
+
+_SMOKE = """
+    import numpy as np
+    from pbs_tpu.runtime import native
+    lib = native.load()
+    assert lib is not None, native.unavailable_reason()
+    from pbs_tpu.obs.trace import (
+        TRACE_HEADER_WORDS, TRACE_REC_WORDS, Ev, TraceBuffer)
+    from pbs_tpu.telemetry import Counter, Ledger, NUM_COUNTERS
+    from pbs_tpu.telemetry.ledger import SLOT_WORDS
+
+    # ABI getters vs the Python mirrors — the same contract
+    # abi-layout-drift checks statically, asserted against the
+    # sanitizer-instrumented binary actually mapped in this process.
+    assert lib.pbst_ledger_slot_words() == SLOT_WORDS
+    assert lib.pbst_trace_rec_words() == TRACE_REC_WORDS
+    assert lib.pbst_trace_header_words() == TRACE_HEADER_WORDS
+
+    # One full seqlock writer/reader round (ctypes tier: that's the
+    # tier PBST_NATIVE_LIB swaps; fastcall carries its own .so).
+    led = Ledger(4, native="ctypes")
+    led.add(2, Counter.STEPS_RETIRED, 9)
+    d = np.zeros(NUM_COUNTERS, dtype=np.uint64)
+    d[:] = 3
+    led.add_many(2, d)
+    assert int(led.snapshot(2)[Counter.STEPS_RETIRED]) == 12
+
+    # One trace-ring round, overfilling so the drop path runs too.
+    tb = TraceBuffer(64, native="ctypes")
+    for i in range(70):
+        tb.emit(1000 + i, int(Ev.SCHED_PICK), i, 7)
+    recs = tb.consume()
+    assert len(recs) == 64 and tb.lost == 6, (len(recs), tb.lost)
+    print("SMOKE-OK")
+"""
+
+
+@pytest.mark.parametrize("flavor", FLAVORS)
+def test_sanitizer_smoke(flavor):
+    """Build the flavor, load it through the normal bindings in a
+    subprocess, run a ledger+trace round, assert the ABI getters."""
+    lib_path = require_native(flavor)
+    proc = _run_py(_SMOKE, _san_env(flavor, lib_path))
+    assert proc.returncode == 0 and "SMOKE-OK" in proc.stdout, (
+        f"{flavor} smoke failed\n--- stdout ---\n{proc.stdout}\n"
+        f"--- stderr ---\n{proc.stderr}")
+
+
+_HAMMER = """
+    import mmap, os, sys, time
+    import numpy as np
+    from pbs_tpu.telemetry import Counter, Ledger, NUM_COUNTERS
+    from pbs_tpu.telemetry.ledger import SLOT_BYTES
+
+    role, path, iters = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    f = open(path, "r+b")
+    mm = mmap.mmap(f.fileno(), 2 * SLOT_BYTES)
+    led = Ledger(2, buf=mm, native="ctypes")
+    if role == "writer":
+        d = np.zeros(NUM_COUNTERS, dtype=np.uint64)
+        # Invariant: every counter advances in lockstep; a torn read
+        # (seqlock protocol violation) shows up as a spread.
+        d[:] = 1
+        for _ in range(iters):
+            led.add_many(0, d)
+        print("WROTE", iters)
+    else:
+        torn = 0
+        last = 0
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            snap = led.snapshot(0)
+            vals = [int(snap[c]) for c in range(NUM_COUNTERS)]
+            if max(vals) != min(vals):
+                torn += 1
+            last = vals[0]
+            if last >= iters:
+                break
+        assert torn == 0, f"{torn} torn snapshots"
+        assert last >= iters, f"writer never finished ({last}/{iters})"
+        print("READ-OK", last)
+    del led
+    mm.close()
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("flavor", FLAVORS)
+def test_sanitizer_cross_process_hammer(flavor, tmp_path):
+    """Two OS processes, one file-backed ledger, both running the
+    sanitizer build: writer pounds add_many while the reader snapshots
+    and asserts the lockstep invariant — the seqlock retry loop under
+    real concurrency with bounds/UB checking on."""
+    lib_path = require_native(flavor)
+    from pbs_tpu.telemetry.ledger import SLOT_BYTES
+
+    shared = tmp_path / "hammer.led"
+    shared.write_bytes(b"\0" * (2 * SLOT_BYTES))
+    env = _san_env(flavor, lib_path)
+    iters = 20_000
+    script = textwrap.dedent(_HAMMER)
+    reader = subprocess.Popen(
+        [sys.executable, "-c", script, "reader", str(shared), str(iters)],
+        cwd=ROOT, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    writer = subprocess.Popen(
+        [sys.executable, "-c", script, "writer", str(shared), str(iters)],
+        cwd=ROOT, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    w_out, w_err = writer.communicate(timeout=180)
+    r_out, r_err = reader.communicate(timeout=180)
+    assert writer.returncode == 0, f"writer died\n{w_out}\n{w_err}"
+    assert reader.returncode == 0, f"reader died\n{r_out}\n{r_err}"
+    assert "READ-OK" in r_out
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("flavor", FLAVORS)
+def test_sanitizer_fastpath_equivalence(flavor):
+    """Rerun the bit-identical tier-equivalence suite
+    (tests/test_native_fastpath.py) with the ctypes tier backed by the
+    sanitizer build: equivalence must hold AND nothing may trip the
+    sanitizer while it holds."""
+    lib_path = require_native(flavor)
+    env = _san_env(flavor, lib_path)
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         os.path.join("tests", "test_native_fastpath.py")],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=480)
+    assert proc.returncode == 0, (
+        f"{flavor} equivalence rerun failed\n--- stdout ---\n"
+        f"{proc.stdout[-4000:]}\n--- stderr ---\n{proc.stderr[-4000:]}")
