@@ -37,6 +37,7 @@ from pbs_tpu.analysis.memmodel import (
 from pbs_tpu.analysis.netdiscipline import NetDisciplinePass
 from pbs_tpu.analysis.obspass import ObsDisciplinePass
 from pbs_tpu.analysis.perfpass import PerfDisciplinePass
+from pbs_tpu.analysis.procpass import ProcessDisciplinePass
 from pbs_tpu.analysis.rolloutpass import RolloutDisciplinePass
 from pbs_tpu.analysis.scenariopass import ScenarioDisciplinePass
 from pbs_tpu.analysis.schedops import SchedOpsPass
@@ -57,6 +58,7 @@ ALL_PASSES: tuple[type[Pass], ...] = (
     RolloutDisciplinePass,
     ScenarioDisciplinePass,
     DurabilityPass,
+    ProcessDisciplinePass,
     ServeDisciplinePass,
     SeqlockDisciplinePass,
     AbiLayoutDriftPass,

@@ -804,7 +804,9 @@ def _parse_addr(s: str) -> tuple[str, int]:
 def _agent_client(args):
     from pbs_tpu.dist.rpc import RpcClient
 
-    return RpcClient(_parse_addr(args.connect))
+    # deadline_s bounds the whole retry loop: a dead agent fails the
+    # command in bounded time instead of hanging the terminal.
+    return RpcClient(_parse_addr(args.connect), deadline_s=60.0)
 
 
 def cmd_create(args) -> int:
@@ -941,8 +943,8 @@ def cmd_migrate(args) -> int:
     overrides deliberately."""
     from pbs_tpu.dist.rpc import RpcClient
 
-    src = RpcClient(_parse_addr(args.connect))
-    dst = RpcClient(_parse_addr(args.to))
+    src = RpcClient(_parse_addr(args.connect), deadline_s=60.0)
+    dst = RpcClient(_parse_addr(args.to), deadline_s=60.0)
     try:
         saved = src.call("save_job", job=args.job, subject=args.subject)
         try:
@@ -1095,8 +1097,86 @@ def cmd_chaos(args) -> int:
     the WHOLE process state, recovered from the write-ahead intent
     journal alone (docs/DURABILITY.md).
     ``--selfcheck`` runs the scenario twice and requires identical
-    digests. Exit 0 = every invariant held."""
+    digests. ``--processes`` (federation/crash plans) runs members as
+    REAL OS processes (docs/GATEWAY.md "Process mode"): ``--plan
+    crash`` becomes literal SIGKILLs to member pids, each victim
+    recovered from its journal bytes alone under supervision.
+    Exit contract: 0 = every invariant held, 1 = an invariant (or the
+    selfcheck digest match) failed, 2 = usage error."""
     from pbs_tpu.faults import FaultPlan, run_chaos
+
+    if args.processes and args.plan not in ("federation", "crash"):
+        print("pbst: --processes applies to --plan federation/crash",
+              file=sys.stderr)
+        return 2
+    if args.processes:
+        from pbs_tpu.gateway import run_federation_chaos
+        from pbs_tpu.gateway.procfed import stock_process_kill_plan
+
+        if args.selfcheck and args.plan == "crash":
+            # The restart timeline is a host-scheduler fact; only the
+            # DISARMED process run carries a full digest.
+            print("pbst: --selfcheck with --processes needs "
+                  "--plan federation (armed runs are wall-clock "
+                  "nondeterministic)", file=sys.stderr)
+            return 2
+        ticks = args.rounds * 80
+        kw = dict(workload=args.workload, seed=args.seed,
+                  n_gateways=args.gateways, n_tenants=args.tenants,
+                  ticks=ticks, process_mode=True)
+        if args.plan == "crash":
+            # Tick-positioned kills only: a real SIGKILL cannot be
+            # aimed at a byte offset (record cuts stay in-process).
+            kw["crash_plan"] = stock_process_kill_plan(ticks)
+        report = run_federation_chaos(**kw)
+        ok = report["ok"]
+        if args.selfcheck:
+            again = run_federation_chaos(**kw)
+            match = again["digest"] == report["digest"]
+            report["selfcheck"] = {
+                "digest_match": match, "second_ok": again["ok"],
+                "second_digest": again["digest"],
+            }
+            ok = ok and match and again["ok"]
+        if args.json:
+            print(json.dumps(report, indent=1, sort_keys=True))
+        else:
+            st = report["stats"]
+            proc = report["process"]
+            label = ("process crash chaos" if args.plan == "crash"
+                     else "process federation chaos")
+            print(f"{label} workload={report['workload']} "
+                  f"seed={report['seed']} gateways={report['gateways']} "
+                  f"ticks={report['ticks']}")
+            print(f"admitted={st['admitted']} "
+                  f"completed={st['completed']} "
+                  f"handoffs={st['handoffs']} "
+                  f"torn_acks={proc['torn_acks']} shed={st['shed']}")
+            for name, m in proc["members"].items():
+                print(f"  {name:<8} pid={m['pid']:>7} "
+                      f"state={m['state']:<10} "
+                      f"restarts={m['restarts']} "
+                      f"recovered_from_journal="
+                      f"{m['recovered_from_journal']}")
+            for k in proc["kills"]:
+                print(f"  SIGKILL {k['member']} pid={k['pid']} "
+                      f"@ tick {k['tick']}")
+            for r in proc["recoveries"]:
+                print(f"  recovered {r['member']} -> gen "
+                      f"{r['generation']} (recovered {r['recovered']},"
+                      f" requeued {r['requeued_inflight']}, torn "
+                      f"{r['torn_bytes']} B)")
+            for prob in report["problems"]:
+                print(f"  INVARIANT VIOLATED: {prob}")
+            if args.selfcheck:
+                sc = report["selfcheck"]
+                print(f"selfcheck: digest_match={sc['digest_match']} "
+                      f"second_ok={sc['second_ok']}")
+            print(f"arrivals_digest={report['arrivals_digest']}")
+            if "digest" in report:
+                print(f"digest={report['digest']}")
+            print("ok" if ok else "FAILED")
+        return 0 if ok else 1
 
     if args.plan in ("federation", "crash"):
         from pbs_tpu.gateway import run_federation_chaos, stock_crash_plan
@@ -1377,6 +1457,35 @@ def cmd_gateway(args) -> int:
     # demo: the chaos harness with no faults and no backend kill.
     from pbs_tpu.faults import FaultPlan
     from pbs_tpu.gateway import run_gateway_chaos
+
+    if args.processes:
+        from pbs_tpu.gateway.procfed import run_process_chaos
+
+        report = run_process_chaos(
+            workload=args.workload, seed=args.seed,
+            n_gateways=args.gateways,
+            backends_per_gateway=args.backends,
+            n_tenants=args.tenants, ticks=args.ticks)
+        if args.json:
+            print(json.dumps(report, indent=1, sort_keys=True))
+            return 0 if report["ok"] else 1
+        st = report["stats"]
+        proc = report["process"]
+        print(f"process gateway demo workload={report['workload']} "
+              f"seed={report['seed']} gateways={report['gateways']} "
+              f"tenants={report['tenants']} ticks={report['ticks']}")
+        print(f"admitted={st['admitted']} completed={st['completed']} "
+              f"handoffs={st['handoffs']} shed={st['shed']}")
+        for name, m in proc["members"].items():
+            print(f"  {name:<8} pid={m['pid']:>7} "
+                  f"state={m['state']:<10} "
+                  f"restarts={m['restarts']} depth={m['depth']}")
+        for prob in report["problems"]:
+            print(f"  PROBLEM: {prob}")
+        # Fault-free ⇒ disarmed ⇒ the run carries a digest.
+        print(f"digest={report['digest']}")
+        print("ok" if report["ok"] else "FAILED")
+        return 0 if report["ok"] else 1
 
     if args.federated:
         from pbs_tpu.gateway import run_federation_chaos
@@ -2413,6 +2522,11 @@ def main(argv=None) -> int:
                     help="write span artifacts here (gateway/"
                          "federation plans; docs/TRACING.md)")
     sp.add_argument("--no-replication", action="store_true")
+    sp.add_argument("--processes", action="store_true",
+                    help="members as REAL OS processes (federation/"
+                         "crash plans; docs/GATEWAY.md 'Process "
+                         "mode'): --plan crash delivers literal "
+                         "SIGKILLs, recovery from journal bytes alone")
     sp.add_argument("--selfcheck", action="store_true",
                     help="run twice; digests must match")
     sp.add_argument("--json", action="store_true")
@@ -2440,6 +2554,10 @@ def main(argv=None) -> int:
     sp.add_argument("--federated", action="store_true",
                     help="drive the federated tier (gateway/federation"
                          ".py) instead of one gateway")
+    sp.add_argument("--processes", action="store_true",
+                    help="the federated tier with members as REAL OS "
+                         "processes, fault-free (docs/GATEWAY.md "
+                         "'Process mode')")
     sp.add_argument("--gateways", type=int, default=3,
                     help="federation members (with --federated)")
     sp.add_argument("--tenants", type=int, default=4)

@@ -140,12 +140,14 @@ class Controller:
         # keyed by them would reseed every run). Probes never retry: a
         # missed ping must stay a missed ping or dead-host detection
         # stretches by the whole retry budget.
+        # deadline_s bounds the WHOLE retry loop (retries included) —
+        # a flaky agent must shed a control op, not pin the controller.
         h = AgentHandle(name, RpcClient(address, auth_token=self.auth_token,
-                                        fault_key=name),
+                                        fault_key=name, deadline_s=30.0),
                         probe=RpcClient(address, timeout_s=2.0,
                                         auth_token=self.auth_token,
                                         fault_key=f"{name}/probe",
-                                        max_retries=0),
+                                        max_retries=0, deadline_s=2.0),
                         address=(address[0], int(address[1])))
         h.info = h.client.call("info")
         h.observed_ns = self.clock.now_ns()
@@ -740,10 +742,12 @@ class Controller:
                 h = AgentHandle(  # heartbeat/recover() handle the rest
                     name,
                     RpcClient((addr["host"], addr["port"]),
-                              auth_token=ctl.auth_token),
+                              auth_token=ctl.auth_token,
+                              deadline_s=30.0),
                     probe=RpcClient((addr["host"], addr["port"]),
                                     timeout_s=2.0,
-                                    auth_token=ctl.auth_token),
+                                    auth_token=ctl.auth_token,
+                                    deadline_s=2.0),
                     address=(addr["host"], addr["port"]),
                     alive=False, missed=ctl.dead_after_missed)
                 ctl.agents[name] = h

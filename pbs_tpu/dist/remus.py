@@ -54,8 +54,11 @@ class RemusSession:
         # replication channel owns its own deterministic fault stream —
         # a shared default key would interleave consultations across
         # sessions and make seeded chaos traces depend on pump timing.
+        # deadline_s: a wedged peer must fail the epoch (failures+=1,
+        # next period retries) — not pin the replication thread.
         self.client = RpcClient(self.peer_addr, auth_token=auth_token,
-                                fault_key=f"{agent.name}.remus.{job_name}")
+                                fault_key=f"{agent.name}.remus.{job_name}",
+                                deadline_s=30.0)
         self.epochs_committed = 0
         self.failures = 0
         self.skipped = 0

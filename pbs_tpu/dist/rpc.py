@@ -514,7 +514,15 @@ class RpcClient:
                 if deadline is not None and time.monotonic() >= deadline:
                     raise
                 self.retries += 1
-                time.sleep(self._backoff(op, attempt))
+                delay = self._backoff(op, attempt)
+                if deadline is not None:
+                    # Clamp the sleep to the remaining budget: a capped
+                    # backoff larger than what's left would overshoot
+                    # the deadline by up to a whole backoff period —
+                    # the loop must wake AT the deadline and raise, not
+                    # after it (supervisor pumps schedule against this).
+                    delay = min(delay, max(0.0, deadline - time.monotonic()))
+                time.sleep(delay)
 
     def call(self, op: str, _timeout: float | None = None,
              _deadline: float | None = None, **kwargs: Any) -> Any:

@@ -58,6 +58,17 @@ def __getattr__(name: str):
         from pbs_tpu.gateway import recovery
 
         return getattr(recovery, name)
+    # Process mode (docs/GATEWAY.md "Process mode"), lazy because it
+    # drags in multiprocessing + the rpc stack.
+    if name in ("ProcessFederation", "run_process_chaos",
+                "stock_process_kill_plan"):
+        from pbs_tpu.gateway import procfed
+
+        return getattr(procfed, name)
+    if name in ("MemberSupervisor", "ProcessHandle"):
+        from pbs_tpu.gateway import supervisor
+
+        return getattr(supervisor, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
@@ -77,6 +88,9 @@ __all__ = [
     "Lease",
     "LeaseBroker",
     "LeasedBucket",
+    "MemberSupervisor",
+    "ProcessFederation",
+    "ProcessHandle",
     "ProcessKill",
     "Request",
     "SLO_CLASSES",
@@ -91,6 +105,8 @@ __all__ = [
     "recover_gateway",
     "run_federation_chaos",
     "run_gateway_chaos",
+    "run_process_chaos",
     "sched_feedback_sink",
     "stock_crash_plan",
+    "stock_process_kill_plan",
 ]

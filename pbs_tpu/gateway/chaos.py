@@ -400,7 +400,8 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
                          autopilot: "bool | dict | None" = None,
                          arrival_model: ArrivalModel | None = None,
                          crash_plan: list[dict] | None = None,
-                         serve=None) -> dict:
+                         serve=None,
+                         process_mode: bool = False) -> dict:
     """One seeded federated-gateway chaos scenario; returns the report
     dict (``ok`` = every invariant held). Gateway deaths, partitions,
     and lease expiries come from the armed plan; a drain of a seeded
@@ -466,6 +467,37 @@ def run_federation_chaos(workload: str = "mixed", seed: int = 0,
     ``crash_plan`` (recovery rebuilds members from journal bytes; a
     jitted engine cannot be resurrected from them). ``serve=None``
     keeps every golden byte-identical."""
+    if process_mode:
+        # Members as REAL OS processes (docs/GATEWAY.md "Process
+        # mode"): delegate to the procfed harness — ``crash_plan``
+        # tick entries become literal SIGKILLs to member pids.
+        # Record-positioned cuts (``{"record": N}``) are an
+        # in-process-only instrument: a byte-precise tear needs the
+        # harness holding the journal fd, and a real SIGKILL cannot be
+        # aimed at a byte offset. The in-process knob/autopilot/serve
+        # control planes don't cross the process boundary either.
+        if any("tick" not in e for e in (crash_plan or [])):
+            raise ValueError(
+                "process_mode realizes only tick-positioned kills: "
+                "record-positioned torn-write cuts need the "
+                "in-process harness (crash_plan without "
+                "process_mode)")
+        if knob_plan or (autopilot is not None and autopilot is not
+                         False) or serve is not None or plan is not None:
+            raise ValueError(
+                "process_mode is mutually exclusive with plan/"
+                "knob_plan/autopilot/serve: those control planes "
+                "live in the harness process, not in the members")
+        from pbs_tpu.gateway.procfed import run_process_chaos
+
+        return run_process_chaos(
+            workload=workload, seed=seed, n_gateways=n_gateways,
+            n_tenants=n_tenants, ticks=ticks, tick_ns=tick_ns,
+            backends_per_gateway=backends_per_gateway,
+            kill_plan=[{"tick": int(e["tick"]),
+                        **({"member": e["member"]} if "member" in e
+                           else {})}
+                       for e in (crash_plan or [])])
     # Armed on any non-None, non-False value: autopilot={} means "the
     # default-configured loop", not "off" (truthiness would silently
     # disarm it).

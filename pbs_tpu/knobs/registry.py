@@ -55,8 +55,9 @@ _SEC = 1_000_000_000
 UNITS = ("ns", "us", "ms", "s", "", "per_s", "tokens", "records",
          "steps", "flop_per_s", "bytes_per_s")
 
-SUBSYSTEMS = ("sched", "gateway", "telemetry", "obs", "runtime", "dist",
-              "autopilot", "scenarios", "journal", "serve")
+SUBSYSTEMS = ("sched", "gateway", "federation", "telemetry", "obs",
+              "runtime", "dist", "autopilot", "scenarios", "journal",
+              "serve")
 
 
 class KnobError(ValueError):
@@ -352,6 +353,29 @@ _declare("gateway.federation.no_gateway_retry_ns", "int", "ns",
 _declare("gateway.federation.partition_heal_ns", "int", "ns",
          20 * _MS, 1 * _MS, 60 * _SEC,
          doc="default gateway.partition fault duration before heal")
+
+# -- federation.proc (gateway/procfed.py, gateway/supervisor.py):
+# process-mode deployment, where each member is a real OS process.
+# Wall-clock-facing (heartbeats and restarts ride the host scheduler),
+# so floors are generous for a loaded 1-vCPU box.
+_declare("federation.proc.heartbeat_ns", "int", "ns",
+         50 * _MS, 1 * _MS, 60 * _SEC,
+         doc="supervisor heartbeat cadence per member process")
+_declare("federation.proc.miss_budget", "int", "",
+         3, 1, 100,
+         doc="consecutive missed heartbeats before a member is "
+             "declared SUSPECT and restarted")
+_declare("federation.proc.restart_backoff_ns", "int", "ns",
+         100 * _MS, 1 * _MS, 300 * _SEC,
+         doc="base restart backoff; doubles per consecutive restart")
+_declare("federation.proc.max_restarts", "int", "",
+         3, 0, 100,
+         doc="restart budget before a member is drained from the "
+             "ring and its queued work handed off")
+_declare("federation.proc.rpc_deadline_ns", "int", "ns",
+         2 * _SEC, 10 * _MS, 600 * _SEC,
+         doc="whole-call rpc deadline (incl. retries) for every "
+             "parent->member op; timeouts shed with retry-after")
 
 # -- runtime (runtime/doorbell.py, runtime/executor.py)
 _declare("runtime.doorbell.poll_ns", "int", "ns",

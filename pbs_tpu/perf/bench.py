@@ -404,7 +404,7 @@ def _rpc_roundtrip(n: int) -> BenchFns:
 
     srv = RpcServer().start()
     srv.register("echo", lambda x=0: x)
-    cli = RpcClient(srv.address)
+    cli = RpcClient(srv.address, deadline_s=30.0)
     cli.call("echo", x=0)  # connect outside the timed region
 
     def run() -> int:

@@ -402,6 +402,35 @@ class Genome:
             plan.append({"p": p, "times": 2, "after": 20})
         return plan
 
+    def process_kill_plan(self, ticks: int, seed: int) -> "list[dict] | None":
+        """The crash genes realized for PROCESS MODE (docs/GATEWAY.md
+        "Process mode"), where every kill is a literal SIGKILL to a
+        member pid and must be tick-positioned — a real signal cannot
+        be aimed at a byte offset, and the probabilistic ``crash_p``
+        stream has no in-process consult point to ride. So the
+        probabilistic gene is realized HERE, seeded and times-capped
+        (2) like the in-process plan entry it mirrors, into concrete
+        ticks; ``crash_positions`` lands at the same evenly spaced
+        fractions as ``crash_plan``. Both genes zero returns None: a
+        genome that never crashes kills no processes."""
+        p = float(self["crash_p"])
+        k = int(self["crash_positions"])
+        if p == 0 and k == 0:
+            return None
+        plan = [{"tick": ((j + 1) * int(ticks)) // (k + 1)}
+                for j in range(k)]
+        if p > 0:
+            rng = np.random.default_rng(int(seed) * 9176 + 77)
+            fired = 0
+            for t in range(20, int(ticks)):
+                if fired >= 2:
+                    break
+                if rng.random() < p:
+                    plan.append({"tick": t})
+                    fired += 1
+        plan.sort(key=lambda e: e["tick"])
+        return plan or None
+
     def arrival_model(self, tenants, ticks: int, seed: int,
                       n_gateways: int = 3) -> "GenomeArrivals":
         return GenomeArrivals(self, tenants, ticks, seed,

@@ -1,0 +1,35 @@
+"""Seeded process-discipline violations (docs/ANALYSIS.md).
+
+Raw process primitives outside the supervisor, a spawned handle that
+is never reaped, and an RpcClient with no whole-call deadline — the
+three failure shapes the pass exists to catch.
+"""
+
+import os
+import signal
+import subprocess
+
+from pbs_tpu.dist.rpc import RpcClient
+
+
+def restart_member(pid):
+    # BAD: raw signal outside gateway/supervisor.py — the liveness
+    # state machine never records this death; no restart, no drain.
+    os.kill(pid, signal.SIGKILL)
+
+
+def install_handler(fn):
+    # BAD: a handler installed behind the supervisor's back.
+    signal.signal(signal.SIGTERM, fn)
+
+
+def launch_worker(argv):
+    # BAD: spawned handle never joined/waited — zombie on exit, exit
+    # code lost.
+    proc = subprocess.Popen(argv)
+    return proc.pid
+
+
+def dial_member(addr):
+    # BAD: no deadline_s — nothing bounds the whole retry loop.
+    return RpcClient(addr)

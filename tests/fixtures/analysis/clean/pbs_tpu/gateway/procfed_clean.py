@@ -1,0 +1,24 @@
+"""Clean twin of procfed_bad.py: the same jobs done with supervised
+handles and deadlined clients (docs/ANALYSIS.md)."""
+
+from pbs_tpu.dist.rpc import RpcClient
+from pbs_tpu.gateway.supervisor import ProcessHandle
+
+
+def restart_member(handle: ProcessHandle):
+    # Lifecycle through the one module allowed raw primitives.
+    handle.kill9()
+
+
+def launch_worker(target, args):
+    proc = ProcessHandle(target=target, args=args)
+    proc.start()
+    try:
+        return proc.pid
+    finally:
+        proc.reap(timeout_s=5.0)
+
+
+def dial_member(addr, deadline_s):
+    # Whole-call deadline: a flaky member sheds, never hangs a pump.
+    return RpcClient(addr, deadline_s=deadline_s)

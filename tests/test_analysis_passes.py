@@ -57,6 +57,8 @@ def test_all_rules_fire_on_bad_tree():
         "rollout-push", "rollout-set-local",
         "scenario-corpus-golden", "scenario-raw-genome",
         "dur-unjournaled-mutation", "dur-unsealed-read",
+        "proc-raw-kill", "proc-unreaped-spawn",
+        "proc-undeadlined-client",
         "serve-unmatched-rule", "serve-raw-mesh-axis",
         "seqlock-missing-release", "seqlock-plain-store",
         "seqlock-unbalanced", "seqlock-reader-protocol",

@@ -1,0 +1,256 @@
+"""Out-of-process federation: members as REAL OS processes, a literal
+SIGKILL, supervised recovery from journal bytes alone
+(docs/GATEWAY.md "Process mode", docs/FAULTS.md).
+
+Tier-1 carries the N=2 smoke (spawn → submit → SIGKILL → recover →
+lease audit), one test per graceful-degradation path (missed renewal →
+conservative bucket; rpc timeout → shed with retry-after; restart
+exhaustion → drain + handoff), disarmed-run determinism, and the
+report-compatibility pin (an in-process run carries no process
+section, so every PR 15/16 golden stays byte-identical). The full
+workload-catalog soak and the restart storm live behind ``slow``.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from pbs_tpu.gateway.admission import TenantQuota
+from pbs_tpu.gateway.chaos import run_federation_chaos
+from pbs_tpu.gateway.procfed import (
+    ProcessFederation,
+    run_process_chaos,
+    stock_process_kill_plan,
+)
+from pbs_tpu.utils.clock import MS, VirtualClock
+
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnraisableExceptionWarning")
+
+
+# -- the tier-1 smoke --------------------------------------------------------
+
+
+def test_process_mode_sigkill_smoke():
+    """Spawn 2 real member processes, drive load, SIGKILL one, and
+    require the full recovery story: supervised restart, the member
+    rebuilt from its journal bytes alone, no durably-acked job lost,
+    every lease-audit identity intact."""
+    r = run_process_chaos(seed=3, n_gateways=2, n_tenants=3, ticks=120,
+                          kill_plan=stock_process_kill_plan(120))
+    assert r["problems"] == []
+    assert r["ok"] is True
+    assert r["stats"]["admitted"] > 0
+    # The kill really happened, to a real pid.
+    assert len(r["process"]["kills"]) == 1
+    kill = r["process"]["kills"][0]
+    victim = kill["member"]
+    assert isinstance(kill["pid"], int) and kill["pid"] > 1
+    m = r["process"]["members"][victim]
+    # ... and the victim came back FROM ITS JOURNAL, under supervision.
+    assert m["restarts"] == 1
+    assert m["recovered_from_journal"] is True
+    assert m["pid"] != kill["pid"]  # a new process, not a survivor
+    rec = [x for x in r["process"]["recoveries"]
+           if x["member"] == victim]
+    assert rec and rec[0]["generation"] >= 1
+    # Lease-audit identities, spelled out (the harness also gates on
+    # them; this keeps the contract visible if the harness regresses).
+    for tenant, a in r["audit"].items():
+        assert a["granted"] <= a["minted"] + 1e-6, tenant
+        backed = (a["leased_spent"] + a["held"] + a["deposited"]
+                  + a["destroyed"])
+        assert backed <= a["granted"] + 1e-6, tenant
+
+
+def test_disarmed_run_is_deterministic():
+    """No kills ⇒ lockstep virtual time ⇒ the full end-state books
+    digest identically run-to-run (the deterministic leg of the
+    process-mode contract)."""
+    kw = dict(seed=5, n_gateways=2, n_tenants=3, ticks=60)
+    a = run_process_chaos(**kw)
+    b = run_process_chaos(**kw)
+    assert a["ok"] and b["ok"]
+    assert a["digest"] == b["digest"]
+    assert a["audit"] == b["audit"]
+    c = run_process_chaos(**{**kw, "seed": 6})
+    assert c["digest"] != a["digest"]
+
+
+def test_in_process_report_has_no_process_section():
+    """process_mode=False keeps the in-process report shape untouched:
+    no process section, no pids — so every existing golden digest
+    (pinned in test_federation_chaos.py) stays byte-identical."""
+    r = run_federation_chaos(workload="mixed", seed=0, n_gateways=2,
+                             n_tenants=3, ticks=60)
+    assert "process" not in r
+    assert "pid" not in str(sorted(r["stats"]))
+
+
+# -- delegation from the in-process harness ----------------------------------
+
+
+def test_process_mode_delegation_and_refusals():
+    r = run_federation_chaos(seed=5, n_gateways=2, n_tenants=3,
+                             ticks=80, crash_plan=[{"tick": 25}],
+                             process_mode=True)
+    assert r["ok"] and r["harness"] == "procfed"
+    assert [k["tick"] for k in r["process"]["kills"]] == [25]
+    # Record-positioned tears are an in-process instrument.
+    with pytest.raises(ValueError, match="tick-positioned"):
+        run_federation_chaos(process_mode=True,
+                             crash_plan=[{"record": 9}])
+    # The in-process control planes don't cross the boundary.
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        run_federation_chaos(process_mode=True, knob_plan=[{}])
+
+
+# -- graceful degradation paths ----------------------------------------------
+
+
+def _fed(workdir, names, **kw):
+    clock = VirtualClock()
+    kw.setdefault("renew_period_ns", 10 ** 15)  # renewals suppressed
+    kw.setdefault("lease_ttl_ns", 5 * MS)
+    kw.setdefault("heartbeat_ns", 8 * MS)
+    kw.setdefault("service_ns_per_cost", 5 * MS)
+    fed = ProcessFederation(str(workdir), names, clock=clock, seed=11,
+                            **kw)
+    fed.start()
+    return fed, clock
+
+
+def test_missed_renewal_degrades_to_conservative_bucket(tmp_path):
+    """A member whose lease lapses (no renewal arrives) keeps serving
+    from the conservative emergency bucket — spend moves to the
+    conservative odometer instead of stopping or inflating."""
+    fed, clock = _fed(tmp_path, ["gw0"])
+    try:
+        fed.register_tenant("t0", TenantQuota(rate=500.0, burst=4.0,
+                                              slo="interactive"))
+        clock.advance(1 * MS)
+        fed.tick()  # first tick renews (level = capacity = 4)
+        # Let the lease lapse, then keep submitting as time passes:
+        # the emergency bucket starts EMPTY at the first degraded take
+        # and mints scrip only with time spent degraded, so admits
+        # resume at the conservative trickle instead of stopping.
+        for _ in range(10):
+            clock.advance(1 * MS)
+            fed.tick()
+        spent = 0
+        for _ in range(60):
+            r = fed.submit("t0", cost=1, slo="interactive")
+            spent += int(bool(r["admitted"]))
+            clock.advance(1 * MS)
+            fed.tick()
+        assert spent > 4  # more than the prepaid level could back
+        audit = fed.lease_audit()["t0"]
+        assert audit["conservative_spent"] > 0
+        # Scrip is not bank-backed: the identity stays on the leased
+        # side only.
+        backed = (audit["leased_spent"] + audit["held"]
+                  + audit["deposited"] + audit["destroyed"])
+        assert backed <= audit["granted"] + 1e-6
+    finally:
+        fed.stop()
+
+
+def test_rpc_timeout_sheds_with_retry_after(tmp_path):
+    """A submit to an unreachable member sheds with a retry-after hint
+    — the parent pump never hangs on a dead wire."""
+    fed, clock = _fed(tmp_path, ["gw0"])
+    try:
+        fed.register_tenant("t0", TenantQuota(rate=100.0, burst=10.0))
+        # Kill the process OUT FROM UNDER the router: supervision has
+        # not observed the death yet, so the ring still routes to it.
+        fed.links["gw0"].handle.kill9()
+        r = fed.submit("t0", cost=1)
+        assert r["admitted"] is False
+        assert r["reason"] == "rpc-timeout"
+        assert r["retry_after_ns"] == fed.rpc_deadline_ns
+        assert fed.fed_sheds["rpc-timeout"] == 1
+        # With no member reachable at all, the shed is explicit too.
+        fed.sups["gw0"].died(clock.now_ns())
+        r2 = fed.submit("t0", cost=1)
+        assert r2["reason"] in ("no-gateway", "rpc-timeout")
+    finally:
+        fed.stop()
+
+
+def test_restart_exhaustion_drains_and_hands_off(tmp_path):
+    """A member that exhausts max_restarts is FAILED: removed from the
+    ring, its journaled queue handed to survivors, its spend odometers
+    folded into the audit — and nothing durably acked is lost."""
+    fed, clock = _fed(tmp_path, ["gw0", "gw1"], max_restarts=0,
+                      n_slots=1)
+    try:
+        quota = TenantQuota(rate=2000.0, burst=20.0)
+        for t in ("t0", "t1"):
+            fed.register_tenant(t, quota)
+        clock.advance(1 * MS)
+        fed.tick()
+        # Build a queue on every member (slow backends, fast arrivals).
+        for _ in range(8):
+            for t in ("t0", "t1"):
+                fed.submit(t, cost=1)
+        clock.advance(1 * MS)
+        fed.tick()  # seals the journal frames: acks become durable
+        durable = set(fed.durable_rids)
+        assert durable
+        victim = fed.ring.lookup("t0")
+        survivor = [n for n in fed.links if n != victim][0]
+        fed.kill9(victim)
+        clock.advance(1 * MS)
+        fed.tick()  # death observed -> max_restarts=0 -> drain
+        assert victim in fed.failed
+        assert fed.sups[victim].state == "failed"
+        assert fed.ring.nodes() == [survivor]
+        assert fed.handoffs > 0  # queued work adopted by the survivor
+        for _ in range(600):
+            clock.advance(1 * MS)
+            fed.tick()
+            if not fed.busy():
+                break
+        # No durably-acked rid lost across the drain: the survivor
+        # finished the victim's journaled backlog.
+        assert durable <= fed.completed_rids
+        # The victim's books survive in the folded audit.
+        audit = fed.lease_audit()
+        for t in ("t0", "t1"):
+            a = audit[t]
+            assert a["granted"] <= a["minted"] + 1e-6
+            backed = (a["leased_spent"] + a["held"] + a["deposited"]
+                      + a["destroyed"])
+            assert backed <= a["granted"] + 1e-6
+    finally:
+        fed.stop()
+
+
+# -- slow: soak + restart storm ----------------------------------------------
+
+
+@pytest.mark.slow
+def test_process_soak_every_workload():
+    from pbs_tpu.sim.workload import workload_names
+
+    for name in workload_names():
+        r = run_process_chaos(workload=name, seed=2, n_gateways=2,
+                              n_tenants=3, ticks=160,
+                              kill_plan=stock_process_kill_plan(160))
+        assert r["ok"], (name, r["problems"])
+
+
+@pytest.mark.slow
+def test_restart_storm_survives_repeated_sigkills():
+    """Three SIGKILLs of the same member across one run: each recovery
+    starts from the journal the previous generation left, so the
+    generation counter climbs and no durable ack is ever lost."""
+    r = run_process_chaos(seed=9, n_gateways=2, n_tenants=3, ticks=360,
+                          kill_plan=[{"tick": 60}, {"tick": 160},
+                                     {"tick": 260}],
+                          max_restarts=5)
+    assert r["ok"], r["problems"]
+    m = r["process"]["members"]["gw0"]
+    assert m["restarts"] == 3
+    gens = [x["generation"] for x in r["process"]["recoveries"]]
+    assert gens == sorted(gens) and gens[-1] >= 3

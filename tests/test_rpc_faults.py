@@ -160,12 +160,25 @@ def test_retries_bounded_then_raise(server):
 
 
 def test_deadline_bounds_whole_retry_loop(server):
-    _plan("drop_request", times=100)
-    cli = _client(server, max_retries=100)
+    # Root cause of the long-standing failure here: the old plan
+    # seeded exactly 100 drops against max_retries=100, betting that
+    # 100 backoffs (base 1 ms, cap 2 ms, jitter 0.5-1.0x) would
+    # outlast the 0.2 s deadline. They sum to ~0.1-0.15 s, so on any
+    # non-loaded host the loop DRAINED the fault budget before the
+    # deadline and attempt 101 succeeded — DID NOT RAISE. The test was
+    # racing wall-clock sleep totals against its own deadline, not
+    # testing the deadline. Now the fault budget and retry budget are
+    # both effectively infinite, so the ONLY thing that can end the
+    # call is the deadline itself — which is the property under test.
+    _plan("drop_request", times=10 ** 6)
+    cli = _client(server, max_retries=10 ** 6)
     t0 = time.monotonic()
     with pytest.raises((socket.timeout, OSError)):
         cli.call("bump", _deadline=0.2)
-    assert time.monotonic() - t0 < 2.0
+    elapsed = time.monotonic() - t0
+    # The backoff clamp in _call_raw wakes the loop AT the deadline:
+    # generous slack for a loaded CI box, but never a runaway loop.
+    assert 0.2 <= elapsed < 2.0
     cli.close()
 
 

@@ -160,6 +160,26 @@ def test_fault_plan_omits_zero_probability_seams():
     assert points == ["gateway.admit"]
 
 
+def test_process_kill_plan_realizes_crash_genes_tick_positioned():
+    """The process-mode realization of the crash genes: every entry
+    tick-positioned (a real SIGKILL cannot ride a probabilistic
+    consult stream), seeded-deterministic, None when both genes are
+    zero (docs/GATEWAY.md "Process mode")."""
+    g = Genome.from_seed(0)
+    d = g.as_dict()
+    d["genes"] = dict(d["genes"])
+    d["genes"].update({"crash_p": 0.006, "crash_positions": 2})
+    armed = Genome.from_dict(d)
+    a = armed.process_kill_plan(300, seed=4)
+    assert a == armed.process_kill_plan(300, seed=4)
+    assert all(set(e) == {"tick"} for e in a)  # no {"p": ...} entries
+    assert [e["tick"] for e in a] == sorted(e["tick"] for e in a)
+    assert {100, 200} <= {e["tick"] for e in a}  # the positioned kills
+    assert len(a) <= 2 + 2  # probabilistic arm is times-capped at 2
+    d["genes"].update({"crash_p": 0.0, "crash_positions": 0})
+    assert Genome.from_dict(d).process_kill_plan(300, seed=4) is None
+
+
 # -- scoring + gate ----------------------------------------------------------
 
 

@@ -1,0 +1,151 @@
+"""MemberSupervisor state machine under an injected clock: every
+lifecycle path — spawn, heartbeat hygiene, suspect/recover, death with
+exponential backoff, restart-budget exhaustion (drain) — with zero
+processes involved (docs/GATEWAY.md "Process mode")."""
+
+from __future__ import annotations
+
+import pytest
+
+from pbs_tpu.gateway.supervisor import MemberSupervisor, ProcessHandle
+from pbs_tpu.utils.clock import MS, VirtualClock
+
+HB = 10 * MS
+BACKOFF = 20 * MS
+
+
+def _sup(clock, *, miss_budget=3, max_restarts=2):
+    return MemberSupervisor(
+        "gw0", heartbeat_ns=HB, miss_budget=miss_budget,
+        restart_backoff_ns=BACKOFF, max_restarts=max_restarts,
+        now_ns=clock.now_ns())
+
+
+def test_spawn_to_live_and_heartbeat_cadence():
+    clock = VirtualClock()
+    s = _sup(clock)
+    assert s.state == "spawning"
+    assert not s.beat_due(clock.now_ns())  # not live yet
+    s.spawned(1234, clock.now_ns())
+    assert s.state == "live" and s.pid == 1234
+    assert not s.beat_due(clock.now_ns())  # cadence, not a hot loop
+    clock.advance(HB)
+    assert s.beat_due(clock.now_ns())
+    s.beat_ok(clock.now_ns())
+    assert not s.beat_due(clock.now_ns())  # next beat rescheduled
+
+
+def test_miss_budget_live_suspect_dead():
+    clock = VirtualClock()
+    s = _sup(clock, miss_budget=3)
+    s.spawned(1, clock.now_ns())
+    clock.advance(HB)
+    assert s.beat_missed(clock.now_ns()) == "wait"
+    assert s.state == "suspect" and s.misses == 1
+    clock.advance(HB)
+    assert s.beat_missed(clock.now_ns()) == "wait"
+    clock.advance(HB)
+    # The budget is CONSECUTIVE misses: the third spends it.
+    assert s.beat_missed(clock.now_ns()) == "dead"
+
+
+def test_heartbeat_resume_clears_suspect_and_misses():
+    clock = VirtualClock()
+    s = _sup(clock, miss_budget=2)
+    s.spawned(1, clock.now_ns())
+    clock.advance(HB)
+    s.beat_missed(clock.now_ns())
+    assert s.state == "suspect"
+    clock.advance(HB)
+    s.beat_ok(clock.now_ns())
+    assert s.state == "live" and s.misses == 0
+    # A later miss starts the budget from zero again.
+    clock.advance(HB)
+    assert s.beat_missed(clock.now_ns()) == "wait"
+
+
+def test_death_schedules_exponential_backoff():
+    clock = VirtualClock()
+    s = _sup(clock, max_restarts=3)
+    s.spawned(1, clock.now_ns())
+    assert s.died(clock.now_ns()) == "backoff"
+    assert s.state == "restarting" and s.pid is None
+    assert s.restart_due_ns == clock.now_ns() + BACKOFF
+    assert not s.restart_due(clock.now_ns())
+    clock.advance(BACKOFF)
+    assert s.restart_due(clock.now_ns())
+    s.spawned(2, clock.now_ns())
+    assert s.state == "live" and s.restarts == 1
+    # Second death: the backoff doubles.
+    assert s.died(clock.now_ns()) == "backoff"
+    assert s.restart_due_ns == clock.now_ns() + 2 * BACKOFF
+
+
+def test_restart_budget_exhaustion_is_drain():
+    clock = VirtualClock()
+    s = _sup(clock, max_restarts=1)
+    s.spawned(1, clock.now_ns())
+    assert s.died(clock.now_ns()) == "backoff"
+    clock.advance(BACKOFF)
+    s.spawned(2, clock.now_ns())
+    assert s.died(clock.now_ns()) == "drain"
+    assert s.state == "failed"
+    # A failed member never schedules another restart or beat.
+    clock.advance(100 * BACKOFF)
+    assert not s.restart_due(clock.now_ns())
+    assert not s.beat_due(clock.now_ns())
+
+
+def test_max_restarts_zero_drains_on_first_death():
+    clock = VirtualClock()
+    s = _sup(clock, max_restarts=0)
+    s.spawned(1, clock.now_ns())
+    assert s.died(clock.now_ns()) == "drain"
+    assert s.state == "failed" and s.restarts == 0
+
+
+def test_transitions_record_the_whole_lifecycle():
+    clock = VirtualClock()
+    s = _sup(clock, miss_budget=1, max_restarts=1)
+    s.spawned(7, clock.now_ns())
+    clock.advance(HB)
+    assert s.beat_missed(clock.now_ns()) == "dead"
+    s.died(clock.now_ns())
+    clock.advance(BACKOFF)
+    s.spawned(8, clock.now_ns())
+    s.died(clock.now_ns())
+    assert [(a, b) for _ts, a, b, _r in s.transitions] == [
+        ("spawning", "live"), ("live", "suspect"),
+        ("suspect", "restarting"), ("restarting", "live"),
+        ("live", "failed")]
+
+
+def test_guards():
+    clock = VirtualClock()
+    with pytest.raises(ValueError):
+        _sup(clock, miss_budget=0)
+    s = _sup(clock)
+    s.spawned(1, clock.now_ns())
+    with pytest.raises(ValueError):
+        s.spawned(2, clock.now_ns())  # spawned() only from down states
+
+
+def _child_sleep_forever():
+    import time
+
+    while True:
+        time.sleep(60)
+
+
+def test_process_handle_kill9_and_reap_idempotent():
+    h = ProcessHandle(target=_child_sleep_forever)
+    h.start()
+    assert h.alive() and h.pid is not None
+    h.kill9()
+    assert not h.alive()
+    # SIGKILL shows as a negative signal exit; reap is idempotent and
+    # the handle stays safe to query after close.
+    assert h.reap() == -9
+    assert h.reap() == -9
+    assert h.pid is None
+    h.kill9()  # idempotent on a dead handle
