@@ -27,6 +27,7 @@ from pbs_tpu.analysis.core import (
 from pbs_tpu.analysis.counterapi import CounterApiPass
 from pbs_tpu.analysis.durabilitypass import DurabilityPass
 from pbs_tpu.analysis.gatewaypass import GatewayDisciplinePass
+from pbs_tpu.analysis.hwpass import HwDisciplinePass
 from pbs_tpu.analysis.knobspass import KnobDisciplinePass
 from pbs_tpu.analysis.locks import LockDisciplinePass
 from pbs_tpu.analysis.memmodel import (
@@ -62,6 +63,7 @@ ALL_PASSES: tuple[type[Pass], ...] = (
     ServeDisciplinePass,
     SeqlockDisciplinePass,
     AbiLayoutDriftPass,
+    HwDisciplinePass,
     DeterminismDisciplinePass,
 )
 

@@ -78,10 +78,32 @@ HDR = (
 )
 
 
+def _fmt_source(meta: dict) -> str:
+    """Counter-source provenance line (docs/HWTELEM.md): which ladder
+    tier feeds these numbers — and WHY the better tiers aren't — so
+    sim-sourced numbers are never passed off as live (the PR 9
+    silent-native-build rule). Empty for pre-hwtelem sidecars."""
+    src = meta.get("source")
+    if not isinstance(src, dict):
+        return ""
+    tier = src.get("tier", "?")
+    if tier is None or src.get("available") is False:
+        reason = src.get("reason") or "unavailable"
+        return f"counters=none (UNAVAILABLE: {reason})"
+    degraded = src.get("degraded") or {}
+    if degraded:
+        why = "; ".join(f"{ev}: {r}" for ev, r in sorted(degraded.items()))
+        return f"counters={tier} (degraded — {why})"
+    return f"counters={tier}"
+
+
 def cmd_dump(args) -> int:
     led = _ledger(args)
     meta = _load_meta(args.ledger)
     print(f"partition={meta['partition']} scheduler={meta['scheduler']}")
+    src_line = _fmt_source(meta)
+    if src_line:
+        print(src_line)
     print(HDR)
     rows = sorted(meta["slots"].items(), key=lambda kv: int(kv[0]))
     snaps = led.snapshot_many([int(s) for s, _ in rows])
@@ -109,6 +131,9 @@ def cmd_top(args) -> int:
             print(f"pbst top — partition={meta['partition']} "
                   f"scheduler={meta['scheduler']} "
                   f"({time.strftime('%H:%M:%S')})")
+            src_line = _fmt_source(meta)
+            if src_line:
+                print(src_line)
             print(HDR)
             print("\n".join(rows))
             time.sleep(args.interval)
@@ -1427,6 +1452,9 @@ def cmd_gateway(args) -> int:
             from pbs_tpu.obs.spans import LatencyHistograms
 
             hist = LatencyHistograms.attach(args.ledger + ".hist")
+        src_line = _fmt_source(_load_meta(args.ledger))
+        if src_line:
+            print(src_line)
         tail_hdr = (
             f"{'qdelay_p50_ms':>14} {'qdelay_p99_ms':>14} "
             f"{'e2e_p99_ms':>11}" if hist is not None else
@@ -1590,6 +1618,23 @@ def cmd_autopilot(args) -> int:
 
         report = run_autopilot_demo(seed=args.seed, ticks=args.ticks,
                                     pathological=args.pathological)
+        if args.fidelity or args.fidelity_window:
+            # The sim-vs-real leg (docs/HWTELEM.md): additive key —
+            # runs without --fidelity carry no trace of it, so the
+            # demo report shape (and anything pinned on it) is
+            # untouched.
+            from pbs_tpu.hwtelem import (
+                CounterWindow,
+                fidelity_report,
+                record_serving_window,
+                render_report,
+            )
+
+            if args.fidelity_window:
+                fw = CounterWindow.load(args.fidelity_window)
+            else:
+                fw, _frep = record_serving_window(seed=args.seed)
+            report["fidelity"] = fidelity_report(fw, seed=args.seed)
         if args.out:
             with open(args.out, "w") as f:
                 json.dump(report, f, indent=1, sort_keys=True)
@@ -1610,6 +1655,8 @@ def cmd_autopilot(args) -> int:
             print(f"admitted={s['admitted']} "
                   f"completed={s['completed']} "
                   f"drained={s['drained']}")
+            if "fidelity" in report:
+                print(render_report(report["fidelity"]))
         ok = report["stats"]["drained"] and \
             report["status"]["state"] == "done"
         return 0 if ok else 1
@@ -2128,11 +2175,17 @@ def cmd_serve(args) -> int:
         backend = ShardedServeBackend(
             "serve0", cfg, n_slots=args.slots, prompt_bucket=8,
             max_len=32, seed=args.seed)
+    hw_source = None
+    if args.hw:
+        from pbs_tpu.hwtelem import HwCounterSource
+
+        hw_source = HwCounterSource(probe=True)
     gw = Gateway(
         [backend],
         quotas={"demo": TenantQuota(rate=1000.0, burst=256.0,
                                     slo="interactive",
-                                    max_queued=max(64, args.requests))})
+                                    max_queued=max(64, args.requests))},
+        hw_source=hw_source)
     shed = 0
     for i in range(args.requests):
         # No prompt on purpose: the backend synthesizes one from the
@@ -2157,6 +2210,145 @@ def serve_entry() -> None:
     """Console entry ``pbst-serve`` (CI convenience: exactly
     ``pbst serve ...`` without the subcommand word)."""
     sys.exit(main(["serve", *sys.argv[1:]]))
+
+
+def cmd_hw(args) -> int:
+    """The live hardware-counter plane (docs/HWTELEM.md).
+
+    - ``pbst hw probe`` — walk the degradation ladder and print each
+      tier with its cached ``unavailable_reason()`` and per-event
+      degradation; exit 1 if NO tier works.
+    - ``pbst hw record --out F`` — drive the seeded gateway serving
+      pump while sampling the live ladder; write the window JSONL.
+    - ``pbst hw replay W...`` — feed each recorded window through two
+      fresh ``ReplaySource`` cursors; ``--check`` additionally demands
+      the file bytes equal the canonical re-encoding and exits 1 on
+      ANY drift (the tier-1 smoke, like ``pbst tune --check``).
+    - ``pbst hw fidelity`` — sim-predicted vs window-measured per-axis
+      report (``--window F`` scores a recorded window reproducibly;
+      without it a live window is recorded first). ``--strict`` exits
+      1 when the margin is negative.
+    - ``pbst hw report F`` — render a written fidelity report JSON.
+    """
+    from pbs_tpu.hwtelem import (
+        CounterWindow,
+        ReplaySource,
+        fidelity_report,
+        probe_report,
+        record_serving_window,
+        render_report,
+    )
+
+    if args.action == "probe":
+        rep = probe_report()
+        if args.json:
+            print(json.dumps(rep, indent=1, sort_keys=True))
+        else:
+            print(f"declared events: {', '.join(rep['declared_events'])}")
+            for t in rep["tiers"]:
+                mark = "*" if t["tier"] == rep["active"] else " "
+                if t["available"]:
+                    evs = ", ".join(t["events"]) or "-"
+                    print(f" {mark}{t['tier']:<11} available  "
+                          f"events: {evs}")
+                    for ev, why in sorted((t.get("degraded")
+                                           or {}).items()):
+                        print(f"   {'':<11} {ev}: {why}")
+                else:
+                    print(f" {mark}{t['tier']:<11} UNAVAILABLE: "
+                          f"{t['reason']}")
+            print(f"active tier: {rep['active'] or 'none'}")
+        return 0 if rep["active"] else 1
+
+    if args.action == "record":
+        window, rep = record_serving_window(
+            seed=args.seed, ticks=args.ticks)
+        window.save(args.out)
+        out = {**rep, "out": args.out, "digest": window.digest(),
+               "span_ns": window.span_ns()}
+        if args.json:
+            print(json.dumps(out, indent=1, sort_keys=True))
+        else:
+            print(f"recorded {out['samples']} samples "
+                  f"(tier={out['tier']}, "
+                  f"span={window.span_ns() / 1e6:.1f}ms) -> {args.out}")
+            print(f"digest {out['digest']}")
+        return 0
+
+    if args.action == "replay":
+        if not args.paths:
+            print("pbst: hw replay needs window file(s)",
+                  file=sys.stderr)
+            return 2
+        failures = []
+        for path in args.paths:
+            try:
+                w = CounterWindow.load(path)
+            except (OSError, ValueError) as e:
+                failures.append(f"{path}: unloadable: {e}")
+                continue
+            n = args.samples or max(1, 2 * len(w.samples))
+            d1 = ReplaySource(w).stream_digest(n)
+            d2 = ReplaySource(w).stream_digest(n)
+            status = "ok"
+            if d1 != d2:
+                failures.append(f"{path}: replay digest drift "
+                                f"{d1[:16]} != {d2[:16]}")
+                status = "DRIFT"
+            if args.check:
+                with open(path, "rb") as f:
+                    raw = f.read()
+                canon = ("\n".join(w.lines()) + "\n").encode()
+                if raw != canon:
+                    failures.append(
+                        f"{path}: file bytes are not the canonical "
+                        f"encoding of their own window")
+                    status = "DRIFT"
+            print(f"{path}: window={w.digest()[:16]} "
+                  f"stream={d1[:16]} x{n} [{status}]")
+        for msg in failures:
+            print(f"pbst: {msg}", file=sys.stderr)
+        return 1 if failures else 0
+
+    if args.action == "fidelity":
+        if args.window:
+            w = CounterWindow.load(args.window)
+        else:
+            w, _rep = record_serving_window(seed=args.seed,
+                                            ticks=args.ticks)
+        rep = fidelity_report(w, seed=args.seed)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(rep, f, indent=1, sort_keys=True)
+                f.write("\n")
+        if args.json:
+            print(json.dumps(rep, indent=1, sort_keys=True))
+        else:
+            print(render_report(rep))
+        return (0 if rep["ok"] else 1) if args.strict else 0
+
+    if args.action == "report":
+        if not args.paths:
+            print("pbst: hw report needs a fidelity JSON file",
+                  file=sys.stderr)
+            return 2
+        try:
+            with open(args.paths[0]) as f:
+                rep = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"pbst: bad report {args.paths[0]!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        print(render_report(rep))
+        return 0
+
+    print(f"pbst: unknown hw action {args.action!r}", file=sys.stderr)
+    return 2
+
+
+def hw_entry() -> None:
+    """Console entry ``pbst-hw``."""
+    sys.exit(main(["hw", *sys.argv[1:]]))
 
 
 def main(argv=None) -> int:
@@ -2198,6 +2390,10 @@ def main(argv=None) -> int:
     sp.add_argument("--disagg", action="store_true",
                     help="demo the prefill/decode disaggregated "
                          "backend instead of the single-pool one")
+    sp.add_argument("--hw", action="store_true",
+                    help="demo: arm the live hardware-counter plane "
+                         "on the gateway (stats gain the active tier "
+                         "+ sampled totals; docs/HWTELEM.md)")
     sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser(
@@ -2378,6 +2574,16 @@ def main(argv=None) -> int:
                          "candidate (demonstrates guarded rollback)")
     sp.add_argument("--seed", type=int, default=0)
     sp.add_argument("--ticks", type=int, default=260)
+    sp.add_argument("--fidelity", action="store_true",
+                    help="run --demo: append the sim-vs-real fidelity "
+                         "leg (docs/HWTELEM.md) — records a live "
+                         "counter window on the serving pump unless "
+                         "--fidelity-window is given")
+    sp.add_argument("--fidelity-window", metavar="FILE",
+                    dest="fidelity_window",
+                    help="score this recorded window instead of "
+                         "sampling live (deterministic; the smoke "
+                         "path)")
     sp.add_argument("--out", metavar="FILE",
                     help="run: also write the report JSON here")
     sp.add_argument("--state", metavar="FILE",
@@ -2570,6 +2776,36 @@ def main(argv=None) -> int:
                          "spans / pbst slo report (docs/TRACING.md)")
     sp.add_argument("--json", action="store_true")
     sp.set_defaults(fn=cmd_gateway)
+
+    sp = sub.add_parser(
+        "hw", help="live hardware-counter plane: probe the ladder, "
+                   "record/replay counter windows, score sim-vs-real "
+                   "fidelity (docs/HWTELEM.md)")
+    sp.add_argument("action",
+                    choices=["probe", "record", "replay", "fidelity",
+                             "report"])
+    sp.add_argument("paths", nargs="*",
+                    help="replay: window JSONL file(s); report: a "
+                         "fidelity JSON file")
+    sp.add_argument("--out", default="hw_window.jsonl",
+                    help="record: window destination; fidelity: also "
+                         "write the report JSON here")
+    sp.add_argument("--window", metavar="FILE",
+                    help="fidelity: score this recorded window "
+                         "(reproducible) instead of recording live")
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--ticks", type=int, default=200,
+                    help="record/fidelity: serving-pump rounds")
+    sp.add_argument("--samples", type=int, default=0,
+                    help="replay: digest stream length (0 = 2x the "
+                         "window)")
+    sp.add_argument("--check", action="store_true",
+                    help="replay: demand canonical file bytes + "
+                         "byte-identical re-replay (the CI smoke)")
+    sp.add_argument("--strict", action="store_true",
+                    help="fidelity: exit 1 when margin < 0")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_hw)
 
     sp = sub.add_parser(
         "tune", help="simulation-driven policy autotuning (docs/TUNE.md)")

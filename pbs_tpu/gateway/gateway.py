@@ -105,6 +105,7 @@ class Gateway:
         spans: SpanRecorder | None = None,
         hist_slots: int = 256,
         journal=None,
+        hw_source=None,
     ):
         if not backends:
             raise ValueError("gateway needs at least one backend")
@@ -127,6 +128,14 @@ class Gateway:
         #: Initialized before tenant registration: register_tenant
         #: describes each tenant contract to an attached recorder.
         self.shadow = None
+        #: Live hardware-counter plane (pbs_tpu/hwtelem, docs/HWTELEM.md):
+        #: when attached, each ``tick()`` samples the real ladder —
+        #: observer-only, like the shadow recorder: the sample touches
+        #: no admission/dispatch decision and no RNG, so arming it
+        #: moves no digest. None = zero cost.
+        self.hw = None
+        self.hw_recorder = None
+        self._hw_totals: dict[str, int] = {}
         #: Write-ahead intent journal (gateway/journal.py,
         #: docs/DURABILITY.md): when attached, every ADMIT/DISPATCH/
         #: COMPLETE/SHED/REQUEUE intent is journaled BEFORE the
@@ -213,6 +222,8 @@ class Gateway:
         self._fb_events = {cls: 0 for cls in SLO_CLASSES}
         if journal is not None:
             self.attach_journal(journal)
+        if hw_source is not None:
+            self.attach_hw(hw_source)
         # Bookkeeping.
         self._rids = itertools.count()
         self._tenant_slot: dict[str, int] = {}  # stable ints for trace
@@ -288,6 +299,36 @@ class Gateway:
         self.shadow = recorder
         for tenant, quota in sorted(self.admission.quotas.items()):
             recorder.note_tenant(tenant, quota)
+
+    # -- hardware-counter plane (docs/HWTELEM.md) ------------------------
+
+    def attach_hw(self, source, recorder=None) -> None:
+        """Arm the live hardware-counter plane: each subsequent
+        ``tick()`` samples ``source`` (an ``hwtelem.HwCounterSource``)
+        and accumulates per-event totals for ``stats()``; with a
+        ``recorder`` (``hwtelem.HwRecorder``) every sample also lands
+        in its bounded ring for window capture. Observer-only — the
+        pump's decisions never read the sample, so arming this on a
+        virtual-time run leaves every digest byte-identical. The
+        ledger meta sidecar is rewritten so ``pbst gateway stats``
+        names the active tier instead of passing sim numbers off as
+        live (the PR 9 silent-native-build rule)."""
+        self.hw = source
+        self.hw_recorder = recorder
+        self._hw_totals = {}
+        source.sample()  # prime the delta baseline at attach
+        if self._ledger_path is not None:
+            self._write_ledger_meta()
+
+    def _hw_sample(self) -> None:
+        if self.hw is None:
+            return
+        deltas = self.hw.sample()
+        for ev, v in deltas.items():
+            if v:
+                self._hw_totals[ev] = self._hw_totals.get(ev, 0) + int(v)
+        if self.hw_recorder is not None:
+            self.hw_recorder.sample(self.hw.clock.now_ns(), deltas)
 
     # -- member knob adoption (docs/AUTOPILOT.md "Canary") ---------------
 
@@ -510,6 +551,7 @@ class Gateway:
             # the unacked suffix), never a committed intent without
             # its span (docs/DURABILITY.md "Crash windows").
             self._journal.commit()
+        self._hw_sample()
         return done
 
     def flush_trace(self) -> None:
@@ -804,6 +846,10 @@ class Gateway:
                 for cls, slot in GW_LEDGER_SLOTS.items()
             },
         }
+        if self.hw is not None:
+            # Counter-source provenance (docs/HWTELEM.md): external
+            # monitors must see which ladder tier (if any) is live.
+            meta["source"] = self.hw.describe()
         tmp = self._ledger_path + ".meta.json.tmp"
         with open(tmp, "w") as f:
             json.dump(meta, f, indent=1)
@@ -835,7 +881,7 @@ class Gateway:
         denom = self.admitted + shed_total
         bypass = sum(getattr(b, "bypass_submits", 0)
                      for b in self.backends)
-        return {
+        out = {
             "name": self.name,
             "admitted": self.admitted,
             "completed": self.completed,
@@ -856,3 +902,12 @@ class Gateway:
                 for b in self.backends
             },
         }
+        if self.hw is not None:
+            # Additive: unarmed gateways never carry the key, so the
+            # stats shape (and every golden over it) is untouched.
+            out["hw"] = {**self.hw.describe(),
+                         "totals": dict(sorted(self._hw_totals.items())),
+                         "recorded": (self.hw_recorder.recorded
+                                      if self.hw_recorder is not None
+                                      else 0)}
+        return out

@@ -1,0 +1,39 @@
+"""pbs_tpu.hwtelem — the live hardware-counter plane.
+
+Real kernel counter sources (perf_event → cgroup → rusage degradation
+ladder) behind the ``TelemetrySource`` protocol, recorded-window
+capture/replay, and sim-vs-real fidelity scoring. jax-free; see
+docs/HWTELEM.md.
+"""
+
+from pbs_tpu.hwtelem.fidelity import (
+    fidelity_report,
+    record_serving_window,
+    render_report,
+)
+from pbs_tpu.hwtelem.sources import (
+    DECLARED_EVENTS,
+    HwCounterSource,
+    ladder,
+    pick_tier,
+    probe_report,
+)
+from pbs_tpu.hwtelem.window import (
+    CounterWindow,
+    HwRecorder,
+    ReplaySource,
+)
+
+__all__ = [
+    "DECLARED_EVENTS",
+    "CounterWindow",
+    "HwCounterSource",
+    "HwRecorder",
+    "ReplaySource",
+    "fidelity_report",
+    "ladder",
+    "pick_tier",
+    "probe_report",
+    "record_serving_window",
+    "render_report",
+]

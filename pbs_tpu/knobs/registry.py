@@ -57,7 +57,7 @@ UNITS = ("ns", "us", "ms", "s", "", "per_s", "tokens", "records",
 
 SUBSYSTEMS = ("sched", "gateway", "federation", "telemetry", "obs",
               "runtime", "dist", "autopilot", "scenarios", "journal",
-              "serve")
+              "serve", "hwtelem")
 
 
 class KnobError(ValueError):
@@ -539,6 +539,31 @@ _declare("telemetry.source.peak_flops", "float", "flop_per_s",
          197e12, 1e9, 1e18, doc="bf16 peak FLOP/s of the modeled chip")
 _declare("telemetry.source.peak_hbm_bw", "float", "bytes_per_s",
          819e9, 1e6, 1e15, doc="peak HBM bandwidth of the modeled chip")
+
+# -- hwtelem live counter plane (pbs_tpu/hwtelem; docs/HWTELEM.md)
+_declare("hwtelem.sample_period_ns", "int", "ns",
+         10 * _MS, 100 * _US, 10 * _SEC,
+         doc="nominal ladder sampling period for live recorders (the "
+             "gateway hw pump and `pbst hw record` tick at this "
+             "cadence; recorded windows carry the value they were "
+             "driven at)")
+_declare("hwtelem.window_len", "int", "records",
+         4096, 16, 1 << 20,
+         doc="HwRecorder ring capacity in samples: a long-lived "
+             "recorder overwrites its oldest capture past this "
+             "(dropped is counted, the shadow-ring retention rule)")
+_declare("hwtelem.stale_threshold", "int", "",
+         3, 1, 100,
+         doc="consecutive dead hw samples (progress without device "
+             "time) a FeedbackPolicy.from_source policy tolerates "
+             "before parking the tslice at its fallback — the "
+             "stale_after the live-counter path runs with")
+_declare("hwtelem.fidelity_margin_floor", "float", "",
+         0.25, 0.0, 1.0,
+         doc="max tolerated per-axis relative error between the sim "
+             "prediction and the live measurement before the "
+             "fidelity report (docs/HWTELEM.md) fails; margin = "
+             "floor - worst axis error")
 
 
 # ---------------------------------------------------------------------------

@@ -399,6 +399,30 @@ def _gateway_pump(n: int) -> BenchFns:
     return run, reset, teardown
 
 
+def _hwtelem_sample(n: int) -> BenchFns:
+    """One live counter-ladder sample (HwCounterSource.sample): the
+    marginal cost every gateway tick pays once ``--hw`` is armed.
+    Times whatever tier the box grants — perf_event read(2) per event
+    on the reference container, getrusage at the ladder floor, the
+    empty-dict fast path when no tier probes — so the gate pins the
+    sampling seam, not one kernel interface."""
+    from pbs_tpu.hwtelem.sources import HwCounterSource
+
+    src = HwCounterSource(probe=True)
+    src.sample()  # prime the delta baseline outside the timed region
+
+    def run() -> int:
+        sample = src.sample
+        for _ in range(n):
+            sample()
+        return n
+
+    def teardown() -> None:
+        src.close()
+
+    return run, lambda: None, teardown
+
+
 def _rpc_roundtrip(n: int) -> BenchFns:
     from pbs_tpu.dist.rpc import RpcClient, RpcServer
 
@@ -443,6 +467,9 @@ BENCHES: dict[str, tuple[Callable[..., BenchFns], int, int]] = {
     # sim.sustained is wall-ns per simulated-ns (lower = faster sim).
     "sim.sustained": (_sim_sustained, 2_000, 250),
     "sweep.cell": (_sweep_cell, 24, 6),
+    # ops = ladder samples; syscall-bound (one read(2) per armed
+    # event) so the per-op cost tracks the kernel, not this code.
+    "hwtelem.sample": (_hwtelem_sample, 20_000, 2_000),
     "rpc.roundtrip": (_rpc_roundtrip, 300, 50),
 }
 
@@ -473,6 +500,9 @@ NATIVE_BENCHES = (
 #: benches keep the tight default.
 CHECK_THRESHOLDS: dict[str, float] = {
     "rpc.roundtrip": 4.0,
+    # Pure syscall round-trips: on a 1-vCPU container the kernel-side
+    # cost swings with host load the same way the socket benches do.
+    "hwtelem.sample": 4.0,
     # File I/O (page-cache writes) + whole-stack pump: wall-clock-
     # bound like the sim benches, same 3x host-variance armor.
     "journal.append": 3.0,

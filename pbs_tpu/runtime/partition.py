@@ -437,6 +437,12 @@ class Partition:
             "scheduler": self.scheduler.name,
             "trace_dir": self._trace_dir,
             "n_rings": len(self.traces),
+            # Counter-source provenance (docs/HWTELEM.md): sources
+            # that can say what they are (hwtelem ladder tiers) do, so
+            # `pbst top` never reports sim-sourced numbers as live.
+            "source": (self.source.describe()
+                       if hasattr(self.source, "describe") else
+                       {"tier": type(self.source).__name__}),
             "slots": {
                 str(ctx.ledger_slot): {
                     "ctx": ctx.name,

@@ -160,6 +160,12 @@ class FeedbackPolicy:
 
             fallback_us = SchedParams().tslice_us
         self.fallback_us = self._clamp(int(fallback_us))
+        #: Live hardware-counter provenance (docs/HWTELEM.md): set by
+        #: :meth:`from_source` so observability surfaces (pbst top) can
+        #: name the ladder tier feeding this policy. Never read on the
+        #: steering path — counters arrive through the partition's
+        #: TelemetrySource like any other backend.
+        self.hw_source = None
         self.states: dict[str, JobMetricState] = {}
         now = partition.clock.now_ns()
         self.timer = partition.timers.arm(
@@ -205,6 +211,33 @@ class FeedbackPolicy:
         return cls(partition,
                    **knob_profile.knobs_to_params(cls.KNOB_POLICY,
                                                   values))
+
+    @classmethod
+    def from_source(cls, partition: "Partition", source,
+                    **params) -> "FeedbackPolicy":
+        """Build a policy for a partition fed by a LIVE hwtelem counter
+        source (docs/HWTELEM.md). Identical steering to the plain
+        constructor — real counters flow through the same
+        ``TelemetrySource`` protocol — but ``stale_after`` defaults
+        from the ``hwtelem.stale_threshold`` knob (real ladders go
+        quiet in ways the sim never does: a cgroup controller unmounts,
+        perf fds die on cgroup migration), and the source is stashed
+        for provenance so monitors can name the active tier. Raises if
+        ``source`` is not the partition's telemetry source or the seam
+        it wraps — a policy steering on counters from a DIFFERENT
+        source than the one it reports would be the exact silent-sim
+        confusion this plane exists to kill."""
+        inner = getattr(partition.source, "inner", None)
+        if partition.source is not source and inner is not source \
+                and getattr(source, "inner", None) is not partition.source:
+            raise ValueError(
+                f"source {type(source).__name__} is not partition "
+                f"{partition.name!r}'s telemetry source (nor wraps it)")
+        params.setdefault("stale_after",
+                          int(knobs.get("hwtelem.stale_threshold")))
+        policy = cls(partition, **params)
+        policy.hw_source = source
+        return policy
 
     def apply_knobs(self, values: dict) -> dict:
         """Atomic live reconfiguration from a knob push (KnobWatcher

@@ -1,0 +1,15 @@
+"""Seeded hw-wallclock violation: a live clock read in an hwtelem
+module that declares no REAL_CLOCK_SEAM."""
+
+import time
+from time import monotonic_ns
+
+
+def stamp_sample(deltas):
+    """Wall-clock stamps make the recorded window unreplayable."""
+    return (time.monotonic_ns(), deltas)
+
+
+def stamp_sample_aliased(deltas):
+    """Same read through a from-import alias."""
+    return (monotonic_ns(), deltas)

@@ -1,0 +1,35 @@
+"""Seeded hw-discipline violations (hw-raw-syscall,
+hw-unguarded-probe) outside the ladder's own module."""
+
+import ctypes
+
+from pbs_tpu.hwtelem.sources import pick_tier
+
+
+def open_cycles_directly():
+    """A second owner of the perf ABI: raw perf_event_open syscall
+    outside hwtelem/sources.py."""
+    libc = ctypes.CDLL(None, use_errno=True)
+    attr = b"\x00" * 128
+    return libc.syscall(298, attr, 0, -1, -1, 0)
+
+
+def sample_without_guard():
+    """pick_tier() bound and consumed with no None branch."""
+    tier = pick_tier()
+    return tier.read()
+
+
+def totals_off_the_call():
+    """Attribute ridden directly off the probe result."""
+    return pick_tier().events()
+
+
+class UnguardedSampler:
+    """pick_tier() stashed on self with no None branch in the class."""
+
+    def __init__(self):
+        self.tier = pick_tier()
+
+    def read(self):
+        return self.tier.read()

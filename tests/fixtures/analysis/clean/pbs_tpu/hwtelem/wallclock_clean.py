@@ -1,0 +1,14 @@
+"""Behavior twin of wallclock_bad.py: the live sampling edge is
+DECLARED, so the clock read is a sanctioned seam."""
+
+import time
+
+REAL_CLOCK_SEAM = (
+    "this module is the declared live sampling edge: samples are "
+    "stamped with monotonic time at capture; replay runs off the "
+    "recorded window, never this clock"
+)
+
+
+def stamp_sample(deltas):
+    return (time.monotonic_ns(), deltas)

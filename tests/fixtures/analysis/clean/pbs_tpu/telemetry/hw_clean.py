@@ -1,0 +1,24 @@
+"""Behavior twin of hw_bad.py: the ladder consumed through its
+sanctioned seams — no raw syscall, every probe result None-checked."""
+
+from pbs_tpu.hwtelem.sources import pick_tier
+
+
+def sample_with_guard():
+    """The degradation contract: no tier is a working configuration."""
+    tier = pick_tier()
+    if tier is None:
+        return {}
+    return tier.read()
+
+
+class GuardedSampler:
+    """Stash-in-init, branch-at-use (the TraceBuffer/Ledger idiom)."""
+
+    def __init__(self):
+        self.tier = pick_tier()
+
+    def read(self):
+        if self.tier is None:
+            return {}
+        return self.tier.read()

@@ -66,6 +66,7 @@ def test_all_rules_fire_on_bad_tree():
         "abi-const-drift", "abi-missing-const", "abi-magic-literal",
         "abi-binding-arity", "abi-unknown-symbol",
         "abi-unbound-export", "abi-fastcall-table",
+        "hw-raw-syscall", "hw-unguarded-probe", "hw-wallclock",
         "det-wallclock", "det-unseeded-rng", "det-urandom",
         "det-set-iteration",
     }
@@ -133,7 +134,7 @@ def test_cli_list_passes(capsys):
                 "rollout-discipline", "scenario-discipline",
                 "durability-discipline", "serve-discipline",
                 "seqlock-discipline", "abi-layout-drift",
-                "determinism-discipline"):
+                "hw-discipline", "determinism-discipline"):
         assert pid in out
 
 

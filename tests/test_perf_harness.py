@@ -35,7 +35,7 @@ def test_bench_registry_names():
             "span.emit", "hist.record", "hist.record_many",
             "ledger.snapshot_many", "fairqueue.cycle",
             "journal.append", "gateway.pump", "sim.smoke",
-            "sim.sustained", "sweep.cell",
+            "sim.sustained", "sweep.cell", "hwtelem.sample",
             "rpc.roundtrip"} == set(bench_names())
     # The native matrix is the substrate subset: every native bench
     # exists in the python registry too (dual-mode, same measurement).
